@@ -1,0 +1,36 @@
+"""structured_light_for_3d_model_replication_tpu — TPU-native structured-light scan-to-print framework.
+
+A brand-new JAX/XLA/Pallas/pjit framework with the capabilities of the reference
+scan-to-print system (TtT609/Structured_Light_for_3D_Model_Replication): Gray-code
+pattern projection, phone-synchronized capture, turntable control, per-pixel stripe
+decode, projector-camera stereo calibration, ray-plane triangulation, point-cloud
+cleaning, 360-degree multi-view registration/merge, and meshing to printable STL.
+
+Unlike the reference (single-process NumPy/OpenCV/Open3D), the compute core here is
+vmapped/shard_mapped JAX running on TPU: decode and triangulation are fused XLA
+programs over the full H x W x bitplane stack, point-cloud neighborhood ops are tiled
+matmul-shaped reductions on the MXU, registration is batched-hypothesis RANSAC plus
+fixed-iteration ICP, and meshing is a grid Poisson solve plus vectorized marching
+cubes. Views shard across chips on a `jax.sharding.Mesh` ("data" axis); pixel rows /
+point blocks shard on the "model" axis.
+
+Subpackage map (reference parity in parentheses, see SURVEY.md section 2):
+  ops/       pure array math: graycode, masks, triangulate, knn, pointcloud,
+             registration, normals, poisson, marching_cubes (A4, A8, A9, A12-A20)
+  models/    end-to-end "model" pipelines: scanner forward pass, 360 reconstruction
+  parallel/  device mesh, shardings, collective helpers (new; reference is 1-node)
+  calib/     chessboard + Gray-corner stereo calibration (A6)
+  io/        PLY/STL/.mat/image-stack codecs (A10 replaced with binary vectorized)
+  acquire/   HTTP capture server, turntable serial, projector, sequencer (A2, A5, A21)
+  pipeline/  artifact-per-stage orchestration + resume (gui.py tab flows, A22)
+  utils/     config-adjacent helpers, synthetic scene generator, timing/profiling
+"""
+
+__version__ = "0.1.0"
+
+from structured_light_for_3d_model_replication_tpu.config import (  # noqa: F401
+    Config,
+    DecodeConfig,
+    TriangulateConfig,
+    load_config,
+)

@@ -1,0 +1,69 @@
+"""Command-line interface.
+
+Restores and extends the reference's only non-GUI entry point
+(Old/process_cloud.py:221-236) into a full subcommand CLI covering every GUI tab
+flow (server/gui.py:176-205). Subcommands are registered here as they land;
+each is a thin wrapper over pipeline/ stages so the CLI, GUI, and tests share
+one implementation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from structured_light_for_3d_model_replication_tpu import __version__, load_config
+
+
+def _add_config_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", default=None, help="path to a JSON config file")
+    p.add_argument(
+        "--set",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="dotted config override, e.g. --set merge.voxel_size=1.5",
+    )
+
+
+def parse_overrides(pairs: list[str]) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"--set expects KEY=VALUE, got {pair!r}")
+        k, v = pair.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="sl3d",
+        description="TPU-native structured-light scan-to-print framework",
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    sub = parser.add_subparsers(dest="command")
+
+    p_cfg = sub.add_parser("config", help="print the resolved configuration as JSON")
+    _add_config_args(p_cfg)
+
+    # further subcommands (decode, reconstruct, clean, merge, mesh, scan, calibrate,
+    # serve) register here as the pipeline layer lands
+    from structured_light_for_3d_model_replication_tpu.pipeline import cli_commands
+
+    cli_commands.register(sub, _add_config_args)
+
+    args = parser.parse_args(argv)
+    if args.command is None:
+        parser.print_help()
+        return 1
+    if args.command == "config":
+        cfg = load_config(args.config, parse_overrides(args.set))
+        json.dump(cfg.to_dict(), sys.stdout, indent=2)
+        print()
+        return 0
+    return cli_commands.run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

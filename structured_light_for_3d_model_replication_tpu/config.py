@@ -1,0 +1,215 @@
+"""Typed configuration layer.
+
+Replaces the reference's module constants (server/config.py:10-30) and its ~80
+Tk variables (server/gui.py:31-169) with dataclasses that serialize to/from JSON,
+can be overridden from CLI flags, and carry the execution-backend choice
+(``jax`` on TPU vs ``numpy`` bit-exact CPU reference) required by BASELINE.json.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ProjectorConfig:
+    """Projector geometry (reference: server/config.py:14-22)."""
+
+    width: int = 1920
+    height: int = 1080
+    screen_offset_x: int = 1920  # projector is the second monitor
+    brightness: int = 200        # PROJ_VALUE: white level of projected patterns
+    downsample: int = 1          # D_SAMPLE_PROJ: pattern downsample factor
+
+
+@dataclass
+class CheckerboardConfig:
+    """Calibration target (reference: server/config.py:26-30)."""
+
+    rows: int = 7
+    cols: int = 7
+    square_size_mm: float = 35.0
+
+
+@dataclass
+class DecodeConfig:
+    """Gray-code decode (reference: server/processing.py:28-124)."""
+
+    n_cols: int = 1920
+    n_rows: int = 1080
+    n_sets_col: int = 11     # how many FIRST column bit-planes to use
+    n_sets_row: int = 11     # how many FIRST row bit-planes to use
+    thresh_mode: str = "otsu"  # 'otsu' | 'manual'
+    shadow_val: float = 40.0
+    contrast_val: float = 10.0
+
+
+@dataclass
+class TriangulateConfig:
+    """Ray-plane triangulation (reference: server/processing.py:127-234)."""
+
+    row_mode: int = 1          # 0=columns only, 1=epipolar filter, 2=merge col+row clouds
+    epipolar_tol: float = 2.0  # mm
+
+
+@dataclass
+class CleanConfig:
+    """Point-cloud cleaning (reference: server/processing.py:337-448, gui.py tab 3)."""
+
+    remove_background_plane: bool = True
+    plane_ransac_dist: float = 2.0
+    plane_ransac_trials: int = 512
+    outlier_nb_neighbors: int = 20
+    outlier_std_ratio: float = 2.0
+    cluster_eps: float = 5.0
+    cluster_min_points: int = 200
+    radius_nb_points: int = 100
+    radius: float = 5.0
+
+
+@dataclass
+class MergeConfig:
+    """360-degree merge (reference: server/processing.py:489-629, gui.py:103-111)."""
+
+    voxel_size: float = 3.0
+    icp_dist_ratio: float = 1.5
+    icp_iters: int = 30
+    ransac_trials: int = 4096   # batched-hypothesis equivalent of Open3D's 100k sequential
+    outlier_nb: int = 20
+    outlier_std: float = 2.0
+    sample_before: int = 0       # uniform sample every k-th point before register (0=off)
+    sample_after: int = 0
+    final_voxel: float = 0.5
+    method: str = "sequential"   # 'sequential' (A18) | 'posegraph' (Old/360Merge.py loop closure)
+
+
+@dataclass
+class MeshConfig:
+    """Meshing (reference: server/processing.py:632-860)."""
+
+    mode: str = "watertight"     # 'watertight' (Poisson) | 'surface' (ball-pivot analog)
+    depth: int = 8               # Poisson grid = 2^depth per axis
+    density_trim_quantile: float = 0.02
+    normal_radius: float = 5.0
+    normal_max_nn: int = 30
+    orientation: str = "radial"  # 'radial' | 'tangent' | 'centroid'
+    smooth_iters: int = 0
+    smooth_method: str = "taubin"  # 'taubin' | 'laplacian'
+    simplify_target_faces: int = 0  # 0 = no decimation
+
+
+@dataclass
+class AcquireConfig:
+    """Capture network + devices (reference: server/server.py, arduino.py, sl_system.py)."""
+
+    http_host: str = "0.0.0.0"
+    http_port: int = 5000
+    long_poll_hold_s: float = 2.0
+    capture_timeout_s: float = 20.0
+    disconnect_after_s: float = 5.0
+    settle_ms_scan: int = 200
+    settle_ms_calib: int = 250
+    serial_port: str = ""        # empty = auto-scan /dev/ttyUSB*, /dev/ttyACM*
+    serial_baud: int = 115200
+    rotate_timeout_s: float = 30.0
+    turns: int = 12
+    degrees_per_turn: float = 30.0
+    simulate: bool = False       # no-hardware mode (reference gui.py:1705-1779)
+
+
+@dataclass
+class ParallelConfig:
+    """Device-mesh layout. New in the TPU build (reference is single-node)."""
+
+    data_axis: int = 0      # shards turntable views; 0 = use all available devices
+    model_axis: int = 1     # shards pixel rows / point blocks within a view
+    backend: str = "jax"    # 'jax' | 'numpy' (bit-exact CPU reference path)
+    use_bf16_features: bool = True  # bf16 for feature/dist matmuls, fp32 accumulation
+
+
+@dataclass
+class Config:
+    """Root configuration for the whole framework."""
+
+    projector: ProjectorConfig = field(default_factory=ProjectorConfig)
+    checkerboard: CheckerboardConfig = field(default_factory=CheckerboardConfig)
+    decode: DecodeConfig = field(default_factory=DecodeConfig)
+    triangulate: TriangulateConfig = field(default_factory=TriangulateConfig)
+    clean: CleanConfig = field(default_factory=CleanConfig)
+    merge: MergeConfig = field(default_factory=MergeConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    acquire: AcquireConfig = field(default_factory=AcquireConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    scan_root: str = ""  # dated scan folder; empty = ./scans/<date>
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+
+
+def _from_dict(cls: type, data: dict[str, Any]) -> Any:
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    kwargs: dict[str, Any] = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        ftype = hints.get(f.name)
+        if isinstance(v, dict) and dataclasses.is_dataclass(ftype):
+            kwargs[f.name] = _from_dict(ftype, v)  # type: ignore[arg-type]
+        else:
+            kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+def _coerce(cur: Any, value: Any) -> Any:
+    """Coerce an override value to the type of the current field value."""
+    if value is None or cur is None or isinstance(cur, (dict, list)):
+        return value
+    if isinstance(cur, bool):
+        if isinstance(value, str):
+            low = value.strip().lower()
+            if low in ("1", "true", "yes", "on"):
+                return True
+            if low in ("0", "false", "no", "off"):
+                return False
+            raise ValueError(f"Cannot interpret {value!r} as a boolean")
+        return bool(value)
+    if isinstance(cur, int) and not isinstance(cur, bool):
+        as_float = float(value)
+        if as_float != int(as_float):
+            raise ValueError(f"Expected an integer, got {value!r}")
+        return int(as_float)
+    return type(cur)(value)
+
+
+def load_config(path: str | None = None, overrides: dict[str, Any] | None = None) -> Config:
+    """Load a Config from JSON, with optional dotted-key overrides.
+
+    ``overrides`` maps dotted keys (e.g. ``"merge.voxel_size"``) to values —
+    the mechanism the CLI uses for per-flag parameter overrides, replacing the
+    reference's per-tab Tk variables.
+    """
+    cfg = Config()
+    if path:
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"Config file not found: {path}")
+        with open(path) as f:
+            cfg = _from_dict(Config, json.load(f))
+    for key, value in (overrides or {}).items():
+        obj: Any = cfg
+        parts = key.split(".")
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        leaf = parts[-1]
+        cur = getattr(obj, leaf)  # raises AttributeError on unknown keys
+        setattr(obj, leaf, _coerce(cur, value))
+    return cfg

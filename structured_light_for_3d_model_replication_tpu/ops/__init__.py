@@ -1,0 +1,7 @@
+"""Pure array math: the TPU compute core.
+
+Every op in this package is written as a pure function, generic over the array
+namespace where practical, with a jitted JAX entry point (the TPU path) and a
+NumPy entry point (the bit-exact CPU reference path selected by
+``ParallelConfig.backend == "numpy"``).
+"""

@@ -1,0 +1,306 @@
+"""Gray-code pattern generation and per-pixel decode.
+
+Capability parity (behavioral spec studied from the reference, re-designed for XLA):
+  - pattern generation: server/sl_system.py:44-86 (reflected Gray code, MSB-first,
+    column-stripe and row-stripe bit-plane images, white + black + pattern/inverse pairs)
+  - decode: server/processing.py:28-124 (Otsu or manual shadow+contrast masks,
+    first-n-bit decode with always-advancing frame pointer, Gray->binary conversion,
+    coordinate rescale by 2^(max_bits - n_use))
+
+TPU-first design notes
+----------------------
+The reference decodes with a Python loop of per-bit cv2.imread + compares. Here the
+whole stack lives as one [F, H, W] array: the bit compare is a single vectorized
+``pattern > inverse`` over all bit-planes at once, the Gray->binary conversion is a
+log2-depth XOR-downshift cascade (exact in int32), and everything fuses into one XLA
+program with no host round-trips. Frames enter as uint8 and are compared in integer
+space (no float upcast needed for exactness; the reference's float32 upcast of uint8
+values is value-preserving, so integer compare is bit-identical).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "gray_bits",
+    "generate_pattern_stack",
+    "frames_per_view",
+    "otsu_threshold",
+    "otsu_threshold_np",
+    "decode_stack",
+    "decode_stack_np",
+    "DecodeResult",
+]
+
+
+def _n_bits(size: int) -> int:
+    return max(1, int(np.ceil(np.log2(size))))
+
+
+def gray_bits(size: int, n_bits: int | None = None) -> np.ndarray:
+    """Bit-planes of the reflected Gray code for positions [0, size).
+
+    Returns bool array [n_bits, size]; row b is the MSB-first bit b of gray(x),
+    where gray(x) = x ^ (x >> 1). This closed form equals the reference's
+    recursive string construction (server/sl_system.py:56-62): the reflect-and-
+    prefix recursion generates exactly the sequence gray(x) = x ^ (x >> 1).
+    """
+    if n_bits is None:
+        n_bits = _n_bits(size)
+    x = np.arange(size, dtype=np.int64)
+    g = x ^ (x >> 1)
+    shifts = np.arange(n_bits - 1, -1, -1, dtype=np.int64)  # MSB first
+    return ((g[None, :] >> shifts[:, None]) & 1).astype(bool)
+
+
+def frames_per_view(width: int = 1920, height: int = 1080, downsample: int = 1) -> int:
+    """Frame count of one capture sequence: white + black + 2*(n_col_bits + n_row_bits).
+
+    1920x1080 -> 2 + 2*(11+11) = 46, matching server/sl_system.py:126-150. With
+    pattern downsampling k, the stripe images carry fewer bit-planes:
+    2 + 2*(bits(w//k) + bits(h//k)).
+    """
+    return 2 + 2 * (_n_bits(width // downsample) + _n_bits(height // downsample))
+
+
+def generate_pattern_stack(
+    width: int = 1920,
+    height: int = 1080,
+    brightness: int = 200,
+    downsample: int = 1,
+) -> np.ndarray:
+    """Full projector frame sequence as uint8 [F, height, width].
+
+    Order (the capture-file contract, server/sl_system.py:126-150): frame 0 white,
+    frame 1 black, then for each column bit MSB->LSB (pattern, inverse), then each
+    row bit (pattern, inverse). ``downsample`` = D_SAMPLE_PROJ (server/config.py:22):
+    patterns are computed at (width//k, height//k) — fewer, coarser bit-planes — and
+    nearest-upsampled back to full projector resolution for display, matching the
+    reference's resize-before-imshow (server/sl_system.py:144-147). Decode the
+    resulting captures with ``decode_stack(..., downsample=k)`` to recover
+    full-range projector coordinates.
+    """
+    w, h = width // downsample, height // downsample
+    nc, nr = _n_bits(w), _n_bits(h)
+    col = gray_bits(w, nc)  # [nc, w]
+    row = gray_bits(h, nr)  # [nr, h]
+    frames = np.zeros((2 + 2 * (nc + nr), h, w), dtype=np.uint8)
+    frames[0] = brightness
+    # frames[1] stays black
+    f = 2
+    for b in range(nc):
+        stripe = np.where(col[b], brightness, 0).astype(np.uint8)  # [w]
+        frames[f] = np.broadcast_to(stripe, (h, w))
+        frames[f + 1] = brightness - frames[f]
+        f += 2
+    for b in range(nr):
+        stripe = np.where(row[b], brightness, 0).astype(np.uint8)  # [h]
+        frames[f] = np.broadcast_to(stripe[:, None], (h, w))
+        frames[f + 1] = brightness - frames[f]
+        f += 2
+    if downsample > 1:
+        # nearest-neighbor upsample to the full projector raster
+        xi = (np.arange(width) * w) // width
+        yi = (np.arange(height) * h) // height
+        frames = frames[:, yi[:, None], xi[None, :]]
+    return frames
+
+
+# ---------------------------------------------------------------------------
+# Otsu threshold — histogram argmax of between-class variance. Matches OpenCV's
+# algorithm (first maximum wins; classes with zero mass score 0) so the manual
+# masks used by the reference (server/processing.py:63-72) reproduce exactly.
+# ---------------------------------------------------------------------------
+
+def _otsu_from_hist(counts, xp):
+    # float64 on the NumPy path; float32 on TPU (x64 is disabled under jit). The
+    # moments are exact integers well inside fp32's 2^24 only for small images, so
+    # the fp32 score can differ from fp64 in the ~1e-7 relative tail; the argmax is
+    # validated against OpenCV at 1080p in tests (test_otsu_matches_cv2_fullres).
+    dtype = xp.float64 if xp is np else xp.float32
+    counts = counts.astype(dtype)
+    total = counts.sum()
+    levels = xp.arange(256, dtype=dtype)
+    w1 = xp.cumsum(counts)                     # mass of class {0..t}
+    m1 = xp.cumsum(counts * levels)            # unnormalized first moment of class {0..t}
+    mT = m1[-1]
+    w2 = total - w1
+    # between-class variance: w1*w2*(mu1-mu2)^2 = (mT*w1 - total*m1)^2 / (w1*w2*total^2)
+    num = (mT * w1 - total * m1) ** 2
+    den = w1 * w2
+    sigma_b = xp.where(den > 0, num / xp.where(den > 0, den, 1.0), 0.0)
+    return xp.argmax(sigma_b)  # first max, like OpenCV's strict-> scan
+
+
+def otsu_threshold_np(img_u8: np.ndarray) -> int:
+    """Otsu threshold of a uint8 image (NumPy reference path)."""
+    counts = np.bincount(img_u8.reshape(-1), minlength=256)[:256]
+    return int(_otsu_from_hist(counts, np))
+
+
+def otsu_threshold(img_u8: jax.Array) -> jax.Array:
+    """Otsu threshold of a uint8 image (JAX path, jit-safe, returns 0-d int array)."""
+    counts = jnp.bincount(img_u8.reshape(-1).astype(jnp.int32), length=256)
+    return _otsu_from_hist(counts, jnp)
+
+
+# ---------------------------------------------------------------------------
+# Decode
+# ---------------------------------------------------------------------------
+
+class DecodeResult(NamedTuple):
+    """Per-pixel decode output. Shapes stay fixed [H, W]; invalid pixels carry mask=False."""
+
+    col_map: jax.Array | np.ndarray  # int32 [H, W], projector column in [0, 2^nc)
+    row_map: jax.Array | np.ndarray  # int32 [H, W], projector row in [0, 2^nr)
+    mask: jax.Array | np.ndarray     # bool  [H, W], shadow & contrast valid
+    texture: jax.Array | np.ndarray  # uint8 [H, W, 3] color of the white frame
+
+
+def _gray_to_binary(g, xp):
+    # XOR-downshift cascade: exact inverse of gray(x) = x ^ (x >> 1) for <= 16 bits.
+    g = g ^ (g >> 1)
+    g = g ^ (g >> 2)
+    g = g ^ (g >> 4)
+    g = g ^ (g >> 8)
+    return g
+
+
+def _decode_axis(frames_i16, start, max_bits, n_use, xp):
+    """Decode one axis from pattern/inverse pairs at frames[start : start+2*max_bits].
+
+    Reads only the first ``n_use`` bit pairs (the rest are skipped with the frame
+    pointer still advancing, per server/processing.py:88-111) and scales the result
+    by 2^(max_bits - n_use) to keep projector coordinates full-range.
+    """
+    pat = frames_i16[start : start + 2 * n_use : 2]      # [n_use, H, W]
+    inv = frames_i16[start + 1 : start + 2 * n_use : 2]  # [n_use, H, W]
+    bits = (pat > inv).astype(xp.int32)                  # [n_use, H, W]
+    weights = (1 << np.arange(n_use - 1, -1, -1, dtype=np.int32))  # MSB first
+    gray = xp.sum(bits * xp.asarray(weights)[:, None, None], axis=0)
+    binary = _gray_to_binary(gray, xp)
+    return binary * (1 << (max_bits - n_use))
+
+
+def _decode_impl(
+    frames,          # uint8/int [F, H, W] grayscale capture stack
+    texture,         # uint8 [H, W, 3]
+    shadow_thresh,   # scalar: mask keeps white > shadow_thresh
+    contrast_thresh, # scalar: mask keeps (white - black) > contrast_thresh
+    *,
+    n_cols: int,
+    n_rows: int,
+    n_sets_col: int,
+    n_sets_row: int,
+    downsample: int,
+    xp,
+):
+    # patterns projected with downsample k carry bits of the k-decimated raster;
+    # decode in that space, then scale by k to restore full projector coordinates
+    n_cols = n_cols // downsample
+    n_rows = n_rows // downsample
+    max_col_bits = _n_bits(n_cols)
+    max_row_bits = _n_bits(n_rows)
+    n_use_col = max(1, min(int(n_sets_col), max_col_bits))
+    n_use_row = max(1, min(int(n_sets_row), max_row_bits))
+
+    need = 2 + 2 * (max_col_bits + max_row_bits)
+    if frames.shape[0] < need:
+        raise ValueError(
+            f"Not enough frames: got {frames.shape[0]}, need {need} "
+            f"(white + black + 2*({max_col_bits} col + {max_row_bits} row bit-planes)) "
+            f"for a {n_cols}x{n_rows} projector."
+        )
+
+    fr = frames.astype(xp.int16)
+    white = fr[0]
+    black = fr[1]
+    mask = (white > shadow_thresh) & ((white - black) > contrast_thresh)
+
+    col_map = _decode_axis(fr, 2, max_col_bits, n_use_col, xp) * downsample
+    row_map = _decode_axis(fr, 2 + 2 * max_col_bits, max_row_bits, n_use_row, xp) * downsample
+    return DecodeResult(col_map.astype(xp.int32), row_map.astype(xp.int32), mask, texture)
+
+
+def _resolve_thresholds_np(frames, thresh_mode, shadow_val, contrast_val):
+    white = frames[0]
+    black = frames[1]
+    if thresh_mode == "otsu":
+        shadow = otsu_threshold_np(white.astype(np.uint8))
+        diff = np.clip(
+            white.astype(np.float32) - black.astype(np.float32), 0, 255
+        ).astype(np.uint8)
+        contrast = otsu_threshold_np(diff)
+        return float(shadow), float(contrast)
+    return float(shadow_val), float(contrast_val)
+
+
+def decode_stack_np(
+    frames: np.ndarray,
+    texture: np.ndarray | None = None,
+    *,
+    n_cols: int = 1920,
+    n_rows: int = 1080,
+    n_sets_col: int = 11,
+    n_sets_row: int = 11,
+    thresh_mode: str = "otsu",
+    shadow_val: float = 40.0,
+    contrast_val: float = 10.0,
+    downsample: int = 1,
+) -> DecodeResult:
+    """NumPy (bit-exact CPU reference) decode of a [F, H, W] capture stack."""
+    if texture is None:
+        texture = np.repeat(frames[0][..., None], 3, axis=-1).astype(np.uint8)
+    shadow, contrast = _resolve_thresholds_np(frames, thresh_mode, shadow_val, contrast_val)
+    return _decode_impl(
+        frames, texture, shadow, contrast,
+        n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col, n_sets_row=n_sets_row,
+        downsample=downsample, xp=np,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_cols", "n_rows", "n_sets_col", "n_sets_row", "thresh_mode", "downsample"),
+)
+def decode_stack(
+    frames: jax.Array,
+    texture: jax.Array | None = None,
+    *,
+    n_cols: int = 1920,
+    n_rows: int = 1080,
+    n_sets_col: int = 11,
+    n_sets_row: int = 11,
+    thresh_mode: str = "otsu",
+    shadow_val: float = 40.0,
+    contrast_val: float = 10.0,
+    downsample: int = 1,
+) -> DecodeResult:
+    """JAX/TPU decode of a [F, H, W] capture stack — one fused XLA program.
+
+    Otsu thresholds are computed on-device (256-bin histogram argmax), so the whole
+    decode including masking never leaves the TPU.
+    """
+    if texture is None:
+        texture = jnp.repeat(frames[0][..., None], 3, axis=-1).astype(jnp.uint8)
+    if thresh_mode == "otsu":
+        white = frames[0]
+        black = frames[1]
+        shadow = otsu_threshold(white.astype(jnp.uint8)).astype(jnp.int16)
+        diff = jnp.clip(
+            white.astype(jnp.float32) - black.astype(jnp.float32), 0, 255
+        ).astype(jnp.uint8)
+        contrast = otsu_threshold(diff).astype(jnp.int16)
+    else:
+        shadow = jnp.asarray(shadow_val, jnp.float32)
+        contrast = jnp.asarray(contrast_val, jnp.float32)
+    return _decode_impl(
+        frames, texture, shadow, contrast,
+        n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col, n_sets_row=n_sets_row,
+        downsample=downsample, xp=jnp,
+    )

@@ -1,0 +1,21 @@
+"""CLI subcommand registry.
+
+Pipeline stages self-register their CLI surface here; cli.py stays a thin shell.
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Callable
+
+_RUNNERS: dict[str, Callable[[argparse.Namespace], int]] = {}
+
+
+def register(sub: argparse._SubParsersAction, add_config_args) -> None:
+    """Register all pipeline subcommands. Populated as stages land."""
+
+
+def run(args: argparse.Namespace) -> int:
+    runner = _RUNNERS.get(args.command)
+    if runner is None:
+        raise SystemExit(f"unknown command: {args.command}")
+    return runner(args)

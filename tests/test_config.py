@@ -1,0 +1,61 @@
+"""Config layer: JSON round-trip, dotted overrides, coercion, error paths."""
+import json
+
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.config import Config, load_config
+
+
+def test_roundtrip(tmp_path):
+    cfg = Config()
+    cfg.merge.voxel_size = 1.25
+    cfg.parallel.backend = "numpy"
+    p = tmp_path / "cfg.json"
+    cfg.save(str(p))
+    loaded = load_config(str(p))
+    assert loaded.merge.voxel_size == 1.25
+    assert loaded.parallel.backend == "numpy"
+    assert loaded.decode.n_sets_col == 11
+
+
+def test_missing_path_raises():
+    with pytest.raises(FileNotFoundError):
+        load_config("/nonexistent/cfg.json")
+
+
+def test_override_coercion():
+    cfg = load_config(overrides={
+        "acquire.simulate": "false",
+        "clean.remove_background_plane": "true",
+        "merge.voxel_size": "1.5",
+        "mesh.depth": "9",
+    })
+    assert cfg.acquire.simulate is False
+    assert cfg.clean.remove_background_plane is True
+    assert cfg.merge.voxel_size == 1.5
+    assert cfg.mesh.depth == 9
+
+
+def test_override_bad_values():
+    with pytest.raises(ValueError):
+        load_config(overrides={"acquire.simulate": "maybe"})
+    with pytest.raises(ValueError):
+        load_config(overrides={"mesh.depth": "3.7"})
+    with pytest.raises(AttributeError):
+        load_config(overrides={"nope.key": 1})
+
+
+def test_nested_partial_json(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"merge": {"voxel_size": 9.0}, "scan_root": "/tmp/x"}))
+    cfg = load_config(str(p))
+    assert cfg.merge.voxel_size == 9.0
+    assert cfg.merge.icp_iters == 30  # untouched default
+    assert cfg.scan_root == "/tmp/x"
+
+
+def test_cli_config_surface(capsys):
+    from structured_light_for_3d_model_replication_tpu.cli import main
+    assert main(["config", "--set", "merge.voxel_size=2.5"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["merge"]["voxel_size"] == 2.5

@@ -1,0 +1,117 @@
+"""Gray-code encode/decode: round-trip, partial-bit quantization, jax==numpy exactness."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
+
+
+def test_gray_bits_matches_reflected_recursion():
+    # the recursive reflect-and-prefix construction must equal gray(x) = x ^ (x >> 1)
+    def recursive(n):
+        if n == 1:
+            return ["0", "1"]
+        prev = recursive(n - 1)
+        return ["0" + s for s in prev] + ["1" + s for s in prev[::-1]]
+
+    for n in (1, 3, 6):
+        codes = recursive(n)
+        bits = gc.gray_bits(2**n, n)
+        for x, s in enumerate(codes):
+            got = "".join("1" if b else "0" for b in bits[:, x])
+            assert got == s
+
+
+def test_frames_per_view_default_is_46():
+    assert gc.frames_per_view(1920, 1080) == 46
+
+
+@pytest.mark.parametrize("w,h", [(64, 48), (640, 480)])
+def test_roundtrip_full_bits(w, h):
+    frames = gc.generate_pattern_stack(w, h, brightness=200)
+    res = gc.decode_stack_np(frames, n_cols=w, n_rows=h,
+                             n_sets_col=99, n_sets_row=99, thresh_mode="manual",
+                             shadow_val=40, contrast_val=10)
+    yy, xx = np.mgrid[0:h, 0:w]
+    assert res.mask.all()
+    np.testing.assert_array_equal(res.col_map, xx)
+    np.testing.assert_array_equal(res.row_map, yy)
+
+
+def test_roundtrip_partial_bits_quantizes():
+    w, h = 256, 128
+    frames = gc.generate_pattern_stack(w, h, brightness=255)
+    res = gc.decode_stack_np(frames, n_cols=w, n_rows=h,
+                             n_sets_col=5, n_sets_row=4, thresh_mode="manual")
+    yy, xx = np.mgrid[0:h, 0:w]
+    kc = 8 - 5  # max_col_bits - n_use
+    kr = 7 - 4
+    np.testing.assert_array_equal(res.col_map, (xx >> kc) << kc)
+    np.testing.assert_array_equal(res.row_map, (yy >> kr) << kr)
+
+
+def test_downsample_roundtrip_full_range_coords():
+    w, h = 256, 128
+    ds = 4
+    frames = gc.generate_pattern_stack(w, h, brightness=200, downsample=ds)
+    assert frames.shape == (gc.frames_per_view(w, h, ds), h, w)
+    res = gc.decode_stack_np(frames, n_cols=w, n_rows=h, downsample=ds,
+                             thresh_mode="manual")
+    yy, xx = np.mgrid[0:h, 0:w]
+    # decoded coordinate is the k-decimated position scaled back to full range
+    np.testing.assert_array_equal(res.col_map, (xx // ds) * ds)
+    np.testing.assert_array_equal(res.row_map, (yy // ds) * ds)
+
+
+def test_masks_shadow_and_contrast():
+    w, h = 32, 16
+    frames = gc.generate_pattern_stack(w, h, brightness=200).astype(np.int32)
+    # dim a corner below the shadow threshold; kill contrast elsewhere
+    frames = frames.astype(np.uint8)
+    frames[0, :4, :4] = 10          # white frame too dark -> shadow mask
+    frames[1, :4, 4:8] = 250        # black frame bright -> contrast mask fails (white-black<0)
+    res = gc.decode_stack_np(frames, n_cols=w, n_rows=h, thresh_mode="manual",
+                             shadow_val=40, contrast_val=10)
+    assert not res.mask[:4, :4].any()
+    assert not res.mask[:4, 4:8].any()
+    assert res.mask[8:, 8:].all()
+
+
+def test_otsu_matches_cv2():
+    cv2 = pytest.importorskip("cv2")
+    rng = np.random.default_rng(1)
+    # bimodal image
+    img = np.concatenate([
+        rng.normal(60, 10, 5000), rng.normal(190, 12, 5000)
+    ]).clip(0, 255).astype(np.uint8).reshape(100, 100)
+    ref, _ = cv2.threshold(img, 0, 255, cv2.THRESH_BINARY | cv2.THRESH_OTSU)
+    assert gc.otsu_threshold_np(img) == int(ref)
+    assert int(gc.otsu_threshold(jnp.asarray(img))) == int(ref)
+
+
+def test_otsu_matches_cv2_fullres(rng):
+    cv2 = pytest.importorskip("cv2")
+    # full 1080p-scale histogram: fp32 on-device scoring must still pick cv2's bin
+    img = np.clip(
+        rng.normal(90, 45, (1080, 1920)) + 80 * (rng.random((1080, 1920)) > 0.6),
+        0, 255,
+    ).astype(np.uint8)
+    ref, _ = cv2.threshold(img, 0, 255, cv2.THRESH_BINARY | cv2.THRESH_OTSU)
+    assert gc.otsu_threshold_np(img) == int(ref)
+    assert int(gc.otsu_threshold(jnp.asarray(img))) == int(ref)
+
+
+@pytest.mark.parametrize("mode", ["otsu", "manual"])
+def test_jax_decode_bit_exact_vs_numpy(mode, rng):
+    w, h = 128, 96
+    frames = gc.generate_pattern_stack(w, h, brightness=200).astype(np.int32)
+    # realistic corruption: noise + shading, clipped to uint8
+    noise = rng.normal(0, 8, frames.shape)
+    shade = 0.5 + 0.5 * np.linspace(0, 1, w)[None, None, :]
+    frames = np.clip(frames * shade + noise, 0, 255).astype(np.uint8)
+    kw = dict(n_cols=w, n_rows=h, thresh_mode=mode, shadow_val=35.0, contrast_val=12.0)
+    r_np = gc.decode_stack_np(frames, **kw)
+    r_jx = gc.decode_stack(jnp.asarray(frames), **kw)
+    np.testing.assert_array_equal(np.asarray(r_jx.col_map), r_np.col_map)
+    np.testing.assert_array_equal(np.asarray(r_jx.row_map), r_np.row_map)
+    np.testing.assert_array_equal(np.asarray(r_jx.mask), r_np.mask)
