@@ -157,6 +157,13 @@ def _from_dict(cls: type, data: dict[str, Any]) -> Any:
     import typing
 
     hints = typing.get_type_hints(cls)
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(data) - known
+    if unknown:
+        raise ValueError(
+            f"Unknown key(s) in config section {cls.__name__}: {sorted(unknown)}; "
+            f"valid keys: {sorted(known)}"
+        )
     kwargs: dict[str, Any] = {}
     for f in dataclasses.fields(cls):
         if f.name not in data:
@@ -172,6 +179,11 @@ def _from_dict(cls: type, data: dict[str, Any]) -> Any:
 
 def _coerce(cur: Any, value: Any) -> Any:
     """Coerce an override value to the type of the current field value."""
+    if dataclasses.is_dataclass(cur):
+        raise ValueError(
+            f"Cannot override a whole config section with {value!r}; "
+            f"use a dotted leaf key like section.field=value"
+        )
     if value is None or cur is None or isinstance(cur, (dict, list)):
         return value
     if isinstance(cur, bool):

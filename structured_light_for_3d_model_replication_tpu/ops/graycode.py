@@ -227,17 +227,50 @@ def _decode_impl(
     return DecodeResult(col_map.astype(xp.int32), row_map.astype(xp.int32), mask, texture)
 
 
-def _resolve_thresholds_np(frames, thresh_mode, shadow_val, contrast_val):
+def _shadow_contrast_hists(white_u8, diff_u8, xp):
+    """256-bin histograms of the white frame and the clipped white-black diff."""
+    if xp is np:
+        h_w = np.bincount(white_u8.reshape(-1), minlength=256)[:256]
+        h_d = np.bincount(diff_u8.reshape(-1), minlength=256)[:256]
+    else:
+        h_w = jnp.bincount(white_u8.reshape(-1).astype(jnp.int32), length=256)
+        h_d = jnp.bincount(diff_u8.reshape(-1).astype(jnp.int32), length=256)
+    return h_w, h_d
+
+
+def _white_diff_u8(frames, xp):
     white = frames[0]
-    black = frames[1]
-    if thresh_mode == "otsu":
-        shadow = otsu_threshold_np(white.astype(np.uint8))
-        diff = np.clip(
-            white.astype(np.float32) - black.astype(np.float32), 0, 255
-        ).astype(np.uint8)
-        contrast = otsu_threshold_np(diff)
-        return float(shadow), float(contrast)
-    return float(shadow_val), float(contrast_val)
+    diff = xp.clip(
+        white.astype(xp.float32) - frames[1].astype(xp.float32), 0, 255
+    ).astype(xp.uint8)
+    return white.astype(xp.uint8), diff
+
+
+@jax.jit
+def _hists_device(frames):
+    white_u8, diff_u8 = _white_diff_u8(frames, jnp)
+    return _shadow_contrast_hists(white_u8, diff_u8, jnp)
+
+
+def resolve_thresholds(frames, thresh_mode: str, shadow_val: float, contrast_val: float,
+                       xp=np) -> tuple[float, float]:
+    """Shadow/contrast thresholds for a capture stack.
+
+    In ``otsu`` mode the 256-bin histograms are built wherever the frames live
+    (on-device for JAX) and scored HOST-SIDE in exact float64, so the NumPy and
+    JAX backends are guaranteed to pick the same bin — fp32 on-device scoring
+    can flip near-tied bins (see ``otsu_device`` mode for the fully fused
+    variant that accepts that risk).
+    """
+    if thresh_mode != "otsu":
+        return float(shadow_val), float(contrast_val)
+    if xp is np:
+        white_u8, diff_u8 = _white_diff_u8(frames, np)
+        h_w, h_d = _shadow_contrast_hists(white_u8, diff_u8, np)
+    else:
+        h_w, h_d = _hists_device(frames)
+        h_w, h_d = np.asarray(h_w), np.asarray(h_d)
+    return float(_otsu_from_hist(h_w, np)), float(_otsu_from_hist(h_d, np))
 
 
 def decode_stack_np(
@@ -256,7 +289,7 @@ def decode_stack_np(
     """NumPy (bit-exact CPU reference) decode of a [F, H, W] capture stack."""
     if texture is None:
         texture = np.repeat(frames[0][..., None], 3, axis=-1).astype(np.uint8)
-    shadow, contrast = _resolve_thresholds_np(frames, thresh_mode, shadow_val, contrast_val)
+    shadow, contrast = resolve_thresholds(frames, thresh_mode, shadow_val, contrast_val, np)
     return _decode_impl(
         frames, texture, shadow, contrast,
         n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col, n_sets_row=n_sets_row,
@@ -266,8 +299,25 @@ def decode_stack_np(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_cols", "n_rows", "n_sets_col", "n_sets_row", "thresh_mode", "downsample"),
+    static_argnames=("n_cols", "n_rows", "n_sets_col", "n_sets_row", "otsu_device", "downsample"),
 )
+def _decode_jit(
+    frames, texture, shadow_val, contrast_val,
+    *, n_cols, n_rows, n_sets_col, n_sets_row, otsu_device, downsample,
+):
+    if otsu_device:
+        white_u8, diff_u8 = _white_diff_u8(frames, jnp)
+        shadow = otsu_threshold(white_u8).astype(jnp.int16)
+        contrast = otsu_threshold(diff_u8).astype(jnp.int16)
+    else:
+        shadow, contrast = shadow_val, contrast_val
+    return _decode_impl(
+        frames, texture, shadow, contrast,
+        n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col, n_sets_row=n_sets_row,
+        downsample=downsample, xp=jnp,
+    )
+
+
 def decode_stack(
     frames: jax.Array,
     texture: jax.Array | None = None,
@@ -281,26 +331,26 @@ def decode_stack(
     contrast_val: float = 10.0,
     downsample: int = 1,
 ) -> DecodeResult:
-    """JAX/TPU decode of a [F, H, W] capture stack — one fused XLA program.
+    """JAX/TPU decode of a [F, H, W] capture stack.
 
-    Otsu thresholds are computed on-device (256-bin histogram argmax), so the whole
-    decode including masking never leaves the TPU.
+    ``thresh_mode``:
+      - ``"otsu"`` (default): histograms on-device, 256-bin scoring host-side in
+        exact fp64 — guaranteed threshold parity with ``decode_stack_np``.
+      - ``"otsu_device"``: fully fused on-device Otsu (fp32 scoring) — zero host
+        sync, for jit-composed batch pipelines; near-tied histogram bins may
+        pick a neighboring threshold vs the NumPy backend.
+      - ``"manual"``: use ``shadow_val`` / ``contrast_val`` as given.
     """
     if texture is None:
         texture = jnp.repeat(frames[0][..., None], 3, axis=-1).astype(jnp.uint8)
+    otsu_device = thresh_mode == "otsu_device"
     if thresh_mode == "otsu":
-        white = frames[0]
-        black = frames[1]
-        shadow = otsu_threshold(white.astype(jnp.uint8)).astype(jnp.int16)
-        diff = jnp.clip(
-            white.astype(jnp.float32) - black.astype(jnp.float32), 0, 255
-        ).astype(jnp.uint8)
-        contrast = otsu_threshold(diff).astype(jnp.int16)
-    else:
-        shadow = jnp.asarray(shadow_val, jnp.float32)
-        contrast = jnp.asarray(contrast_val, jnp.float32)
-    return _decode_impl(
-        frames, texture, shadow, contrast,
+        shadow_val, contrast_val = resolve_thresholds(
+            frames, "otsu", shadow_val, contrast_val, jnp
+        )
+    return _decode_jit(
+        frames, texture,
+        jnp.asarray(shadow_val, jnp.float32), jnp.asarray(contrast_val, jnp.float32),
         n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col, n_sets_row=n_sets_row,
-        downsample=downsample, xp=jnp,
+        otsu_device=otsu_device, downsample=downsample,
     )

@@ -11,6 +11,12 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
+import jax  # noqa: E402
+
+# the environment's sitecustomize force-registers the axon TPU plugin and wins
+# over the env var; the config update is authoritative
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -43,6 +43,15 @@ def test_override_bad_values():
         load_config(overrides={"mesh.depth": "3.7"})
     with pytest.raises(AttributeError):
         load_config(overrides={"nope.key": 1})
+    with pytest.raises(ValueError):  # whole-section override is a typo, not a request
+        load_config(overrides={"merge": "5"})
+
+
+def test_unknown_json_key_raises(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"merge": {"voxel_sizes": 9.0}}))  # typo'd key
+    with pytest.raises(ValueError, match="voxel_sizes"):
+        load_config(str(p))
 
 
 def test_nested_partial_json(tmp_path):

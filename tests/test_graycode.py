@@ -101,6 +101,23 @@ def test_otsu_matches_cv2_fullres(rng):
     assert int(gc.otsu_threshold(jnp.asarray(img))) == int(ref)
 
 
+def test_otsu_device_mode_runs_fused(rng):
+    # the fully fused on-device Otsu variant: same shapes, mask within a bin of
+    # the exact path (usually identical; near-ties may flip one bin)
+    w, h = 128, 96
+    frames = np.clip(
+        gc.generate_pattern_stack(w, h, 200).astype(np.int32)
+        + rng.normal(0, 8, (gc.frames_per_view(w, h), h, w)),
+        0, 255,
+    ).astype(np.uint8)
+    r_dev = gc.decode_stack(jnp.asarray(frames), n_cols=w, n_rows=h,
+                            thresh_mode="otsu_device")
+    r_ref = gc.decode_stack_np(frames, n_cols=w, n_rows=h, thresh_mode="otsu")
+    assert np.asarray(r_dev.mask).shape == r_ref.mask.shape
+    agree = (np.asarray(r_dev.mask) == r_ref.mask).mean()
+    assert agree > 0.99
+
+
 @pytest.mark.parametrize("mode", ["otsu", "manual"])
 def test_jax_decode_bit_exact_vs_numpy(mode, rng):
     w, h = 128, 96
