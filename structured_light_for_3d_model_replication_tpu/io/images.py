@@ -1,0 +1,110 @@
+"""Capture-stack image IO.
+
+The reference reads scan folders of 46 numbered frames ("01.png".."46.png",
+server/sl_system.py:126-150) one cv2.imread at a time inside the decode loop
+(processing.py:95-101). Here the stack loads once into a [F, H, W] array (and
+the white frame additionally as RGB texture), so the decode kernel sees a
+single device buffer. cv2 is used when present; a PNG/PPM fallback via PIL
+keeps the path alive without it.
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+__all__ = ["list_frame_files", "load_stack", "save_stack", "load_gray", "load_color"]
+
+_EXTS = (".bmp", ".png", ".jpg", ".jpeg", ".ppm", ".pgm")
+
+
+def _imread(path: str, gray: bool):
+    try:
+        import cv2
+
+        img = cv2.imread(path, 0 if gray else 1)
+        if img is None:
+            raise IOError(f"unreadable image: {path}")
+        if not gray:
+            img = img[:, :, ::-1]  # BGR -> RGB at the IO boundary, once
+        return img
+    except ImportError:
+        from PIL import Image
+
+        img = Image.open(path)
+        img = img.convert("L" if gray else "RGB")
+        return np.asarray(img)
+
+
+def _imwrite(path: str, img: np.ndarray):
+    try:
+        import cv2
+
+        ok = cv2.imwrite(path, img if img.ndim == 2 else img[:, :, ::-1])
+        if not ok:
+            raise IOError(f"failed to write {path}")
+    except ImportError:
+        from PIL import Image
+
+        Image.fromarray(img).save(path)
+
+
+def load_gray(path: str) -> np.ndarray:
+    return _imread(path, gray=True)
+
+
+def load_color(path: str) -> np.ndarray:
+    """Returns RGB uint8 [H, W, 3]."""
+    return _imread(path, gray=False)
+
+
+def list_frame_files(source) -> list[str]:
+    """Resolve a scan source (folder or explicit file list) to a sorted frame list.
+
+    Mirrors the reference's resolution order: .bmp glob first, then .png
+    (processing.py:49-54), extended with the other common formats.
+    """
+    if isinstance(source, (list, tuple)):
+        return list(source)
+    if not os.path.isdir(source):
+        raise FileNotFoundError(f"scan folder not found: {source}")
+    for ext in _EXTS:
+        files = sorted(glob.glob(os.path.join(source, f"*{ext}")))
+        if files:
+            return files
+    raise FileNotFoundError(f"no frames ({'/'.join(_EXTS)}) in {source}")
+
+
+def load_stack(source, expected: int | None = None):
+    """Load a capture folder/list -> (frames uint8 [F,H,W], texture uint8 [H,W,3]).
+
+    The texture is the white frame (frame 0) in color, per the reference's use
+    of files[0] as the point-cloud color source (processing.py:124).
+    """
+    files = list_frame_files(source)
+    if expected is not None and len(files) < expected:
+        raise ValueError(f"{source}: expected >= {expected} frames, found {len(files)}")
+    if len(files) < 4:
+        raise ValueError(f"{source}: need at least 4 frames, found {len(files)}")
+    first = load_gray(files[0])
+    frames = np.empty((len(files),) + first.shape, np.uint8)
+    frames[0] = first
+    for i, p in enumerate(files[1:], start=1):
+        img = load_gray(p)
+        if img.shape != first.shape:
+            raise ValueError(f"{p}: frame size {img.shape} != {first.shape}")
+        frames[i] = img
+    texture = load_color(files[0])
+    return frames, texture
+
+
+def save_stack(folder: str, frames: np.ndarray, ext: str = "png") -> list[str]:
+    """Write frames as the reference's numbered-file contract (01.png, 02.png, ...)."""
+    os.makedirs(folder, exist_ok=True)
+    paths = []
+    for i, frame in enumerate(frames):
+        p = os.path.join(folder, f"{i + 1:02d}.{ext}")
+        _imwrite(p, np.asarray(frame, np.uint8))
+        paths.append(p)
+    return paths

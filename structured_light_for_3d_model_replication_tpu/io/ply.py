@@ -1,0 +1,197 @@
+"""PLY point-cloud/mesh IO — binary-first, fully vectorized.
+
+Replaces the reference's ASCII writer (server/processing.py:237-248: a Python
+f-string loop over ~10^6 points, a measured bottleneck independent of the
+compute backend) with numpy-structured-array binary encode/decode. An ASCII
+mode is kept for interop with the reference's artifacts (including its %.4f
+formatting and header layout); the reader handles both formats.
+
+Color convention: this framework is RGB end-to-end. The reference stores BGR
+in memory (cv2) and swaps at write time (processing.py:245-248); our acquire
+layer swaps BGR->RGB at image-load time instead, so IO never reorders.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["write_ply", "read_ply", "write_mesh_ply"]
+
+_PLY_DTYPES = {
+    "float": "<f4", "float32": "<f4", "double": "<f8", "float64": "<f8",
+    "uchar": "u1", "uint8": "u1", "char": "i1", "int8": "i1",
+    "ushort": "<u2", "uint16": "<u2", "short": "<i2", "int16": "<i2",
+    "uint": "<u4", "uint32": "<u4", "int": "<i4", "int32": "<i4",
+}
+
+
+def _vertex_dtype(has_colors: bool, has_normals: bool) -> np.dtype:
+    fields = [("x", "<f4"), ("y", "<f4"), ("z", "<f4")]
+    if has_normals:
+        fields += [("nx", "<f4"), ("ny", "<f4"), ("nz", "<f4")]
+    if has_colors:
+        fields += [("red", "u1"), ("green", "u1"), ("blue", "u1")]
+    return np.dtype(fields)
+
+
+def write_ply(path: str, points: np.ndarray, colors: np.ndarray | None = None,
+              normals: np.ndarray | None = None, binary: bool = True) -> None:
+    """Write a point cloud. points [N,3] float; colors [N,3] uint8 RGB;
+    normals [N,3] float; binary little-endian by default."""
+    points = np.asarray(points, np.float32)
+    n = points.shape[0]
+    has_c = colors is not None
+    has_n = normals is not None
+
+    header = ["ply",
+              "format binary_little_endian 1.0" if binary else "format ascii 1.0",
+              f"element vertex {n}",
+              "property float x", "property float y", "property float z"]
+    if has_n:
+        header += ["property float nx", "property float ny", "property float nz"]
+    if has_c:
+        header += ["property uchar red", "property uchar green", "property uchar blue"]
+    header.append("end_header")
+
+    if binary:
+        rec = np.empty(n, _vertex_dtype(has_c, has_n))
+        rec["x"], rec["y"], rec["z"] = points[:, 0], points[:, 1], points[:, 2]
+        if has_n:
+            nrm = np.asarray(normals, np.float32)
+            rec["nx"], rec["ny"], rec["nz"] = nrm[:, 0], nrm[:, 1], nrm[:, 2]
+        if has_c:
+            col = np.asarray(colors, np.uint8)
+            rec["red"], rec["green"], rec["blue"] = col[:, 0], col[:, 1], col[:, 2]
+        with open(path, "wb") as f:
+            f.write(("\n".join(header) + "\n").encode("ascii"))
+            rec.tofile(f)
+    else:
+        # vectorized ASCII: one np.savetxt-style formatting pass, %.4f floats
+        # (the reference's precision, processing.py:247)
+        cols: list[np.ndarray] = [points.astype(np.float64)]
+        fmt = "%.4f %.4f %.4f"
+        if has_n:
+            cols.append(np.asarray(normals, np.float64))
+            fmt += " %.6f %.6f %.6f"
+        if has_c:
+            cols.append(np.asarray(colors, np.float64))
+            fmt += " %d %d %d"
+        body = np.concatenate(cols, axis=1)
+        lines = [fmt % tuple(row) for row in body]
+        with open(path, "w") as f:
+            f.write("\n".join(header) + "\n")
+            f.write("\n".join(lines))
+            if lines:
+                f.write("\n")
+
+
+def write_mesh_ply(path: str, vertices: np.ndarray, faces: np.ndarray,
+                   colors: np.ndarray | None = None,
+                   normals: np.ndarray | None = None) -> None:
+    """Write a triangle mesh (binary little-endian)."""
+    vertices = np.asarray(vertices, np.float32)
+    faces = np.asarray(faces, np.int32)
+    has_c = colors is not None
+    has_n = normals is not None
+    n, m = vertices.shape[0], faces.shape[0]
+    header = ["ply", "format binary_little_endian 1.0",
+              f"element vertex {n}",
+              "property float x", "property float y", "property float z"]
+    if has_n:
+        header += ["property float nx", "property float ny", "property float nz"]
+    if has_c:
+        header += ["property uchar red", "property uchar green", "property uchar blue"]
+    header += [f"element face {m}", "property list uchar int vertex_indices",
+               "end_header"]
+    rec = np.empty(n, _vertex_dtype(has_c, has_n))
+    rec["x"], rec["y"], rec["z"] = vertices[:, 0], vertices[:, 1], vertices[:, 2]
+    if has_n:
+        nrm = np.asarray(normals, np.float32)
+        rec["nx"], rec["ny"], rec["nz"] = nrm[:, 0], nrm[:, 1], nrm[:, 2]
+    if has_c:
+        col = np.asarray(colors, np.uint8)
+        rec["red"], rec["green"], rec["blue"] = col[:, 0], col[:, 1], col[:, 2]
+    frec = np.empty(m, np.dtype([("k", "u1"), ("a", "<i4"), ("b", "<i4"), ("c", "<i4")]))
+    frec["k"] = 3
+    frec["a"], frec["b"], frec["c"] = faces[:, 0], faces[:, 1], faces[:, 2]
+    with open(path, "wb") as f:
+        f.write(("\n".join(header) + "\n").encode("ascii"))
+        rec.tofile(f)
+        frec.tofile(f)
+
+
+def read_ply(path: str):
+    """Read a PLY file (binary little-endian or ascii).
+
+    Returns dict with 'points' [N,3] f32, optional 'colors' [N,3] u8,
+    'normals' [N,3] f32, 'faces' [M,3] i32.
+    """
+    with open(path, "rb") as f:
+        # header is ascii lines terminated by 'end_header'
+        header_lines = []
+        while True:
+            line = f.readline()
+            if not line:
+                raise ValueError(f"{path}: truncated PLY header")
+            header_lines.append(line.decode("ascii", "replace").strip())
+            if header_lines[-1] == "end_header":
+                break
+        fmt = None
+        elements: list[tuple[str, int, list]] = []  # (name, count, [(prop, type)])
+        for ln in header_lines:
+            parts = ln.split()
+            if not parts:
+                continue
+            if parts[0] == "format":
+                fmt = parts[1]
+            elif parts[0] == "element":
+                elements.append((parts[1], int(parts[2]), []))
+            elif parts[0] == "property" and elements:
+                if parts[1] == "list":
+                    elements[-1][2].append(("list", parts[2], parts[3], parts[4]))
+                else:
+                    elements[-1][2].append((parts[2], parts[1]))
+        if fmt is None:
+            raise ValueError(f"{path}: no format line in PLY header")
+        body = f.read()
+
+    out: dict[str, np.ndarray] = {}
+    offset = 0
+    for name, count, props in elements:
+        is_list = any(p[0] == "list" for p in props)
+        if fmt == "ascii":
+            text = body.decode("ascii", "replace").split("\n")
+            rows = [r.split() for r in text if r.strip()][:count]
+            if is_list:
+                faces = np.array([[int(v) for v in r[1:1 + int(r[0])]] for r in rows],
+                                 np.int32)
+                out["faces"] = faces
+            else:
+                arr = np.array([[float(v) for v in r] for r in rows], np.float64)
+                _unpack_vertex(out, arr, [p[0] for p in props])
+            break  # ascii path: simple single-pass (vertex [+faces]) support
+        if is_list:
+            # uniform triangle lists only (the overwhelmingly common case)
+            ldt = np.dtype([("k", _PLY_DTYPES[props[0][1]]),
+                            ("v", _PLY_DTYPES[props[0][2]], 3)])
+            rec = np.frombuffer(body, ldt, count=count, offset=offset)
+            if count and not (rec["k"] == 3).all():
+                raise ValueError(f"{path}: only triangle faces supported")
+            out["faces"] = rec["v"].astype(np.int32)
+            offset += ldt.itemsize * count
+        else:
+            dt = np.dtype([(p[0], _PLY_DTYPES[p[1]]) for p in props])
+            rec = np.frombuffer(body, dt, count=count, offset=offset)
+            arr = np.stack([rec[p[0]].astype(np.float64) for p in props], axis=1)
+            _unpack_vertex(out, arr, [p[0] for p in props])
+            offset += dt.itemsize * count
+    return out
+
+
+def _unpack_vertex(out: dict, arr: np.ndarray, names: list[str]) -> None:
+    idx = {nm: i for i, nm in enumerate(names)}
+    if all(k in idx for k in ("x", "y", "z")):
+        out["points"] = arr[:, [idx["x"], idx["y"], idx["z"]]].astype(np.float32)
+    if all(k in idx for k in ("red", "green", "blue")):
+        out["colors"] = arr[:, [idx["red"], idx["green"], idx["blue"]]].astype(np.uint8)
+    if all(k in idx for k in ("nx", "ny", "nz")):
+        out["normals"] = arr[:, [idx["nx"], idx["ny"], idx["nz"]]].astype(np.float32)

@@ -1,0 +1,57 @@
+"""Binary STL mesh IO — the print-ready output format.
+
+The reference writes STL through Open3D (server/processing.py:739,859); here it
+is a direct vectorized binary codec (80-byte header, uint32 count, 50-byte
+records), with normals computed from the winding when not supplied.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["write_stl", "read_stl"]
+
+
+def face_normals(vertices: np.ndarray, faces: np.ndarray) -> np.ndarray:
+    v = np.asarray(vertices, np.float64)
+    f = np.asarray(faces, np.int64)
+    a, b, c = v[f[:, 0]], v[f[:, 1]], v[f[:, 2]]
+    n = np.cross(b - a, c - a)
+    norm = np.linalg.norm(n, axis=1, keepdims=True)
+    return (n / np.where(norm > 0, norm, 1)).astype(np.float32)
+
+
+def write_stl(path: str, vertices: np.ndarray, faces: np.ndarray,
+              normals: np.ndarray | None = None) -> None:
+    """Write a binary STL. vertices [N,3] float, faces [M,3] int."""
+    vertices = np.asarray(vertices, np.float32)
+    faces = np.asarray(faces, np.int64)
+    m = faces.shape[0]
+    if normals is None:
+        normals = face_normals(vertices, faces)
+    rec = np.zeros(m, np.dtype([
+        ("normal", "<f4", 3), ("v0", "<f4", 3), ("v1", "<f4", 3), ("v2", "<f4", 3),
+        ("attr", "<u2"),
+    ]))
+    rec["normal"] = np.asarray(normals, np.float32)
+    rec["v0"] = vertices[faces[:, 0]]
+    rec["v1"] = vertices[faces[:, 1]]
+    rec["v2"] = vertices[faces[:, 2]]
+    with open(path, "wb") as f:
+        f.write(b"structured_light_for_3d_model_replication_tpu".ljust(80, b"\0"))
+        f.write(np.uint32(m).tobytes())
+        rec.tofile(f)
+
+
+def read_stl(path: str):
+    """Read a binary STL. Returns (vertices [3M,3] f32, faces [M,3] i32,
+    normals [M,3] f32). Vertices are NOT deduplicated."""
+    with open(path, "rb") as f:
+        f.seek(80)
+        m = int(np.frombuffer(f.read(4), "<u4")[0])
+        rec = np.frombuffer(f.read(m * 50), np.dtype([
+            ("normal", "<f4", 3), ("v0", "<f4", 3), ("v1", "<f4", 3), ("v2", "<f4", 3),
+            ("attr", "<u2"),
+        ]), count=m)
+    verts = np.stack([rec["v0"], rec["v1"], rec["v2"]], axis=1).reshape(-1, 3)
+    faces = np.arange(3 * m, dtype=np.int32).reshape(-1, 3)
+    return verts.copy(), faces, rec["normal"].copy()

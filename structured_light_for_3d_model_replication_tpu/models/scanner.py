@@ -1,0 +1,129 @@
+"""SLScanner — the flagship forward model: capture stack -> colored point cloud.
+
+This is the TPU-resident composition of the two hot kernels (Gray decode,
+server/processing.py:28-124; ray-plane triangulation, processing.py:127-234)
+into ONE jitted forward pass. Calibration tensors (per-pixel ray field, light
+plane equations) are uploaded once at construction and live in HBM; per call
+only the [F, H, W] uint8 frame stack moves, and everything from bit compare to
+3D point fuses into a single XLA program. `forward_views` vmaps the same
+program over a batch of turntable views — the per-view loop the reference runs
+folder-by-folder (processing.py:314-334) becomes one device launch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.ops import graycode
+from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+    CloudResult,
+    pixel_rays,
+)
+
+__all__ = ["SLScanner"]
+
+
+class SLScanner:
+    """Decode + triangulate with device-resident calibration.
+
+    Parameters
+    ----------
+    calib : dict — reference-layout calibration (Nc/Oc/wPlaneCol/wPlaneRow/cam_K)
+    cam_size : (width, height) of the camera frames
+    proj_size : (width, height) of the projector
+    row_mode, epipolar_tol, n_sets_col, n_sets_row, downsample: see ops modules.
+    """
+
+    def __init__(self, calib: dict, cam_size: tuple[int, int],
+                 proj_size: tuple[int, int] = (1920, 1080),
+                 row_mode: int = 1, epipolar_tol: float = 2.0,
+                 n_sets_col: int = 11, n_sets_row: int = 11,
+                 downsample: int = 1):
+        cw, ch = cam_size
+        self.cam_size = cam_size
+        self.proj_size = proj_size
+        self.row_mode = int(row_mode)
+        self.epipolar_tol = float(epipolar_tol)
+        self._decode_kw = dict(
+            n_cols=proj_size[0], n_rows=proj_size[1],
+            n_sets_col=n_sets_col, n_sets_row=n_sets_row, downsample=downsample,
+        )
+
+        pc = np.asarray(calib["wPlaneCol"], np.float32)
+        pr = np.asarray(calib["wPlaneRow"], np.float32)
+        if pc.shape[0] == 4:
+            pc = pc.T
+        if pr.shape[0] == 4:
+            pr = pr.T
+        nc = calib.get("Nc")
+        if nc is not None:
+            nc = np.asarray(nc, np.float32)
+            if nc.shape[0] == 3:
+                nc = nc.T
+            if nc.shape[0] != cw * ch:
+                nc = None
+        if nc is None:
+            nc = pixel_rays(np.asarray(calib["cam_K"], np.float32), ch, cw, np)
+        # device-resident calibration (uploaded once)
+        self.rays = jnp.asarray(nc)
+        self.oc = jnp.asarray(np.asarray(calib["Oc"], np.float32).reshape(3))
+        self.plane_col = jnp.asarray(pc)
+        self.plane_row = jnp.asarray(pr)
+
+        # closures capture the device-resident calibration tensors as constants
+        self._fwd = jax.jit(
+            lambda frames, s, c: SLScanner._forward_impl(self, frames, s, c)
+        )
+        self._fwd_views = jax.jit(
+            lambda fv, sv, cv: SLScanner._forward_views_impl(self, fv, sv, cv)
+        )
+
+    @staticmethod
+    def _forward_impl(scanner, frames, shadow, contrast):
+        from structured_light_for_3d_model_replication_tpu.ops.graycode import _decode_impl
+        from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+            _triangulate_impl,
+        )
+
+        texture = jnp.repeat(frames[0][..., None], 3, axis=-1).astype(jnp.uint8)
+        dec = _decode_impl(frames, texture, shadow, contrast,
+                           n_sets_col=scanner._decode_kw["n_sets_col"],
+                           n_sets_row=scanner._decode_kw["n_sets_row"],
+                           n_cols=scanner._decode_kw["n_cols"],
+                           n_rows=scanner._decode_kw["n_rows"],
+                           downsample=scanner._decode_kw["downsample"], xp=jnp)
+        return _triangulate_impl(
+            dec.col_map, dec.row_map, dec.mask, dec.texture,
+            scanner.rays, scanner.oc, scanner.plane_col, scanner.plane_row,
+            row_mode=scanner.row_mode, epipolar_tol=scanner.epipolar_tol, xp=jnp,
+        )
+
+    @staticmethod
+    def _forward_views_impl(scanner, frames_v, shadow_v, contrast_v):
+        return jax.vmap(
+            lambda f, s, c: SLScanner._forward_impl(scanner, f, s, c)
+        )(frames_v, shadow_v, contrast_v)
+
+    def forward(self, frames, thresh_mode: str = "otsu",
+                shadow_val: float = 40.0, contrast_val: float = 10.0) -> CloudResult:
+        """One view: frames uint8 [F, H, W] -> CloudResult (fixed shape [H*W])."""
+        frames = jnp.asarray(frames)
+        s, c = graycode.resolve_thresholds(frames, thresh_mode, shadow_val,
+                                           contrast_val, jnp)
+        return self._fwd(frames, jnp.float32(s), jnp.float32(c))
+
+    def forward_views(self, frames_v, thresh_mode: str = "otsu",
+                      shadow_val: float = 40.0, contrast_val: float = 10.0
+                      ) -> CloudResult:
+        """Batched views: uint8 [V, F, H, W] -> CloudResult with leading V axis."""
+        frames_v = jnp.asarray(frames_v)
+        v = frames_v.shape[0]
+        ss, cs = [], []
+        for i in range(v):  # per-view thresholds (tiny host math on device hists)
+            s, c = graycode.resolve_thresholds(frames_v[i], thresh_mode,
+                                               shadow_val, contrast_val, jnp)
+            ss.append(s)
+            cs.append(c)
+        return self._fwd_views(frames_v, jnp.asarray(ss, jnp.float32),
+                               jnp.asarray(cs, jnp.float32))

@@ -1,0 +1,56 @@
+"""Device-mesh construction and axis conventions.
+
+The reference is single-node (SURVEY.md section 2 checklist: no DP/TP/SP and no
+collective backend); parallel scale-out is new capability in this framework.
+Axis conventions used everywhere:
+
+  - ``"data"``  — data parallelism over turntable *views* (the reference's
+    per-folder batch loop, processing.py:314-334, becomes this axis)
+  - ``"model"`` — spatial parallelism over *pixel rows* within a view (decode,
+    triangulation) and over *point blocks* (cloud ops); the long-sequence axis
+    analog, so ring/all-to-all style exchanges live on it
+
+Cross-chip communication is XLA collectives (psum / all_gather / ppermute)
+over ICI; nothing here assumes a particular topology beyond a 2D mesh.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["AXIS_DATA", "AXIS_MODEL", "make_mesh", "view_sharding", "P"]
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+
+
+def make_mesh(n_data: int | None = None, n_model: int | None = None,
+              devices=None) -> Mesh:
+    """Build a (data, model) mesh.
+
+    Defaults: use every available device, favoring the data (views) axis —
+    views are embarrassingly parallel, so they absorb chips first; pass
+    ``n_model > 1`` to also split pixel rows / point blocks within a view.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n_data is None and n_model is None:
+        n_data, n_model = n, 1
+    elif n_data is None:
+        if n % n_model:
+            raise ValueError(f"{n} devices not divisible by n_model={n_model}")
+        n_data = n // n_model
+    elif n_model is None:
+        if n % n_data:
+            raise ValueError(f"{n} devices not divisible by n_data={n_data}")
+        n_model = n // n_data
+    if n_data * n_model > n:
+        raise ValueError(f"mesh {n_data}x{n_model} needs more than {n} devices")
+    grid = np.asarray(devices[: n_data * n_model]).reshape(n_data, n_model)
+    return Mesh(grid, (AXIS_DATA, AXIS_MODEL))
+
+
+def view_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a [V, F, H, W] view-batch: views over data, rows over model."""
+    return NamedSharding(mesh, P(AXIS_DATA, None, AXIS_MODEL, None))

@@ -1,0 +1,87 @@
+"""Sharded multi-view scan pipeline: shard_map over (views, pixel rows).
+
+The full 360-degree reconstruction step distributed over a device mesh:
+each chip decodes + triangulates its (view-shard, row-shard) block, then mesh
+collectives (psum) reduce the global cloud statistics every chip needs for the
+downstream merge (counts, centroid, bounding box). This is the step
+``__graft_entry__.dryrun_multichip`` compiles over an N-virtual-device mesh.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+
+from structured_light_for_3d_model_replication_tpu.ops.graycode import _decode_impl
+from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+    CloudResult,
+    _triangulate_impl,
+)
+from structured_light_for_3d_model_replication_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    P,
+)
+
+__all__ = ["build_sharded_scan_step"]
+
+
+def build_sharded_scan_step(mesh, *, proj_size, n_sets_col: int = 11,
+                            n_sets_row: int = 11, row_mode: int = 1,
+                            epipolar_tol: float = 2.0, downsample: int = 1):
+    """Returns a jitted step: (frames_v, rays_hw, oc, plane_col, plane_row,
+    shadow_v, contrast_v) -> (CloudResult [V, Npix(,x2)], stats dict).
+
+    Sharding: frames [V, F, H, W] split (views -> data, rows -> model); the ray
+    field [H, W, 3] splits with the rows; plane tables and Oc are replicated
+    (they are KB-scale). Stats are psum-reduced over the whole mesh so every
+    chip holds the global values.
+    """
+    pw, ph = proj_size
+
+    def _local(frames_v, rays_hw, oc, plane_col, plane_row, shadow_v, contrast_v):
+        def one_view(frames, shadow, contrast):
+            texture = jnp.repeat(frames[0][..., None], 3, axis=-1).astype(jnp.uint8)
+            dec = _decode_impl(frames, texture, shadow, contrast,
+                               n_cols=pw, n_rows=ph, n_sets_col=n_sets_col,
+                               n_sets_row=n_sets_row, downsample=downsample, xp=jnp)
+            return _triangulate_impl(
+                dec.col_map, dec.row_map, dec.mask, dec.texture,
+                rays_hw.reshape(-1, 3), oc, plane_col, plane_row,
+                row_mode=row_mode, epipolar_tol=epipolar_tol, xp=jnp,
+            )
+
+        cloud = jax.vmap(one_view)(frames_v, shadow_v, contrast_v)
+        # global cloud statistics for the merge stage — collectives over both axes
+        valid_f = cloud.valid.astype(jnp.float32)
+        n_valid = jax.lax.psum(valid_f.sum(), (AXIS_DATA, AXIS_MODEL))
+        centroid = jax.lax.psum(
+            (cloud.points * valid_f[..., None]).sum((0, 1)), (AXIS_DATA, AXIS_MODEL)
+        ) / jnp.maximum(n_valid, 1.0)
+        big = jnp.float32(1e30)
+        masked = jnp.where(cloud.valid[..., None], cloud.points, big)
+        bb_min = jax.lax.pmin(masked.min((0, 1)), (AXIS_DATA, AXIS_MODEL))
+        masked = jnp.where(cloud.valid[..., None], cloud.points, -big)
+        bb_max = jax.lax.pmax(masked.max((0, 1)), (AXIS_DATA, AXIS_MODEL))
+        stats = {"n_valid": n_valid, "centroid": centroid,
+                 "bb_min": bb_min, "bb_max": bb_max}
+        return cloud, stats
+
+    spec_frames = P(AXIS_DATA, None, AXIS_MODEL, None)
+    spec_rays = P(AXIS_MODEL, None, None)
+    spec_perview = P(AXIS_DATA)
+    spec_cloud = CloudResult(
+        points=P(AXIS_DATA, AXIS_MODEL, None),
+        colors=P(AXIS_DATA, AXIS_MODEL, None),
+        valid=P(AXIS_DATA, AXIS_MODEL),
+    )
+    spec_stats = {"n_valid": P(), "centroid": P(), "bb_min": P(), "bb_max": P()}
+
+    step = shard_map(
+        _local, mesh=mesh,
+        in_specs=(spec_frames, spec_rays, P(), P(), P(), spec_perview, spec_perview),
+        out_specs=(spec_cloud, spec_stats),
+    )
+    return jax.jit(step)

@@ -1,0 +1,119 @@
+"""IO layer: PLY/STL round-trips, .mat calib compat, image stacks."""
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.io import images, matfile, ply, stl
+
+
+@pytest.fixture
+def cloud(rng):
+    n = 1000
+    pts = rng.normal(0, 100, (n, 3)).astype(np.float32)
+    cols = rng.integers(0, 256, (n, 3)).astype(np.uint8)
+    nrm = rng.normal(0, 1, (n, 3)).astype(np.float32)
+    nrm /= np.linalg.norm(nrm, axis=1, keepdims=True)
+    return pts, cols, nrm
+
+
+def test_ply_binary_roundtrip(tmp_path, cloud):
+    pts, cols, nrm = cloud
+    p = str(tmp_path / "c.ply")
+    ply.write_ply(p, pts, cols, nrm)
+    out = ply.read_ply(p)
+    np.testing.assert_array_equal(out["points"], pts)
+    np.testing.assert_array_equal(out["colors"], cols)
+    np.testing.assert_array_equal(out["normals"], nrm)
+
+
+def test_ply_ascii_roundtrip(tmp_path, cloud):
+    pts, cols, _ = cloud
+    p = str(tmp_path / "c.ply")
+    ply.write_ply(p, pts, cols, binary=False)
+    out = ply.read_ply(p)
+    np.testing.assert_allclose(out["points"], pts, atol=1e-4 + 1e-7)
+    np.testing.assert_array_equal(out["colors"], cols)
+
+
+def test_ply_reads_reference_style_ascii(tmp_path):
+    # the reference's exact header layout + %.4f rows (processing.py:237-248)
+    p = tmp_path / "ref.ply"
+    p.write_text(
+        "ply\nformat ascii 1.0\nelement vertex 2\n"
+        "property float x\nproperty float y\nproperty float z\n"
+        "property uchar red\nproperty uchar green\nproperty uchar blue\nend_header\n"
+        "1.5000 -2.2500 300.0000 255 128 0\n"
+        "0.0000 0.1000 0.2000 1 2 3\n"
+    )
+    out = ply.read_ply(str(p))
+    np.testing.assert_allclose(out["points"], [[1.5, -2.25, 300.0], [0, 0.1, 0.2]],
+                               atol=1e-6)
+    np.testing.assert_array_equal(out["colors"], [[255, 128, 0], [1, 2, 3]])
+
+
+def test_mesh_ply_roundtrip(tmp_path):
+    verts = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, 1]], np.float32)
+    faces = np.array([[0, 1, 2], [0, 2, 3]], np.int32)
+    p = str(tmp_path / "m.ply")
+    ply.write_mesh_ply(p, verts, faces)
+    out = ply.read_ply(p)
+    np.testing.assert_array_equal(out["points"], verts)
+    np.testing.assert_array_equal(out["faces"], faces)
+
+
+def test_stl_roundtrip(tmp_path):
+    verts = np.array([[0, 0, 0], [10, 0, 0], [0, 10, 0], [0, 0, 10]], np.float32)
+    faces = np.array([[0, 1, 2], [0, 2, 3]], np.int32)
+    p = str(tmp_path / "m.stl")
+    stl.write_stl(p, verts, faces)
+    v2, f2, n2 = stl.read_stl(p)
+    assert f2.shape == (2, 3)
+    np.testing.assert_array_equal(v2[f2].reshape(-1, 3), verts[faces].reshape(-1, 3))
+    # winding-derived normals are unit length
+    np.testing.assert_allclose(np.linalg.norm(n2, axis=1), 1.0, atol=1e-6)
+
+
+def test_calibration_mat_roundtrip(tmp_path):
+    from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+    calib = syn.default_rig().calibration()
+    p = str(tmp_path / "calib.mat")
+    matfile.save_calibration(p, calib)
+    out = matfile.load_calibration(p)
+    np.testing.assert_allclose(out["wPlaneCol"], calib["wPlaneCol"])
+    np.testing.assert_allclose(out["Nc"], calib["Nc"])
+    assert out["wPlaneCol"].shape[0] == 4  # reference's transposed layout
+
+    p2 = str(tmp_path / "calib.npz")
+    matfile.save_calibration(p2, calib)
+    out2 = matfile.load_calibration(p2)
+    np.testing.assert_allclose(out2["wPlaneRow"], calib["wPlaneRow"])
+
+
+def test_calibration_mat_rejects_noncalib(tmp_path):
+    import scipy.io
+    p = str(tmp_path / "x.mat")
+    scipy.io.savemat(p, {"foo": np.eye(2)})
+    with pytest.raises(ValueError, match="not a calibration"):
+        matfile.load_calibration(p)
+
+
+def test_image_stack_roundtrip(tmp_path):
+    from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
+    frames = gc.generate_pattern_stack(64, 32, brightness=200)
+    folder = str(tmp_path / "scan")
+    paths = images.save_stack(folder, frames)
+    assert [p.endswith(f"{i+1:02d}.png") for i, p in enumerate(paths)]
+    loaded, texture = images.load_stack(folder)
+    np.testing.assert_array_equal(loaded, frames)
+    assert texture.shape == (32, 64, 3)
+
+
+def test_image_stack_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        images.load_stack(str(tmp_path / "missing"))
+    folder = tmp_path / "empty"
+    folder.mkdir()
+    with pytest.raises(FileNotFoundError, match="no frames"):
+        images.load_stack(str(folder))
+    images.save_stack(str(folder), np.zeros((2, 8, 8), np.uint8))
+    with pytest.raises(ValueError, match="at least 4"):
+        images.load_stack(str(folder))
