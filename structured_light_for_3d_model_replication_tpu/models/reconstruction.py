@@ -1,0 +1,154 @@
+"""360-degree multi-view merge: the reference's flagship post-processing flow.
+
+Capability parity (behavior studied from server/processing.py:489-629
+merge_pro_360): clouds sorted by turntable angle chain-align view i onto the
+accumulated frame of view i-1 — per pair: voxel downsample + normals + FPFH,
+RANSAC global init (fitness warning below 0.05), point-to-plane ICP refine,
+accumulate T, transform the full-resolution cloud and concatenate; then final
+voxel downsample, optional uniform sampling, statistical outlier removal and
+normal re-estimation.
+
+Every per-pair step runs on-device through ops/{pointcloud,normals,
+registration}; the view chain itself is a host loop (inherently sequential).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.config import MergeConfig
+from structured_light_for_3d_model_replication_tpu.ops import (
+    normals as nrmlib,
+    pointcloud as pc,
+    registration as reg,
+)
+
+__all__ = ["merge_360", "preprocess_for_registration", "chamfer_distance"]
+
+
+@dataclass
+class _Prep:
+    points: jnp.ndarray
+    valid: jnp.ndarray
+    normals: jnp.ndarray
+    features: jnp.ndarray
+
+
+def preprocess_for_registration(points, colors, valid, voxel_size: float) -> _Prep:
+    """Voxel downsample -> normals (r=2*voxel) -> FPFH (r=5*voxel): the
+    reference's preprocess_point_cloud (processing.py:455-466)."""
+    cols = colors if colors is not None else np.zeros_like(points, dtype=np.uint8)
+    p, c, v = pc.voxel_downsample(jnp.asarray(points), jnp.asarray(cols),
+                                  jnp.asarray(valid), voxel_size)
+    nr = nrmlib.estimate_normals(p, v, k=30)
+    feat = reg.fpfh_features(p, nr, v, radius=5.0 * voxel_size, k=48)
+    return _Prep(p, v, nr, feat)
+
+
+def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
+              step_callback=None):
+    """Merge ordered per-view clouds into one 360-degree cloud.
+
+    clouds: list of (points [N,3] f32, colors [N,3] u8) in turntable order.
+    Returns (points, colors, transforms) — transforms[i] maps view i into the
+    frame of view 0 (T_accum chain, processing.py:585-593).
+    """
+    cfg = cfg or MergeConfig()
+    voxel = float(cfg.voxel_size)
+    merged_p = [np.asarray(clouds[0][0], np.float32)]
+    merged_c = [np.asarray(clouds[0][1], np.uint8)]
+    transforms = [np.eye(4, dtype=np.float32)]
+
+    def maybe_sample(p, c, every):
+        if every and every > 1:
+            return p[::every], c[::every]
+        return p, c
+
+    prev_p, prev_c = clouds[0]
+    prev_p, prev_c = maybe_sample(np.asarray(prev_p), np.asarray(prev_c),
+                                  cfg.sample_before)
+    prev = preprocess_for_registration(prev_p, prev_c,
+                                       np.ones(len(prev_p), bool), voxel)
+    t_accum = np.eye(4, dtype=np.float32)
+
+    for i in range(1, len(clouds)):
+        cur_p_full = np.asarray(clouds[i][0], np.float32)
+        cur_c_full = np.asarray(clouds[i][1], np.uint8)
+        cur_p, cur_c = maybe_sample(cur_p_full, cur_c_full, cfg.sample_before)
+        cur = preprocess_for_registration(cur_p, cur_c,
+                                          np.ones(len(cur_p), bool), voxel)
+
+        glob = reg.ransac_global_registration(
+            cur.points, cur.features, cur.valid,
+            prev.points, prev.features, prev.valid,
+            max_dist=voxel * 1.5, trials=cfg.ransac_trials,
+        )
+        if float(glob.fitness) < 0.05:
+            log(f"[merge_360] WARNING view {i}: global fitness "
+                f"{float(glob.fitness):.3f} < 0.05 — alignment may fail "
+                f"(processing.py:566-569 semantics)")
+
+        icp = reg.icp_point_to_plane(
+            cur.points, cur.valid, prev.points, prev.valid, prev.normals,
+            init_transform=glob.transform,
+            max_dist=voxel * float(cfg.icp_dist_ratio), iters=cfg.icp_iters,
+        )
+        log(f"[merge_360] view {i}: global fit {float(glob.fitness):.3f} | "
+            f"ICP fit {float(icp.fitness):.3f} rmse {float(icp.rmse):.3f}")
+
+        t_local = np.asarray(icp.transform, np.float32)
+        t_accum = (t_accum @ t_local).astype(np.float32)
+        transforms.append(t_accum.copy())
+        moved = cur_p_full @ t_accum[:3, :3].T + t_accum[:3, 3]
+        merged_p.append(moved.astype(np.float32))
+        merged_c.append(cur_c_full)
+        if step_callback is not None:
+            step_callback(i, np.concatenate(merged_p), np.concatenate(merged_c))
+        prev = cur
+
+    points = np.concatenate(merged_p)
+    colors = np.concatenate(merged_c)
+
+    # ---- post-processing chain (processing.py:605-629) ----
+    n = len(points)
+    valid = np.ones(n, bool)
+    if cfg.final_voxel and cfg.final_voxel > 0:
+        p, c, v = pc.voxel_downsample(jnp.asarray(points), jnp.asarray(colors),
+                                      jnp.asarray(valid), float(cfg.final_voxel))
+        keep = np.asarray(v)
+        points = np.asarray(p)[keep]
+        colors = np.asarray(c)[keep]
+        valid = np.ones(len(points), bool)
+    if cfg.sample_after and cfg.sample_after > 1:
+        points = points[:: cfg.sample_after]
+        colors = colors[:: cfg.sample_after]
+        valid = valid[:: cfg.sample_after]
+    if cfg.outlier_nb > 0:
+        m = np.asarray(pc.statistical_outlier_mask(
+            jnp.asarray(points), jnp.asarray(valid),
+            cfg.outlier_nb, cfg.outlier_std))
+        points, colors = points[m], colors[m]
+    return points, colors, transforms
+
+
+def chamfer_distance(a, b) -> float:
+    """Symmetric mean nearest-neighbor distance between clouds [Na,3], [Nb,3].
+    The accuracy metric BASELINE.json tracks (Chamfer vs CPU path)."""
+    from structured_light_for_3d_model_replication_tpu.ops import grid as gridlib
+
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+
+    def one_way(x, y):
+        ext = np.asarray(jnp.max(y, 0) - jnp.min(y, 0), np.float64)
+        vol = float(np.prod(np.maximum(ext, 1e-6)))
+        cell = 2.0 * (vol / max(y.shape[0], 1)) ** (1 / 3)
+        g = gridlib.build_grid(y, jnp.ones(y.shape[0], bool), cell)
+        _, d2 = gridlib.grid_query_knn(g, x, 1, rings=3)
+        d = jnp.sqrt(d2[:, 0])
+        d = jnp.where(jnp.isfinite(d), d, 0.0)  # out-of-range: grid miss
+        return float(d.mean())
+
+    return 0.5 * (one_way(a, b) + one_way(b, a))

@@ -11,6 +11,8 @@ folder-by-folder (processing.py:314-334) becomes one device launch.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -71,39 +73,16 @@ class SLScanner:
         self.plane_col = jnp.asarray(pc)
         self.plane_row = jnp.asarray(pr)
 
-        # closures capture the device-resident calibration tensors as constants
-        self._fwd = jax.jit(
-            lambda frames, s, c: SLScanner._forward_impl(self, frames, s, c)
-        )
-        self._fwd_views = jax.jit(
-            lambda fv, sv, cv: SLScanner._forward_views_impl(self, fv, sv, cv)
-        )
+        # static compile key for the module-level jitted kernels; calibration
+        # tensors are passed as ARGUMENTS (closure capture would bake them into
+        # the executable as constants — megabytes of HLO payload)
+        self._static = (proj_size[0], proj_size[1], n_sets_col, n_sets_row,
+                        downsample, self.row_mode)
 
-    @staticmethod
-    def _forward_impl(scanner, frames, shadow, contrast):
-        from structured_light_for_3d_model_replication_tpu.ops.graycode import _decode_impl
-        from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
-            _triangulate_impl,
-        )
-
-        texture = jnp.repeat(frames[0][..., None], 3, axis=-1).astype(jnp.uint8)
-        dec = _decode_impl(frames, texture, shadow, contrast,
-                           n_sets_col=scanner._decode_kw["n_sets_col"],
-                           n_sets_row=scanner._decode_kw["n_sets_row"],
-                           n_cols=scanner._decode_kw["n_cols"],
-                           n_rows=scanner._decode_kw["n_rows"],
-                           downsample=scanner._decode_kw["downsample"], xp=jnp)
-        return _triangulate_impl(
-            dec.col_map, dec.row_map, dec.mask, dec.texture,
-            scanner.rays, scanner.oc, scanner.plane_col, scanner.plane_row,
-            row_mode=scanner.row_mode, epipolar_tol=scanner.epipolar_tol, xp=jnp,
-        )
-
-    @staticmethod
-    def _forward_views_impl(scanner, frames_v, shadow_v, contrast_v):
-        return jax.vmap(
-            lambda f, s, c: SLScanner._forward_impl(scanner, f, s, c)
-        )(frames_v, shadow_v, contrast_v)
+    def _fwd(self, frames, shadow, contrast):
+        return _scan_forward(frames, shadow, contrast, self.rays, self.oc,
+                             self.plane_col, self.plane_row,
+                             jnp.float32(self.epipolar_tol), cfg=self._static)
 
     def forward(self, frames, thresh_mode: str = "otsu",
                 shadow_val: float = 40.0, contrast_val: float = 10.0) -> CloudResult:
@@ -125,5 +104,43 @@ class SLScanner:
                                                shadow_val, contrast_val, jnp)
             ss.append(s)
             cs.append(c)
-        return self._fwd_views(frames_v, jnp.asarray(ss, jnp.float32),
-                               jnp.asarray(cs, jnp.float32))
+        return _scan_forward_views(frames_v, jnp.asarray(ss, jnp.float32),
+                                   jnp.asarray(cs, jnp.float32), self.rays,
+                                   self.oc, self.plane_col, self.plane_row,
+                                   jnp.float32(self.epipolar_tol),
+                                   cfg=self._static)
+
+
+def _forward_math(frames, shadow, contrast, rays, oc, plane_col, plane_row,
+                  epipolar_tol, cfg):
+    from structured_light_for_3d_model_replication_tpu.ops.graycode import _decode_impl
+    from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+        _triangulate_impl,
+    )
+
+    n_cols, n_rows, n_sets_col, n_sets_row, downsample, row_mode = cfg
+    texture = jnp.repeat(frames[0][..., None], 3, axis=-1).astype(jnp.uint8)
+    dec = _decode_impl(frames, texture, shadow, contrast,
+                       n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col,
+                       n_sets_row=n_sets_row, downsample=downsample, xp=jnp)
+    return _triangulate_impl(
+        dec.col_map, dec.row_map, dec.mask, dec.texture,
+        rays, oc, plane_col, plane_row,
+        row_mode=row_mode, epipolar_tol=epipolar_tol, xp=jnp,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _scan_forward(frames, shadow, contrast, rays, oc, plane_col, plane_row,
+                  epipolar_tol, *, cfg):
+    return _forward_math(frames, shadow, contrast, rays, oc, plane_col,
+                         plane_row, epipolar_tol, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _scan_forward_views(frames_v, shadow_v, contrast_v, rays, oc, plane_col,
+                        plane_row, epipolar_tol, *, cfg):
+    return jax.vmap(
+        lambda f, s, c: _forward_math(f, s, c, rays, oc, plane_col, plane_row,
+                                      epipolar_tol, cfg)
+    )(frames_v, shadow_v, contrast_v)

@@ -1,0 +1,287 @@
+"""Rigid registration: FPFH features, batched-RANSAC global alignment,
+point-to-plane ICP — the Open3D registration stack (server/processing.py:
+451-486 preprocess + global RANSAC, :572-582 ICP refine) rebuilt for TPU.
+
+TPU-first design notes
+----------------------
+  - Correspondence search is the grid engine (ops/grid.py) or, for features,
+    a dense [Ns, Nd] similarity matmul on the MXU — no KD-trees.
+  - Open3D's sequential 100k-iteration RANSAC (processing.py:484) becomes
+    *batched hypothesis scoring*: thousands of 3-point Kabsch solves and their
+    inlier counts evaluated in one shot; same statistical power, three orders
+    of magnitude fewer serial steps.
+  - ICP runs a fixed iteration count with masked correspondences (fixed
+    shapes; no early-exit data-dependence), solving the 6x6 point-to-plane
+    normal equations per step.
+
+All transforms are 4x4 float32 row-major, acting on column vectors.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.ops import grid as gridlib
+
+__all__ = ["RegistrationResult", "icp_point_to_plane", "fpfh_features",
+           "ransac_global_registration", "transform_points", "compose",
+           "kabsch"]
+
+
+class RegistrationResult(NamedTuple):
+    transform: jax.Array  # [4,4]
+    fitness: jax.Array    # inlier fraction of valid source points
+    rmse: jax.Array       # inlier RMSE
+
+
+def transform_points(T, pts):
+    return pts @ T[:3, :3].T + T[:3, 3]
+
+
+def compose(a, b):
+    """Transform equivalent to applying b, then a."""
+    return a @ b
+
+
+def _skew(v):
+    z = jnp.zeros_like(v[..., 0])
+    return jnp.stack([
+        jnp.stack([z, -v[..., 2], v[..., 1]], -1),
+        jnp.stack([v[..., 2], z, -v[..., 0]], -1),
+        jnp.stack([-v[..., 1], v[..., 0], z], -1),
+    ], -2)
+
+
+def _exp_so3(w):
+    """Rodrigues: [..,3] axis-angle -> [..,3,3] rotation."""
+    theta = jnp.sqrt((w * w).sum(-1, keepdims=True) + 1e-24)[..., None]
+    k = _skew(w / theta[..., 0])
+    eye = jnp.eye(3, dtype=w.dtype)
+    return eye + jnp.sin(theta) * k + (1 - jnp.cos(theta)) * (k @ k)
+
+
+def kabsch(p, q, w=None):
+    """Least-squares rigid transform aligning p -> q. p, q: [.., M, 3];
+    optional weights [.., M]. Returns [.., 4, 4]."""
+    if w is None:
+        w = jnp.ones(p.shape[:-1], p.dtype)
+    ws = jnp.maximum(w.sum(-1, keepdims=True), 1e-12)
+    cp = (p * w[..., None]).sum(-2) / ws
+    cq = (q * w[..., None]).sum(-2) / ws
+    pc = (p - cp[..., None, :]) * w[..., None]
+    qc = q - cq[..., None, :]
+    h = jnp.einsum("...mi,...mj->...ij", pc, qc)
+    u, s, vt = jnp.linalg.svd(h)
+    det = jnp.linalg.det(jnp.einsum("...ij,...jk->...ik",
+                                    jnp.swapaxes(vt, -1, -2),
+                                    jnp.swapaxes(u, -1, -2)))
+    d = jnp.stack([jnp.ones_like(det), jnp.ones_like(det), det], -1)
+    r = jnp.einsum("...ji,...j,...jk->...ik", vt, d, jnp.swapaxes(u, -1, -2))
+    t = cq - jnp.einsum("...ij,...j->...i", r, cp)
+    bot = jnp.broadcast_to(jnp.asarray([0, 0, 0, 1], p.dtype),
+                           r.shape[:-2] + (1, 4))
+    top = jnp.concatenate([r, t[..., :, None]], -1)
+    return jnp.concatenate([top, bot], -2)
+
+
+# ---------------------------------------------------------------------------
+# Point-to-plane ICP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("iters", "rings"))
+def _icp_jit(src, src_valid, grid: gridlib.HashGrid, dst_normals, T0,
+             max_dist, iters: int, rings: int):
+    n = src.shape[0]
+    nv = jnp.maximum(src_valid.sum().astype(jnp.float32), 1.0)
+
+    def step(T, _):
+        cur = transform_points(T, src)
+        idx, d2 = gridlib._query_knn_jit(grid, cur, 1, rings, 4096)
+        j = idx[:, 0]
+        d2 = d2[:, 0]
+        q = grid.points[j]
+        nrm = dst_normals[j]
+        ok = src_valid & (d2 <= max_dist * max_dist) & jnp.isfinite(d2)
+        w = ok.astype(jnp.float32)
+        r = ((cur - q) * nrm).sum(-1)                     # signed p2plane residual
+        jac = jnp.concatenate([jnp.cross(cur, nrm), nrm], -1)  # [N, 6]
+        a = jnp.einsum("ni,nj->ij", jac * w[:, None], jac)
+        b = -(jac * (w * r)[:, None]).sum(0)
+        x = jnp.linalg.solve(a + 1e-6 * jnp.eye(6), b)
+        dT = jnp.eye(4, dtype=T.dtype)
+        dT = dT.at[:3, :3].set(_exp_so3(x[:3]))
+        dT = dT.at[:3, 3].set(x[3:])
+        T_new = dT @ T
+        rmse = jnp.sqrt((w * r * r).sum() / jnp.maximum(w.sum(), 1.0))
+        fitness = w.sum() / nv
+        return T_new, (fitness, rmse)
+
+    T, (fit, rmse) = jax.lax.scan(step, T0.astype(jnp.float32), None,
+                                  length=iters)
+    return T, fit[-1], rmse[-1]
+
+
+def icp_point_to_plane(src_pts, src_valid, dst_pts, dst_valid, dst_normals,
+                       init_transform=None, max_dist: float = 4.5,
+                       iters: int = 30) -> RegistrationResult:
+    """Point-to-plane ICP of src onto dst (Open3D TransformationEstimation-
+    PointToPlane semantics, processing.py:572-582). Fixed ``iters`` Gauss-
+    Newton steps with grid-accelerated nearest neighbors."""
+    dst = jnp.asarray(dst_pts, jnp.float32)
+    dvalid = jnp.asarray(dst_valid) if dst_valid is not None else \
+        jnp.ones(dst.shape[0], bool)
+    # cell >= max_dist would guarantee exactness but can explode occupancy;
+    # 2 rings at cell=max_dist/2 gives the same guarantee at bounded memory
+    grid = gridlib.build_grid(dst, dvalid, float(max_dist) / 2 + 1e-6)
+    rings = int(np.ceil(float(max_dist) / float(np.asarray(grid.cell)))) + 1
+    rings = min(rings, 5)
+    T0 = jnp.eye(4, dtype=jnp.float32) if init_transform is None \
+        else jnp.asarray(init_transform, jnp.float32)
+    T, fit, rmse = _icp_jit(jnp.asarray(src_pts, jnp.float32),
+                            jnp.asarray(src_valid) if src_valid is not None
+                            else jnp.ones(src_pts.shape[0], bool),
+                            grid, jnp.asarray(dst_normals, jnp.float32), T0,
+                            jnp.float32(max_dist), iters, rings)
+    return RegistrationResult(T, fit, rmse)
+
+
+# ---------------------------------------------------------------------------
+# FPFH features (A16's compute_fpfh_feature)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _fpfh_jit(points, normals, valid, idx, d2, radius, k: int):
+    """FPFH from a fixed-k neighborhood (the grid/brute kNN of the caller).
+
+    SPFH: for each neighbor pair, the Darboux-frame angles (alpha, phi, theta)
+    binned into 3x11 histograms; FPFH_i = SPFH_i + mean_j w_j SPFH_j with
+    w_j = 1/d_ij — Rusu's formulation, fixed shapes.
+    """
+    n = points.shape[0]
+    nb_ok = (d2 <= radius * radius) & valid[idx] & valid[:, None] & (d2 > 0)
+    p = points[:, None, :]
+    q = points[idx]
+    nrm_p = normals[:, None, :]
+    nrm_q = normals[idx]
+    d = q - p
+    dist = jnp.sqrt(jnp.maximum(d2, 1e-20))[..., None]
+    u = nrm_p
+    dn = d / dist
+    # ensure source normal points "toward" consistent frame (Rusu's ordering
+    # simplification: swap so angle between u and d is acute)
+    v = jnp.cross(dn, u)
+    v_n = v / jnp.maximum(jnp.linalg.norm(v, axis=-1, keepdims=True), 1e-12)
+    w = jnp.cross(u, v_n)
+    alpha = (v_n * nrm_q).sum(-1)                       # in [-1,1]
+    phi = (u * dn).sum(-1)                              # in [-1,1]
+    theta = jnp.arctan2((w * nrm_q).sum(-1), (u * nrm_q).sum(-1))  # [-pi,pi]
+
+    def hist11(x, lo, hi):
+        b = jnp.clip(((x - lo) / (hi - lo) * 11).astype(jnp.int32), 0, 10)
+        oh = jax.nn.one_hot(b, 11, dtype=jnp.float32)
+        return (oh * nb_ok[..., None]).sum(1)           # [N, 11]
+
+    spfh = jnp.concatenate([
+        hist11(alpha, -1.0, 1.0),
+        hist11(phi, -1.0, 1.0),
+        hist11(theta, -jnp.pi, jnp.pi),
+    ], axis=-1)                                          # [N, 33]
+    cnt = jnp.maximum(nb_ok.sum(-1, keepdims=True).astype(jnp.float32), 1.0)
+    spfh = spfh / cnt                                    # normalize per point
+
+    wgt = jnp.where(nb_ok, 1.0 / jnp.sqrt(jnp.maximum(d2, 1e-12)), 0.0)
+    wsum = jnp.maximum(wgt.sum(-1, keepdims=True), 1e-12)
+    neigh_spfh = (spfh[idx] * wgt[..., None]).sum(1) / wsum
+    fpfh = spfh + neigh_spfh
+    return jnp.where(valid[:, None], fpfh, 0.0)
+
+
+def fpfh_features(points, normals, valid, radius: float, k: int = 64):
+    """FPFH [N, 33] over a radius-bounded k-neighborhood."""
+    from structured_light_for_3d_model_replication_tpu.ops import knn as knnlib
+
+    idx, d2 = knnlib.knn(points, valid, k)
+    return _fpfh_jit(jnp.asarray(points, jnp.float32),
+                     jnp.asarray(normals, jnp.float32),
+                     jnp.asarray(valid), idx, d2, jnp.float32(radius), k)
+
+
+# ---------------------------------------------------------------------------
+# Global registration: feature matching + batched RANSAC (A17)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("trials",))
+def _ransac_jit(src, dst, corr_j, corr_ok, max_dist, edge_sim, trials: int,
+                key):
+    ns = src.shape[0]
+    probs = corr_ok.astype(jnp.float32)
+    probs = probs / jnp.maximum(probs.sum(), 1.0)
+    samp = jax.random.choice(key, ns, shape=(trials, 3), p=probs)
+    p = src[samp]                    # [T,3,3]
+    q = dst[corr_j[samp]]            # [T,3,3]
+
+    # Open3D's correspondence checkers: edge-length similarity prune
+    def edges(x):
+        return jnp.stack([
+            jnp.linalg.norm(x[:, 0] - x[:, 1], axis=-1),
+            jnp.linalg.norm(x[:, 1] - x[:, 2], axis=-1),
+            jnp.linalg.norm(x[:, 0] - x[:, 2], axis=-1)], -1)
+
+    ep, eq = edges(p), edges(q)
+    ratio = jnp.minimum(ep, eq) / jnp.maximum(jnp.maximum(ep, eq), 1e-9)
+    edge_pass = (ratio > edge_sim).all(-1)
+
+    T = kabsch(p, q)                 # [T,4,4]
+    moved = jnp.einsum("tij,nj->tni", T[:, :3, :3], src) + T[:, None, :3, 3]
+    d2 = ((moved - dst[corr_j][None, :, :]) ** 2).sum(-1)
+    inl = (d2 <= max_dist * max_dist) & corr_ok[None, :]
+    scores = jnp.where(edge_pass, inl.sum(-1), -1)
+    best = jnp.argmax(scores)
+    # refine on the best hypothesis' inliers with a weighted Kabsch
+    w = inl[best].astype(jnp.float32)
+    T_ref = kabsch(src, dst[corr_j], w)
+    moved = transform_points(T_ref, src)
+    d2r = ((moved - dst[corr_j]) ** 2).sum(-1)
+    inl_r = (d2r <= max_dist * max_dist) & corr_ok
+    nv = jnp.maximum(corr_ok.sum().astype(jnp.float32), 1.0)
+    fitness = inl_r.sum() / nv
+    rmse = jnp.sqrt((jnp.where(inl_r, d2r, 0)).sum()
+                    / jnp.maximum(inl_r.sum(), 1))
+    return T_ref, fitness, rmse
+
+
+def ransac_global_registration(src_pts, src_feat, src_valid,
+                               dst_pts, dst_feat, dst_valid,
+                               max_dist: float, trials: int = 4096,
+                               edge_sim: float = 0.9,
+                               seed: int = 0) -> RegistrationResult:
+    """Feature-matched RANSAC alignment (processing.py:471-486 semantics:
+    FPFH nearest-neighbor correspondences, edge-length 0.9 + distance checks).
+
+    Correspondences come from a dense [Ns, Nd] feature-distance matmul (MXU);
+    ``trials`` batched hypotheses replace Open3D's 100k sequential iterations.
+    """
+    src = jnp.asarray(src_pts, jnp.float32)
+    dst = jnp.asarray(dst_pts, jnp.float32)
+    sf = jnp.asarray(src_feat, jnp.float32)
+    df = jnp.asarray(dst_feat, jnp.float32)
+    sv = jnp.asarray(src_valid) if src_valid is not None else \
+        jnp.ones(src.shape[0], bool)
+    dv = jnp.asarray(dst_valid) if dst_valid is not None else \
+        jnp.ones(dst.shape[0], bool)
+    # nearest feature: ||a-b||^2 = |a|^2 + |b|^2 - 2ab
+    cross = sf @ df.T
+    d2f = (sf * sf).sum(-1, keepdims=True) + (df * df).sum(-1)[None, :] \
+        - 2.0 * cross
+    d2f = jnp.where(dv[None, :], d2f, jnp.inf)
+    corr_j = jnp.argmin(d2f, axis=1)
+    corr_ok = sv
+    key = jax.random.PRNGKey(seed)
+    T, fit, rmse = _ransac_jit(src, dst, corr_j, corr_ok,
+                               jnp.float32(max_dist), jnp.float32(edge_sim),
+                               trials, key)
+    return RegistrationResult(T, fit, rmse)

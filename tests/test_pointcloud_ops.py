@@ -1,0 +1,164 @@
+"""Point-cloud ops vs scipy/exact references on random and structured clouds."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.ops import (
+    knn as knnlib,
+    normals as nrmlib,
+    pointcloud as pc,
+)
+
+BLK = 512  # pad multiple covering knn block sizes in tests
+
+
+@pytest.fixture(scope="module")
+def cloud():
+    rng = np.random.default_rng(42)
+    n = 2000
+    pts = np.concatenate([
+        rng.normal(0, 20, (n // 2, 3)),
+        rng.normal((80, 0, 0), 12, (n // 2, 3)),
+    ]).astype(np.float32)
+    pts_p, valid_p, _ = knnlib.pad_points(pts, None, 4096)
+    return pts, pts_p.astype(np.float32), valid_p
+
+
+def test_knn_matches_ckdtree(cloud):
+    pts, pts_p, valid_p = cloud
+    idx_j, d2_j = knnlib.knn(jnp.asarray(pts_p), jnp.asarray(valid_p), 8,
+                             block_q=512, block_b=2048)
+    idx_n, d2_n = knnlib.knn_np(pts_p, valid_p, 8)
+    n = pts.shape[0]
+    # expansion-form d2 carries ~|p|^2*eps cancellation error; indices can
+    # additionally differ on near-ties
+    np.testing.assert_allclose(np.sqrt(np.asarray(d2_j)[:n]),
+                               np.sqrt(d2_n[:n]), rtol=1e-3, atol=5e-3)
+    agree = (np.asarray(idx_j)[:n] == idx_n[:n]).mean()
+    assert agree > 0.995
+
+
+def test_radius_count_matches(cloud):
+    pts, pts_p, valid_p = cloud
+    n = pts.shape[0]
+    r = 10.0
+    c_j = np.asarray(knnlib.radius_count(jnp.asarray(pts_p), jnp.asarray(valid_p),
+                                         r, block_q=512, block_b=2048))[:n]
+    c_n = knnlib.radius_count_np(pts_p, valid_p, r)[:n]
+    # boundary-epsilon ties can differ by a hair
+    assert (np.abs(c_j - c_n) <= 1).all()
+    assert (c_j == c_n).mean() > 0.99
+
+
+def test_statistical_outlier(cloud):
+    pts, pts_p, valid_p = cloud
+    n = pts.shape[0]
+    # inject obvious outliers
+    pts_o = pts_p.copy()
+    out_idx = [10, 500, 900]
+    pts_o[out_idx] = [[500, 500, 500], [-400, 300, 0], [0, -600, 200]]
+    m_j = np.asarray(pc.statistical_outlier_mask(
+        jnp.asarray(pts_o), jnp.asarray(valid_p), 20, 2.0))
+    m_n = pc.statistical_outlier_mask_np(pts_o, valid_p, 20, 2.0)
+    assert not m_j[out_idx].any() and not m_n[out_idx].any()
+    assert (m_j[:n] == m_n[:n]).mean() > 0.99
+    assert m_j[:n].mean() > 0.8  # bulk survives
+
+
+def test_radius_outlier(cloud):
+    pts, pts_p, valid_p = cloud
+    n = pts.shape[0]
+    pts_o = pts_p.copy()
+    pts_o[77] = [999.0, -999.0, 999.0]
+    m_j = np.asarray(pc.radius_outlier_mask(
+        jnp.asarray(pts_o), jnp.asarray(valid_p), radius=15.0, nb_points=10))
+    m_n = pc.radius_outlier_mask_np(pts_o, valid_p, radius=15.0, nb_points=10)
+    assert not m_j[77] and not m_n[77]
+    assert (m_j[:n] == m_n[:n]).mean() > 0.99
+
+
+def test_segment_plane_finds_dominant_plane(rng):
+    n_plane, n_obj = 3000, 800
+    plane_pts = np.stack([
+        rng.uniform(-100, 100, n_plane), rng.uniform(-100, 100, n_plane),
+        rng.normal(0, 0.3, n_plane)], axis=1).astype(np.float32)
+    obj = rng.normal((0, 0, 40), 10, (n_obj, 3)).astype(np.float32)
+    pts = np.concatenate([plane_pts, obj])
+    pts_p, valid_p, n = knnlib.pad_points(pts, None, 4096)
+    plane, inl = pc.segment_plane(jnp.asarray(pts_p), jnp.asarray(valid_p),
+                                  distance_threshold=1.0, num_iterations=256)
+    inl = np.asarray(inl)
+    assert inl[:n_plane].mean() > 0.95      # the wall is found
+    assert inl[n_plane:n].mean() < 0.15     # the object survives removal
+    nrm = np.asarray(plane[:3])
+    assert abs(nrm[2]) > 0.99               # normal is +-z
+    # numpy twin agrees
+    plane_n, inl_n = pc.segment_plane_np(pts_p, valid_p, 1.0, 256)
+    assert inl_n[:n_plane].mean() > 0.95 and inl_n[n_plane:n].mean() < 0.15
+
+
+def test_largest_cluster(rng):
+    a = rng.normal((0, 0, 0), 3, (1200, 3)).astype(np.float32)
+    b = rng.normal((60, 0, 0), 3, (300, 3)).astype(np.float32)
+    noise = rng.uniform(-200, 200, (30, 3)).astype(np.float32)
+    pts = np.concatenate([a, b, noise])
+    pts_p, valid_p, n = knnlib.pad_points(pts, None, 2048)
+    m_j = np.asarray(pc.largest_cluster_mask(
+        jnp.asarray(pts_p), jnp.asarray(valid_p), eps=5.0, min_points=10, k=16))
+    m_n = pc.largest_cluster_mask_np(pts_p, valid_p, eps=5.0, min_points=10)
+    assert m_j[:1200].mean() > 0.95 and m_n[:1200].mean() > 0.95
+    assert m_j[1200:1500].mean() < 0.05 and m_n[1200:1500].mean() < 0.05
+    assert not m_j[1500:n].any() and not m_n[1500:n].any()
+
+
+def test_voxel_downsample(rng):
+    pts = rng.uniform(0, 10, (5000, 3)).astype(np.float32)
+    cols = rng.integers(0, 255, (5000, 3)).astype(np.uint8)
+    pts_p, valid_p, n = knnlib.pad_points(pts, None, 8192)
+    cols_p = np.zeros((pts_p.shape[0], 3), np.uint8)
+    cols_p[:n] = cols
+    p_j, c_j, v_j = pc.voxel_downsample(jnp.asarray(pts_p), jnp.asarray(cols_p),
+                                        jnp.asarray(valid_p), 1.0)
+    p_n, c_n, _ = pc.voxel_downsample_np(pts_p[:n], cols_p[:n], None, 1.0)
+    v_j = np.asarray(v_j)
+    assert v_j.sum() == p_n.shape[0]  # same number of occupied voxels
+    # same voxel centroids as sets (order differs)
+    sj = sorted(map(tuple, np.round(np.asarray(p_j)[v_j], 3)))
+    sn = sorted(map(tuple, np.round(p_n, 3)))
+    np.testing.assert_allclose(np.array(sj), np.array(sn), atol=2e-3)
+
+
+def test_normals_on_analytic_surfaces(rng):
+    # plane z=0: normal must be +-z
+    pts = np.stack([rng.uniform(-10, 10, 600), rng.uniform(-10, 10, 600),
+                    np.zeros(600)], axis=1).astype(np.float32)
+    pts_p, valid_p, n = knnlib.pad_points(pts, None, 1024)
+    nr = np.asarray(nrmlib.estimate_normals(jnp.asarray(pts_p),
+                                            jnp.asarray(valid_p), k=12))[:n]
+    assert (np.abs(nr[:, 2]) > 0.999).mean() > 0.99
+    # sphere: radial after orientation
+    dirs = rng.normal(size=(800, 3))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    sph = (50 * dirs).astype(np.float32)
+    sph_p, valid_s, ns = knnlib.pad_points(sph, None, 1024)
+    nr_s = nrmlib.estimate_normals(jnp.asarray(sph_p), jnp.asarray(valid_s), k=10)
+    oriented = np.asarray(nrmlib.orient_normals(
+        jnp.asarray(sph_p), nr_s, jnp.asarray(valid_s), mode="radial"))[:ns]
+    dots = (oriented * dirs).sum(1)
+    assert (dots > 0.95).mean() > 0.97
+    # flip=True inverts (A19's Poisson-inward convention)
+    flipped = np.asarray(nrmlib.orient_normals(
+        jnp.asarray(sph_p), nr_s, jnp.asarray(valid_s), mode="radial",
+        flip=True))[:ns]
+    assert ((flipped * dirs).sum(1) < -0.95).mean() > 0.97
+
+
+def test_smallest_eigvec_matches_eigh(rng):
+    m = rng.normal(size=(50, 3, 3))
+    cov = np.einsum("nij,nkj->nik", m, m).astype(np.float32)
+    v_j = np.asarray(nrmlib.smallest_eigvec_sym3(jnp.asarray(cov)))
+    for i in range(50):
+        w, v = np.linalg.eigh(cov[i])
+        dot = abs(float(v_j[i] @ v[:, 0]))
+        assert dot > 0.999, (i, dot)
