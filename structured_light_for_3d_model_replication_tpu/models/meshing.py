@@ -1,0 +1,96 @@
+"""Point cloud -> printable mesh: the reference's STL reconstruction flows.
+
+Capability parity (behavior studied from server/processing.py):
+  - reconstruct_stl (A19, :632-787): normals + centroid/outward orientation
+    (+ optional flip), watertight Poisson with density trim, optional
+    smoothing/simplification post stage, STL output
+  - mesh_360 (A20, :791-860): tunable normal estimation, radial vs tangent
+    orientation, screened Poisson with full parameter surface, density
+    quantile trim
+
+The compute path is ops/poisson.py (grid Poisson, jit) + ops/surface_nets.py
+(iso-surface extraction) + ops/meshproc.py (post ops).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.config import MeshConfig
+from structured_light_for_3d_model_replication_tpu.ops import (
+    meshproc,
+    normals as nrmlib,
+    poisson,
+    surface_nets,
+)
+from structured_light_for_3d_model_replication_tpu.ops.poisson import (
+    trilinear_sample,
+)
+
+__all__ = ["reconstruct_mesh", "mesh_to_stl"]
+
+
+def reconstruct_mesh(points, valid=None, normals=None,
+                     cfg: MeshConfig | None = None, log=print):
+    """Full cloud -> mesh flow. Returns (vertices [V,3], faces [F,3]).
+
+    Orientation convention: normals are oriented OUTWARD (radial/centroid
+    modes, processing.py:657-670, 809-830); Poisson chi is then < iso inside,
+    and extracted faces wind outward (positive signed volume).
+    """
+    cfg = cfg or MeshConfig()
+    pts = jnp.asarray(points, jnp.float32)
+    v = jnp.asarray(valid) if valid is not None else jnp.ones(pts.shape[0], bool)
+
+    if normals is None:
+        nr = nrmlib.estimate_normals(pts, v, k=cfg.normal_max_nn)
+        nr = nrmlib.orient_normals(pts, nr, v, mode="radial")
+        log(f"[mesh] normals estimated (k={cfg.normal_max_nn}, radial orient)")
+    else:
+        nr = jnp.asarray(normals, jnp.float32)
+
+    res = poisson.poisson_solve(pts, nr, v, depth=cfg.depth)
+    log(f"[mesh] poisson depth={cfg.depth} iso={float(res.iso):.4f}")
+    verts, faces = surface_nets.extract_surface(res.chi, float(res.iso),
+                                                origin=np.asarray(res.origin),
+                                                cell=float(res.cell))
+    log(f"[mesh] surface nets: {len(verts):,} verts, {len(faces):,} faces")
+
+    if cfg.density_trim_quantile and cfg.density_trim_quantile > 0:
+        # low-support crop (processing.py:707-709): sample the splat density
+        # at mesh vertices, drop the lowest quantile
+        coords = (jnp.asarray(verts) - res.origin) / res.cell
+        dens = np.asarray(trilinear_sample(res.density, coords))
+        thresh = np.quantile(dens, cfg.density_trim_quantile)
+        verts, faces = meshproc.filter_faces_by_vertex_mask(
+            verts, faces, dens >= thresh)
+        log(f"[mesh] density trim q={cfg.density_trim_quantile}: "
+            f"{len(verts):,} verts remain")
+
+    if cfg.smooth_iters > 0:
+        if cfg.smooth_method == "taubin":
+            verts = meshproc.taubin_smooth(verts, faces, cfg.smooth_iters)
+        else:
+            verts = meshproc.laplacian_smooth(verts, faces, cfg.smooth_iters)
+        log(f"[mesh] {cfg.smooth_method} smoothing x{cfg.smooth_iters}")
+
+    if cfg.simplify_target_faces and len(faces) > cfg.simplify_target_faces:
+        # derive a clustering cell from the target face budget
+        bbox = verts.max(0) - verts.min(0)
+        area = 2 * (bbox[0] * bbox[1] + bbox[1] * bbox[2] + bbox[0] * bbox[2])
+        cell = float(np.sqrt(area / max(cfg.simplify_target_faces, 1)))
+        for _ in range(8):
+            nv, nf = meshproc.vertex_cluster_decimate(verts, faces, cell)
+            if len(nf) <= cfg.simplify_target_faces or len(nf) == 0:
+                break
+            cell *= 1.3
+        verts, faces = nv, nf
+        log(f"[mesh] decimated to {len(faces):,} faces")
+
+    return verts, faces
+
+
+def mesh_to_stl(path: str, vertices, faces) -> None:
+    from structured_light_for_3d_model_replication_tpu.io import stl
+
+    stl.write_stl(path, vertices, faces)

@@ -1,0 +1,159 @@
+"""Surface Nets iso-surface extraction — the mesh extractor for the Poisson grid.
+
+Marching cubes needs 256-entry hand-built lookup tables; Surface Nets
+(Gibson '98 "naive surface nets") achieves a watertight quad/tri mesh with
+pure array ops, which suits XLA: one vertex per sign-change cell (placed at
+the mean of its edge crossings), one quad per sign-change grid edge joining
+the 4 cells that share it. Device side computes fixed-shape masks and vertex
+positions; the only data-dependent step (compacting active cells/edges) is a
+host-side np.where at the export boundary, like every other compaction in
+this framework.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["extract_surface"]
+
+
+@jax.jit
+def _cell_vertices(field, iso):
+    """Per-cell Surface-Nets vertex. field [G,G,G] sampled at cell centers.
+
+    Cells are the dual cubes between 8 neighboring samples; cell (i,j,k) spans
+    samples [i:i+2, j:j+2, k:k+2]. Returns (active [g-1]^3 bool,
+    vertex [g-1]^3 x 3 fractional grid coords relative to sample (0,0,0)).
+    """
+    f = field
+    g = f.shape[0]
+    c = {}
+    for dx in (0, 1):
+        for dy in (0, 1):
+            for dz in (0, 1):
+                c[(dx, dy, dz)] = f[dx:g - 1 + dx, dy:g - 1 + dy, dz:g - 1 + dz]
+    d = jnp.float32(iso)
+    inside = {k: v < d for k, v in c.items()}
+
+    # 12 cube edges: (corner a, corner b)
+    corners = list(c.keys())
+    edges = []
+    for a in corners:
+        for b in corners:
+            if a < b and sum(abs(a[i] - b[i]) for i in range(3)) == 1:
+                edges.append((a, b))
+    vsum = jnp.zeros(c[(0, 0, 0)].shape + (3,), jnp.float32)
+    wsum = jnp.zeros(c[(0, 0, 0)].shape, jnp.float32)
+    for a, b in edges:
+        fa, fb = c[a], c[b]
+        cross = inside[a] != inside[b]
+        t = jnp.where(cross, (d - fa) / jnp.where(jnp.abs(fb - fa) > 1e-12,
+                                                  fb - fa, 1.0), 0.0)
+        t = jnp.clip(t, 0.0, 1.0)
+        pa = jnp.asarray(a, jnp.float32)
+        pb = jnp.asarray(b, jnp.float32)
+        pt = pa[None, None, None, :] + t[..., None] * (pb - pa)[None, None, None, :]
+        vsum = vsum + jnp.where(cross[..., None], pt, 0.0)
+        wsum = wsum + cross.astype(jnp.float32)
+    active = wsum > 0
+    vertex = vsum / jnp.maximum(wsum, 1.0)[..., None]
+    return active, vertex
+
+
+@jax.jit
+def _edge_quads(field, iso):
+    """Sign-change masks for grid edges along each axis, and their direction.
+
+    Edge along axis a at sample (i,j,k) connects samples (i,j,k) and +1 on a.
+    A sign change emits a quad between the 4 dual cells sharing that edge.
+    Returns per-axis (cross mask, flip mask) with shape [g-1 on a, g on rest].
+    """
+    f = field
+    d = jnp.float32(iso)
+    inside = f < d
+    out = []
+    for axis in range(3):
+        a0 = jax.lax.slice_in_dim(inside, 0, f.shape[axis] - 1, axis=axis)
+        a1 = jax.lax.slice_in_dim(inside, 1, f.shape[axis], axis=axis)
+        cross = a0 != a1
+        flip = a0  # inside -> outside vs outside -> inside orientation
+        out.append((cross, flip))
+    return out
+
+
+def extract_surface(field, iso, origin=None, cell=1.0):
+    """Extract the iso-surface triangle mesh of a [G,G,G] scalar field.
+
+    Returns (vertices [V,3] f32 world coords, faces [F,3] i32). Watertight on
+    closed iso-surfaces away from the grid boundary.
+    """
+    field = jnp.asarray(field, jnp.float32)
+    g = field.shape[0]
+    active, vertex = _cell_vertices(field, iso)
+    edge_data = _edge_quads(field, iso)
+
+    active_np = np.asarray(active)
+    vertex_np = np.asarray(vertex)
+
+    # host compaction: dense cell-id -> compact vertex id
+    cell_id = np.full(active_np.shape, -1, np.int64)
+    ai, aj, ak = np.nonzero(active_np)
+    cell_id[ai, aj, ak] = np.arange(len(ai))
+    verts = vertex_np[ai, aj, ak] + np.stack([ai, aj, ak], axis=1)
+
+    faces = []
+    gm = g - 1  # cell grid size per axis
+    for axis in range(3):
+        cross, flip = (np.asarray(x) for x in edge_data[axis])
+        # edge at sample (i,j,k) along `axis`; adjacent cells: subtract 1 in
+        # the two OTHER axes. Valid only where all 4 cells exist.
+        o1, o2 = [a for a in range(3) if a != axis]
+        ii, jj, kk = np.nonzero(cross)
+        pos = np.stack([ii, jj, kk], axis=1)
+        ok = (pos[:, o1] >= 1) & (pos[:, o1] <= gm - 0) & \
+             (pos[:, o2] >= 1) & (pos[:, o2] <= gm - 0) & \
+             (pos[:, axis] <= gm - 1)
+        ok &= (pos[:, o1] - 1 >= 0) & (pos[:, o2] - 1 >= 0) & \
+              (pos[:, o1] < gm + 1) & (pos[:, o2] < gm + 1)
+        pos = pos[ok]
+        fl = flip[ii, jj, kk][ok]
+        if len(pos) == 0:
+            continue
+
+        def cid(dp1, dp2):
+            q = pos.copy()
+            q[:, o1] -= dp1
+            q[:, o2] -= dp2
+            inb = ((q >= 0).all(1) & (q[:, 0] < gm) & (q[:, 1] < gm)
+                   & (q[:, 2] < gm))
+            out = np.full(len(q), -1, np.int64)
+            out[inb] = cell_id[q[inb, 0], q[inb, 1], q[inb, 2]]
+            return out
+
+        c00 = cid(1, 1)
+        c10 = cid(0, 1)
+        c11 = cid(0, 0)
+        c01 = cid(1, 0)
+        quad_ok = (c00 >= 0) & (c10 >= 0) & (c11 >= 0) & (c01 >= 0)
+        c00, c10, c11, c01 = (c[quad_ok] for c in (c00, c10, c11, c01))
+        fl = fl[quad_ok]
+        if axis == 1:
+            # permutation (axis, o1, o2) = (1, 0, 2) is odd: the (o1, o2) ring
+            # runs clockwise seen from +axis, unlike axes 0 and 2 — flip
+            fl = ~fl
+        # two triangles per quad; winding by crossing direction
+        t1 = np.where(fl[:, None], np.stack([c00, c10, c11], 1),
+                      np.stack([c00, c11, c10], 1))
+        t2 = np.where(fl[:, None], np.stack([c00, c11, c01], 1),
+                      np.stack([c00, c01, c11], 1))
+        faces.append(t1)
+        faces.append(t2)
+
+    faces_np = (np.concatenate(faces).astype(np.int32) if faces
+                else np.zeros((0, 3), np.int32))
+    verts_world = verts.astype(np.float32)
+    if origin is not None:
+        verts_world = verts_world * np.float32(cell) + np.asarray(origin,
+                                                                  np.float32)
+    return verts_world, faces_np
