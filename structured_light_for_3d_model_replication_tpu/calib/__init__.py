@@ -1,0 +1,24 @@
+"""Projector-camera stereo calibration (reference parity: server/sl_system.py:187-425,
+Old/sl_calib_process.py, Old/read_calib.py, Old/ResultCalibCam.py).
+
+  chessboard   corner detection + board geometry (OpenCV-gated)
+  pipeline     analyze / prune / solve / save end-to-end calibration
+  geometry     ray field + projector light-plane construction (batched)
+  undistort    Brown-Conrady undistortion as fused JAX remap kernels
+  inspect      human-readable geometry summary + quality bands
+"""
+from structured_light_for_3d_model_replication_tpu.calib.geometry import (  # noqa: F401
+    build_calibration,
+    camera_ray_field,
+    projector_planes,
+)
+from structured_light_for_3d_model_replication_tpu.calib.chessboard import (  # noqa: F401
+    BoardSpec,
+    board_object_points,
+    find_corners,
+)
+from structured_light_for_3d_model_replication_tpu.calib.inspect import (  # noqa: F401
+    format_summary,
+    quality_band,
+    summarize_calibration,
+)

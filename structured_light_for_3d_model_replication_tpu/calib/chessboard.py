@@ -1,0 +1,104 @@
+"""Chessboard corner detection for projector-camera calibration.
+
+Capability parity (behavior studied from server/sl_system.py:222-247): the white
+frame of each calibration pose is contrast-enhanced (Gaussian blur + CLAHE), the
+inner-corner grid is located, corners are refined to sub-pixel accuracy, and an
+annotated preview image is produced for the operator.
+
+OpenCV supplies the corner detector itself (a sparse, branchy CPU algorithm with
+no TPU upside); everything around it is ours. The import is lazy and gated so the
+rest of the framework works on machines without cv2.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+__all__ = [
+    "BoardSpec",
+    "board_object_points",
+    "find_corners",
+    "draw_corner_preview",
+]
+
+
+def _require_cv2():
+    try:
+        import cv2
+    except ImportError as e:  # pragma: no cover - environment dependent
+        raise RuntimeError(
+            "chessboard detection requires OpenCV (cv2); install opencv-python "
+            "or use precomputed corner files"
+        ) from e
+    return cv2
+
+
+class BoardSpec(NamedTuple):
+    """Inner-corner grid of the calibration chessboard."""
+
+    rows: int = 7
+    cols: int = 7
+    square_size: float = 35.0  # mm (server/config.py:26-30)
+
+
+def board_object_points(board: BoardSpec) -> np.ndarray:
+    """World coordinates of the inner corners, z=0 plane, row-major [N, 3] float32.
+
+    Grid layout matches the reference's mgrid construction
+    (server/sl_system.py:210-212) so saved calibrations are interchangeable.
+    """
+    obj = np.zeros((board.rows * board.cols, 3), np.float32)
+    obj[:, :2] = np.mgrid[0 : board.rows, 0 : board.cols].T.reshape(-1, 2)
+    return obj * board.square_size
+
+
+def enhance_for_detection(gray: np.ndarray) -> np.ndarray:
+    """Blur + CLAHE contrast pull, the reference's detection preprocessing
+    (server/sl_system.py:230-235)."""
+    cv2 = _require_cv2()
+    blurred = cv2.GaussianBlur(gray, (5, 5), 0)
+    clahe = cv2.createCLAHE(clipLimit=2.0, tileGridSize=(8, 8))
+    return clahe.apply(blurred)
+
+
+def find_corners(image: np.ndarray, board: BoardSpec,
+                 refine: bool = True) -> np.ndarray | None:
+    """Locate the board's inner corners in a white-frame image.
+
+    Returns sub-pixel corner coordinates [N, 2] float32 (N = rows*cols) or None
+    when no complete grid is found. Detection runs on the enhanced image but the
+    sub-pixel refinement runs on the raw grayscale (as the reference does:
+    server/sl_system.py:236-240) — CLAHE shifts local extrema.
+    """
+    cv2 = _require_cv2()
+    if image.ndim == 3:
+        # io.images normalizes to RGB at the IO boundary, so use RGB weights
+        gray = cv2.cvtColor(image, cv2.COLOR_RGB2GRAY)
+    else:
+        gray = image
+    ok, corners = cv2.findChessboardCorners(
+        enhance_for_detection(gray), (board.rows, board.cols), None
+    )
+    if not ok:
+        return None
+    if refine:
+        corners = cv2.cornerSubPix(
+            gray, corners, (11, 11), (-1, -1),
+            (cv2.TERM_CRITERIA_EPS + cv2.TERM_CRITERIA_MAX_ITER, 30, 0.001),
+        )
+    return corners.reshape(-1, 2).astype(np.float32)
+
+
+def draw_corner_preview(image: np.ndarray, corners: np.ndarray,
+                        board: BoardSpec) -> np.ndarray:
+    """Annotated copy of ``image`` with the detected grid drawn on it."""
+    cv2 = _require_cv2()
+    preview = image.copy()
+    if preview.ndim == 2:
+        preview = cv2.cvtColor(preview, cv2.COLOR_GRAY2BGR)
+    cv2.drawChessboardCorners(
+        preview, (board.rows, board.cols),
+        corners.reshape(-1, 1, 2).astype(np.float32), True,
+    )
+    return preview

@@ -14,7 +14,8 @@ import os
 
 import numpy as np
 
-__all__ = ["list_frame_files", "load_stack", "save_stack", "load_gray", "load_color"]
+__all__ = ["list_frame_files", "load_stack", "save_stack", "load_gray",
+           "load_color", "save_image"]
 
 _EXTS = (".bmp", ".png", ".jpg", ".jpeg", ".ppm", ".pgm")
 
@@ -48,6 +49,11 @@ def _imwrite(path: str, img: np.ndarray):
         from PIL import Image
 
         Image.fromarray(img).save(path)
+
+
+def save_image(path: str, img: np.ndarray) -> None:
+    """Write one image; color images are RGB (the IO-boundary convention)."""
+    _imwrite(path, np.asarray(img, np.uint8))
 
 
 def load_gray(path: str) -> np.ndarray:

@@ -1,0 +1,203 @@
+"""Calibration pipeline tests: chessboard detection, corner Gray decode, the
+stereo solve on synthetic geometry, inspectors, and JAX undistortion."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.calib import chessboard as cb
+from structured_light_for_3d_model_replication_tpu.calib import inspect as insp
+from structured_light_for_3d_model_replication_tpu.calib import pipeline as cpipe
+from structured_light_for_3d_model_replication_tpu.calib.geometry import (
+    build_calibration,
+)
+from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
+
+cv2 = pytest.importorskip("cv2")
+
+BOARD = cb.BoardSpec(rows=5, cols=6, square_size=20.0)
+
+
+def render_board_image(width=320, height=240, origin=(60, 50), cell=24):
+    """Fronto-parallel chessboard drawing with inner corners at known pixels."""
+    img = np.full((height, width), 255, np.uint8)
+    ox, oy = origin
+    # (rows+1) x (cols+1) squares so there are rows x cols inner corners
+    for i in range(BOARD.cols + 1):
+        for j in range(BOARD.rows + 1):
+            if (i + j) % 2 == 0:
+                y0, x0 = oy + i * cell, ox + j * cell
+                img[y0 : y0 + cell, x0 : x0 + cell] = 20
+    # a checker intersection at block boundary x0 sits between pixels: x0 - 0.5
+    corners = np.array(
+        [
+            [ox + (j + 1) * cell - 0.5, oy + (i + 1) * cell - 0.5]
+            for i in range(BOARD.cols)
+            for j in range(BOARD.rows)
+        ],
+        np.float32,
+    )
+    return img, corners
+
+
+def test_find_corners_synthetic_board():
+    img, expected = render_board_image()
+    found = cb.find_corners(img, BOARD)
+    assert found is not None and found.shape == (BOARD.rows * BOARD.cols, 2)
+    # detection may enumerate the grid in reverse order; compare as sets
+    d = np.abs(found[None, :, :] - expected[:, None, :]).sum(-1)
+    assert d.min(axis=1).max() < 1.0  # every expected corner matched sub-pixel
+
+
+def test_decode_at_points_recovers_projector_coords():
+    pw, ph = 128, 64
+    stack = gc.generate_pattern_stack(pw, ph, brightness=200)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, pw, 50).astype(np.float64) + rng.uniform(0, 0.45, 50)
+    y = rng.integers(0, ph, 50).astype(np.float64) + rng.uniform(0, 0.45, 50)
+    pts = np.column_stack([x, y])
+    col, row = cpipe.decode_at_points(stack[2:], pts, 7, 6)
+    np.testing.assert_array_equal(col, np.floor(x))
+    np.testing.assert_array_equal(row, np.floor(y))
+
+
+def _synth_rig():
+    cam_K = np.array([[300.0, 0, 160], [0, 300.0, 120], [0, 0, 1]])
+    proj_K = np.array([[400.0, 0, 128], [0, 400.0, 64], [0, 0, 1]])
+    ang = np.radians(12.0)
+    R = np.array(
+        [[np.cos(ang), 0, np.sin(ang)], [0, 1, 0], [-np.sin(ang), 0, np.cos(ang)]]
+    )
+    T = np.array([[-120.0], [5.0], [30.0]])
+    return cam_K, proj_K, R, T
+
+
+def _project(K, R, t, pts):
+    p = pts @ R.T + t.reshape(1, 3)
+    p = p / p[:, 2:3]
+    return (p @ K.T)[:, :2]
+
+
+def make_observations(n_poses=8):
+    """Synthetic matched triples: a board posed in front of both devices."""
+    cam_K, proj_K, R, T = _synth_rig()
+    obj = cb.board_object_points(BOARD).astype(np.float64)
+    rng = np.random.default_rng(7)
+    obs = []
+    for i in range(n_poses):
+        rx, ry, rz = rng.uniform(-0.35, 0.35, 3)
+        Rb, _ = cv2.Rodrigues(np.array([rx, ry, rz]))
+        tb = np.array([rng.uniform(-30, 30), rng.uniform(-25, 25),
+                       rng.uniform(380, 560)])
+        world = obj @ Rb.T + tb  # board points in camera frame
+        cam_px = _project(cam_K, np.eye(3), np.zeros(3), world)
+        proj_px = _project(proj_K, R, T.reshape(3), world)
+        obs.append(
+            cpipe.PoseObservation(
+                f"pose{i:02d}",
+                obj.astype(np.float32),
+                cam_px.astype(np.float32),
+                proj_px.astype(np.float32),
+            )
+        )
+    return obs, cam_K, proj_K, R, T
+
+
+def test_stereo_calibration_recovers_geometry():
+    obs, cam_K, proj_K, R, T = make_observations()
+    sol = cpipe.calibrate_stereo(obs, (320, 240), (256, 128), log=lambda *_: None)
+    assert sol.rms_stereo < 0.5
+    np.testing.assert_allclose(sol.cam_K[0, 0], cam_K[0, 0], rtol=0.02)
+    np.testing.assert_allclose(sol.proj_K[0, 0], proj_K[0, 0], rtol=0.02)
+    np.testing.assert_allclose(
+        np.linalg.norm(sol.T), np.linalg.norm(T), rtol=0.02
+    )
+    np.testing.assert_allclose(sol.R, R, atol=0.01)
+
+
+def test_reprojection_errors_and_pose_selection():
+    obs, *_ = make_observations()
+    # corrupt one pose's projector decode with non-rigid noise (a constant
+    # offset would be absorbed into that pose's extrinsics) to make it prunable
+    bad = obs[3]
+    noise = np.random.default_rng(11).normal(0, 6.0, bad.proj_pts.shape)
+    obs[3] = bad._replace(proj_pts=(bad.proj_pts + noise).astype(np.float32))
+    errors = cpipe.reprojection_errors(obs, (320, 240), (256, 128))
+    assert set(errors) == {o.name for o in obs}
+    keep = cpipe.select_poses(errors, max_cam_err=1.0, max_proj_err=0.5)
+    assert "pose03" not in keep and len(keep) >= 3
+
+
+def test_summarize_and_quality_bands():
+    cam_K, proj_K, R, T = _synth_rig()
+    calib = build_calibration(cam_K, np.zeros(5), proj_K, R, T, 320, 240,
+                              256, 128, include_ray_field=False)
+    s = insp.summarize_calibration(calib, reprojection_error_px=0.3)
+    assert s["quality"] == "EXCELLENT"
+    np.testing.assert_allclose(s["baseline_mm"], np.linalg.norm(T), rtol=1e-6)
+    assert abs(s["euler_deg"]["pitch"] - 12.0) < 1e-3
+    assert insp.quality_band(0.7) == "GOOD" and insp.quality_band(1.5) == "POOR"
+    assert "baseline" in insp.format_summary(s)
+
+
+def test_collect_calibration_data_end_to_end(tmp_path):
+    """Full folder-level path: white/black + pattern frames on disk -> matched
+    observations with decoded projector coordinates."""
+    pw, ph = 128, 64
+    cw, chh = 320, 240
+    base, corners = render_board_image(cw, chh)
+    stack = gc.generate_pattern_stack(pw, ph, brightness=200)
+    # camera sees the projector raster through a fixed affine pixel map
+    xi = (np.arange(cw) * pw) // cw
+    yi = (np.arange(chh) * ph) // chh
+    for pose in ("p0", "p1", "p2"):
+        d = tmp_path / pose
+        d.mkdir()
+        cam_view = stack[:, yi[:, None], xi[None, :]]
+        # white frame must contain the detectable board
+        cv2.imwrite(str(d / "01.png"), base)
+        cv2.imwrite(str(d / "02.png"), np.zeros_like(base))
+        for f in range(2, stack.shape[0]):
+            cv2.imwrite(str(d / f"{f + 1:02d}.png"), cam_view[f])
+    obs, img_shape = cpipe.collect_calibration_data(
+        str(tmp_path), board=BOARD, proj_size=(pw, ph),
+        save_previews=True, log=lambda *_: None,
+    )
+    assert img_shape == (cw, chh)
+    assert len(obs) == 3
+    assert os.path.isdir(tmp_path / "corners_preview")
+    o = obs[0]
+    # decoded projector coords must equal the affine map at the corner pixels
+    exp_col = (o.cam_pts[:, 0].astype(int) * pw) // cw
+    exp_row = (o.cam_pts[:, 1].astype(int) * ph) // chh
+    np.testing.assert_allclose(o.proj_pts[:, 0], exp_col, atol=1.0)
+    np.testing.assert_allclose(o.proj_pts[:, 1], exp_row, atol=1.0)
+
+
+def test_undistort_points_roundtrip():
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.calib import undistort as ud
+
+    dist = np.array([-0.28, 0.12, 1e-3, -5e-4, -0.02], np.float32)
+    rng = np.random.default_rng(3)
+    pts = rng.uniform(-0.6, 0.6, (200, 2)).astype(np.float32)
+    distorted = np.asarray(ud.distort_points(jnp.asarray(pts), dist))
+    back = np.asarray(ud.undistort_points(jnp.asarray(distorted), dist))
+    np.testing.assert_allclose(back, pts, atol=2e-4)
+
+
+def test_undistort_image_identity_and_shift():
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.calib import undistort as ud
+
+    K = np.array([[200.0, 0, 64], [0, 200.0, 48], [0, 0, 1]])
+    img = np.arange(96 * 128, dtype=np.float32).reshape(96, 128) % 251
+    out = np.asarray(ud.undistort_image(jnp.asarray(img), K, np.zeros(5)))
+    np.testing.assert_allclose(out, img, atol=1e-3)
+    stack = np.stack([img, img[::-1]])
+    out_s = np.asarray(ud.undistort_stack(stack, K, np.zeros(5)))
+    np.testing.assert_allclose(out_s[1], img[::-1], atol=1e-3)
