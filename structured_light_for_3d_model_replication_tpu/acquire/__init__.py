@@ -1,0 +1,28 @@
+"""Acquisition layer: everything between the pipeline and the physical rig
+(reference parity: server/server.py, server/sl_system.py capture paths,
+server/arduino.py, server/gui.py auto-scan tab).
+
+  server     HTTP capture rendezvous (phone long-poll + upload), stdlib-only
+  sequencer  Gray-code pattern sequence -> numbered frame files per pose
+  projector  fullscreen pattern display (OpenCV) + virtual backend
+  turntable  serial stepper protocol + simulation/loopback backends
+  android    client for the Android camera-host pull API
+  autoscan   the 360-degree turntable sweep orchestrator
+"""
+from structured_light_for_3d_model_replication_tpu.acquire.autoscan import (  # noqa: F401
+    auto_scan_360,
+    view_folder_name,
+)
+from structured_light_for_3d_model_replication_tpu.acquire.sequencer import (  # noqa: F401
+    CaptureSequencer,
+)
+from structured_light_for_3d_model_replication_tpu.acquire.server import (  # noqa: F401
+    CaptureServer,
+    CaptureTimeout,
+)
+from structured_light_for_3d_model_replication_tpu.acquire.turntable import (  # noqa: F401
+    LoopbackTurntable,
+    SerialTurntable,
+    SimulatedTurntable,
+    open_turntable,
+)

@@ -1,0 +1,91 @@
+"""Client for the Android camera-host HTTP API (pull-model capture).
+
+Capability parity (protocol studied from android_camera_host/CameraHostServer.kt:20-72
+and Old/android_camera_host_client.py:1-105): the phone app runs an HTTP server
+(default port 8765) with ``GET /status``, ``GET /capabilities``,
+``POST /settings`` (manual exposure/ISO/focus/zoom/AWB/stabilization), and
+``POST /capture/jpeg`` which returns the JPEG bytes plus an ``X-Capture-Meta``
+JSON header. Reachable over Wi-Fi or USB via ``adb reverse tcp:8765``.
+
+Stdlib urllib only — no client dependency.
+"""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from dataclasses import asdict, dataclass
+
+__all__ = ["CameraSettings", "AndroidCameraClient"]
+
+
+@dataclass
+class CameraSettings:
+    """Manual camera controls; None fields are left at the phone's defaults."""
+
+    exposure_ns: int | None = None
+    iso: int | None = None
+    focus_diopters: float | None = None
+    awb_mode: str | None = None
+    zoom: float | None = None
+    stabilization: bool | None = None
+    jpeg_quality: int | None = None
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in asdict(self).items() if v is not None}
+
+
+class AndroidCameraClient:
+    def __init__(self, host: str, port: int = 8765, timeout: float = 10.0):
+        self.base = f"http://{host}:{port}"
+        self.timeout = timeout
+
+    def _request(self, path: str, data: bytes | None = None,
+                 headers: dict | None = None):
+        req = urllib.request.Request(
+            self.base + path, data=data, headers=headers or {},
+            method="POST" if data is not None else "GET",
+        )
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def _json(self, path: str, payload: dict | None = None) -> dict:
+        data = None
+        headers = {}
+        if payload is not None:
+            data = json.dumps(payload).encode()
+            headers["Content-Type"] = "application/json"
+        with self._request(path, data, headers) as resp:
+            return json.loads(resp.read().decode() or "{}")
+
+    def status(self) -> dict:
+        return self._json("/status")
+
+    def capabilities(self) -> dict:
+        return self._json("/capabilities")
+
+    def apply_settings(self, settings: CameraSettings) -> dict:
+        return self._json("/settings", settings.to_dict())
+
+    def reachable(self) -> bool:
+        try:
+            self.status()
+            return True
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    def capture_jpeg(self) -> tuple[bytes, dict]:
+        """Trigger a still capture; returns (jpeg_bytes, capture_metadata)."""
+        with self._request("/capture/jpeg", data=b"") as resp:
+            meta_hdr = resp.headers.get("X-Capture-Meta", "{}")
+            try:
+                meta = json.loads(meta_hdr)
+            except json.JSONDecodeError:
+                meta = {"raw": meta_hdr}
+            return resp.read(), meta
+
+    def capture_to_path(self, path: str) -> dict:
+        """Capture one frame to disk — drop-in CaptureFn for the sequencer."""
+        jpeg, meta = self.capture_jpeg()
+        with open(path, "wb") as f:
+            f.write(jpeg)
+        return meta

@@ -1,0 +1,69 @@
+"""Auto-scan 360: the turntable sweep orchestrator.
+
+Capability parity (behavior studied from server/gui.py:1700-1787): N turns of
+(capture full pattern sequence) -> (rotate turntable, wait DONE), writing each
+view to ``{base}_{angle}deg_scan/``. A rotation timeout logs a warning and
+continues (the reference's behavior, gui.py:1774-1776). Progress reporting
+carries elapsed + estimated-remaining wall-clock.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+__all__ = ["AutoScanResult", "auto_scan_360", "view_folder_name"]
+
+
+def view_folder_name(base: str, angle_deg: float) -> str:
+    """The angle-tagged folder contract the merge stage sorts by
+    (``"<n>deg"`` substring, server/processing.py:499-519)."""
+    return f"{base}_{int(round(angle_deg)):03d}deg_scan"
+
+
+@dataclass
+class AutoScanResult:
+    view_dirs: list[str] = field(default_factory=list)
+    angles: list[float] = field(default_factory=list)
+    rotation_warnings: list[int] = field(default_factory=list)
+    elapsed_s: float = 0.0
+
+
+def auto_scan_360(sequencer, turntable, output_root: str,
+                  turns: int = 12, step_deg: float = 30.0,
+                  base_name: str = "scan", rotate_timeout: float = 30.0,
+                  progress: Callable[[dict], None] | None = None,
+                  log=print) -> AutoScanResult:
+    """Run the full turntable sweep; returns per-view folders + angles.
+
+    ``sequencer`` is a CaptureSequencer (or anything with ``capture_scan``);
+    ``turntable`` anything with ``rotate``/``wait_for_done`` (serial, sim, fake).
+    """
+    os.makedirs(output_root, exist_ok=True)
+    result = AutoScanResult()
+    t0 = time.monotonic()
+    for i in range(turns):
+        angle = i * step_deg
+        view_dir = os.path.join(output_root, view_folder_name(base_name, angle))
+        log(f"[autoscan] view {i + 1}/{turns} @ {angle:.0f}deg")
+        sequencer.capture_scan(view_dir)
+        result.view_dirs.append(view_dir)
+        result.angles.append(angle)
+        if progress:
+            elapsed = time.monotonic() - t0
+            per_view = elapsed / (i + 1)
+            progress({
+                "view": i + 1, "turns": turns, "angle": angle,
+                "elapsed_s": elapsed,
+                "remaining_s": per_view * (turns - i - 1),
+            })
+        if i < turns - 1:
+            turntable.rotate(step_deg)
+            if not turntable.wait_for_done(rotate_timeout):
+                # continue with a warning, like the reference (gui.py:1774-1776)
+                log(f"[autoscan] WARNING: rotation {i + 1} timed out; continuing")
+                result.rotation_warnings.append(i + 1)
+    result.elapsed_s = time.monotonic() - t0
+    log(f"[autoscan] {turns} views in {result.elapsed_s:.1f}s")
+    return result

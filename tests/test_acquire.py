@@ -1,0 +1,268 @@
+"""Acquisition-layer tests: live HTTP rendezvous with a fake phone client,
+turntable backends, the capture sequencer, and the auto-scan orchestrator."""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.acquire import (
+    CaptureSequencer,
+    CaptureServer,
+    CaptureTimeout,
+    LoopbackTurntable,
+    SimulatedTurntable,
+    auto_scan_360,
+    view_folder_name,
+)
+from structured_light_for_3d_model_replication_tpu.acquire.projector import (
+    VirtualProjector,
+)
+from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
+
+
+class FakePhone(threading.Thread):
+    """Protocol-faithful phone: long-polls /poll_command, dedups command ids,
+    uploads a deterministic PNG-ish payload per fresh capture command."""
+
+    def __init__(self, base_url: str, payload: bytes = b"fakeimage"):
+        super().__init__(daemon=True)
+        self.base = base_url
+        self.payload = payload
+        self.stop_flag = threading.Event()
+        self.captures = 0
+        self.last_id = None
+
+    def run(self):
+        while not self.stop_flag.is_set():
+            try:
+                with urllib.request.urlopen(self.base + "/poll_command",
+                                            timeout=5) as r:
+                    cmd = json.loads(r.read())
+            except OSError:
+                continue
+            if cmd["action"] == "capture" and cmd["id"] != self.last_id:
+                self.last_id = cmd["id"]
+                body, ctype = self._multipart(self.payload)
+                req = urllib.request.Request(
+                    self.base + "/upload", data=body,
+                    headers={"Content-Type": ctype}, method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    assert json.loads(r.read())["status"] == "ok"
+                self.captures += 1
+
+    @staticmethod
+    def _multipart(payload: bytes):
+        boundary = "testboundary42"
+        body = (
+            f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="file"; filename="f.png"\r\n'
+            "Content-Type: image/png\r\n\r\n"
+        ).encode() + payload + f"\r\n--{boundary}--\r\n".encode()
+        return body, f"multipart/form-data; boundary={boundary}"
+
+
+@pytest.fixture
+def server():
+    srv = CaptureServer(host="127.0.0.1", port=0, poll_hold=0.3)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_capture_rendezvous_over_http(server, tmp_path):
+    phone = FakePhone(f"http://127.0.0.1:{server.port}")
+    phone.start()
+    try:
+        for i in range(3):
+            p = str(tmp_path / f"{i:02d}.png")
+            out = server.trigger_capture(p, timeout=10.0)
+            assert out == p and open(p, "rb").read() == b"fakeimage"
+        deadline = time.monotonic() + 3
+        while phone.captures < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)  # the waiter unblocks before the phone's counter
+        assert phone.captures == 3
+        assert server.state.connected
+    finally:
+        phone.stop_flag.set()
+        phone.join(timeout=3)
+
+
+def test_capture_timeout_without_phone(server, tmp_path):
+    t0 = time.monotonic()
+    with pytest.raises(CaptureTimeout):
+        server.trigger_capture(str(tmp_path / "x.png"), timeout=0.5)
+    assert time.monotonic() - t0 < 5.0
+    # state must be disarmed after the failed rendezvous
+    assert server.state.current_command()["action"] == "idle"
+
+
+def test_status_endpoint_and_raw_upload(server, tmp_path):
+    base = f"http://127.0.0.1:{server.port}"
+    with urllib.request.urlopen(base + "/status", timeout=5) as r:
+        st = json.loads(r.read())
+    assert st["command"]["action"] == "idle"
+
+    # raw-body upload (non-multipart client) also completes the rendezvous
+    path = str(tmp_path / "raw.png")
+    done = threading.Event()
+    result = {}
+
+    def waiter():
+        result["path"] = server.trigger_capture(path, timeout=10.0)
+        done.set()
+
+    threading.Thread(target=waiter, daemon=True).start()
+    deadline = time.monotonic() + 5
+    while server.state.current_command()["action"] != "capture":
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    req = urllib.request.Request(base + "/upload", data=b"rawbytes",
+                                 headers={"Content-Type": "image/png"},
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert json.loads(r.read())["status"] == "ok"
+    assert done.wait(5.0) and open(result["path"], "rb").read() == b"rawbytes"
+
+
+def test_upload_without_armed_capture_conflicts(server):
+    base = f"http://127.0.0.1:{server.port}"
+    req = urllib.request.Request(base + "/upload", data=b"zz",
+                                 headers={"Content-Type": "image/png"},
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=5)
+    assert exc.value.code == 409
+
+
+def test_stale_upload_id_rejected(server, tmp_path):
+    base = f"http://127.0.0.1:{server.port}"
+    path = str(tmp_path / "b.png")
+    done = threading.Event()
+
+    def waiter():
+        try:
+            server.trigger_capture(path, timeout=10.0)
+        finally:
+            done.set()
+
+    threading.Thread(target=waiter, daemon=True).start()
+    deadline = time.monotonic() + 5
+    while server.state.current_command()["action"] != "capture":
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    # an upload echoing a WRONG command id must be rejected (409)...
+    req = urllib.request.Request(base + "/upload?id=deadbeef", data=b"stale",
+                                 headers={"Content-Type": "image/png"},
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=5)
+    assert exc.value.code == 409
+    # ...while echoing the armed id completes the rendezvous
+    armed = server.state.current_command()["id"]
+    req = urllib.request.Request(base + f"/upload?id={armed}", data=b"fresh",
+                                 headers={"Content-Type": "image/png"},
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert json.loads(r.read())["status"] == "ok"
+    assert done.wait(5.0) and open(path, "rb").read() == b"fresh"
+
+
+def test_sequencer_writes_numbered_frames(tmp_path):
+    proj = VirtualProjector(64, 32)
+    patterns = gc.generate_pattern_stack(64, 32, brightness=200)
+
+    def capture(path):
+        # the "camera" photographs whatever the projector currently shows
+        from structured_light_for_3d_model_replication_tpu.io.images import (
+            save_image,
+        )
+        save_image(path, proj.shown[-1])
+
+    seq = CaptureSequencer(proj, capture, proj_size=(64, 32),
+                           log=lambda *_: None)
+    paths = seq.capture_scan(str(tmp_path / "scan"))
+    assert len(paths) == gc.frames_per_view(64, 32)
+    assert [os.path.basename(p) for p in paths[:3]] == [
+        "01.png", "02.png", "03.png"
+    ]
+    from structured_light_for_3d_model_replication_tpu.io.images import load_stack
+
+    frames, _ = load_stack(str(tmp_path / "scan"))
+    np.testing.assert_array_equal(frames, patterns)
+
+
+def test_sequencer_calibration_poses(tmp_path):
+    proj = VirtualProjector(32, 16)
+    seq = CaptureSequencer(proj, lambda p: open(p, "wb").write(b"x"),
+                           proj_size=(32, 16), log=lambda *_: None)
+    seen = []
+    dirs = seq.capture_calibration(str(tmp_path), 3, on_pose=seen.append)
+    assert seen == [0, 1, 2]
+    assert [os.path.basename(d) for d in dirs] == ["pose01", "pose02", "pose03"]
+    n = gc.frames_per_view(32, 16)
+    assert len(os.listdir(dirs[0])) == n
+    # calibration settle time is the longer one
+    assert seq.calib_settle_ms in proj.settle_log
+
+
+def test_turntable_backends():
+    lb = LoopbackTurntable()
+    lb.rotate(30.0)
+    lb.rotate(30.0)
+    assert lb.wait_for_done() and lb.angle == 60.0
+
+    sim = SimulatedTurntable(rotate_time_s=0.05)
+    sim.rotate(90.0)
+    assert sim.wait_for_done(timeout=1.0) and sim.angle == 90.0
+
+    flaky = LoopbackTurntable(fail_after=1)
+    flaky.rotate(30.0)
+    assert flaky.wait_for_done()
+    flaky.rotate(30.0)
+    assert not flaky.wait_for_done()
+
+
+def test_auto_scan_360_loop(tmp_path):
+    proj = VirtualProjector(32, 16)
+    seq = CaptureSequencer(proj, lambda p: open(p, "wb").write(b"x"),
+                           proj_size=(32, 16), log=lambda *_: None)
+    table = LoopbackTurntable()
+    events = []
+    res = auto_scan_360(seq, table, str(tmp_path), turns=4, step_deg=90.0,
+                        progress=events.append, log=lambda *_: None)
+    assert len(res.view_dirs) == 4
+    assert res.angles == [0.0, 90.0, 180.0, 270.0]
+    assert table.commands == [90.0, 90.0, 90.0]  # no rotate after the last view
+    assert os.path.basename(res.view_dirs[1]) == view_folder_name("scan", 90.0)
+    assert all(os.path.isdir(d) for d in res.view_dirs)
+    assert events[-1]["view"] == 4 and events[-1]["remaining_s"] == 0.0
+
+
+def test_auto_scan_rotation_timeout_warns_and_continues(tmp_path):
+    proj = VirtualProjector(32, 16)
+    seq = CaptureSequencer(proj, lambda p: open(p, "wb").write(b"x"),
+                           proj_size=(32, 16), log=lambda *_: None)
+    table = LoopbackTurntable(fail_after=1)
+    res = auto_scan_360(seq, table, str(tmp_path), turns=3, step_deg=120.0,
+                        log=lambda *_: None)
+    assert len(res.view_dirs) == 3 and res.rotation_warnings == [2]
+
+
+def test_capture_page_served_when_configured():
+    srv = CaptureServer(host="127.0.0.1", port=0,
+                        capture_page="<html><body>capture</body></html>")
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/", timeout=5
+        ) as r:
+            assert b"capture" in r.read()
+    finally:
+        srv.stop()
