@@ -35,7 +35,8 @@ class CaptureTimeout(TimeoutError):
 class CaptureState:
     """Locked shared state between the HTTP handlers and the pipeline thread."""
 
-    def __init__(self, disconnect_after: float = 5.0):
+    def __init__(self, disconnect_after: float = 5.0,
+                 fallback_dir: str | None = None):
         self._lock = threading.Lock()
         self.command = "idle"
         self.command_id: str = ""
@@ -44,6 +45,8 @@ class CaptureState:
         self.last_seen = 0.0
         self.connected = False
         self.disconnect_after = disconnect_after
+        self.fallback_dir = fallback_dir
+        self._fallback_seq = 0
         self.on_connect = None   # optional callbacks for the orchestrator/GUI
         self.on_disconnect = None
 
@@ -96,10 +99,23 @@ class CaptureState:
         the armed-command check only. The event is set only if the same
         command is still armed after the file write, so a concurrent re-arm
         can never be released by a stale frame.
+
+        With no capture armed, the frame lands in ``fallback_dir`` (when set)
+        under a timestamped name — the standalone ``serve`` flow, where a
+        phone uploads without a command round-trip.
         """
         with self._lock:
             if self.command != "capture" or self.save_path is None:
-                raise ValueError("no capture armed")
+                if self.fallback_dir is None:
+                    raise ValueError("no capture armed")
+                os.makedirs(self.fallback_dir, exist_ok=True)
+                name = time.strftime("upload_%Y%m%d_%H%M%S")
+                path = os.path.join(self.fallback_dir, f"{name}_{os.getpid()}"
+                                    f"_{self._fallback_seq}.png")
+                self._fallback_seq += 1
+                with open(path, "wb") as f:
+                    f.write(payload)
+                return path
             if upload_id and upload_id != self.command_id:
                 raise ValueError(
                     f"stale upload for command {upload_id[:8]}..., "
@@ -232,8 +248,10 @@ class CaptureServer:
 
     def __init__(self, host: str = "0.0.0.0", port: int = 5000,
                  poll_hold: float = 2.0, disconnect_after: float = 5.0,
-                 capture_page: str | None = None):
-        self.state = CaptureState(disconnect_after=disconnect_after)
+                 capture_page: str | None = None,
+                 upload_dir: str | None = None):
+        self.state = CaptureState(disconnect_after=disconnect_after,
+                                  fallback_dir=upload_dir)
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.capture_state = self.state  # type: ignore[attr-defined]
         self._httpd.poll_hold = poll_hold       # type: ignore[attr-defined]

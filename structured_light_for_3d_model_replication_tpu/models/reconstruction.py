@@ -25,7 +25,8 @@ from structured_light_for_3d_model_replication_tpu.ops import (
     registration as reg,
 )
 
-__all__ = ["merge_360", "preprocess_for_registration", "chamfer_distance"]
+__all__ = ["merge_360", "merge_360_posegraph", "preprocess_for_registration",
+           "chamfer_distance"]
 
 
 @dataclass
@@ -38,10 +39,26 @@ class _Prep:
 
 def preprocess_for_registration(points, colors, valid, voxel_size: float) -> _Prep:
     """Voxel downsample -> normals (r=2*voxel) -> FPFH (r=5*voxel): the
-    reference's preprocess_point_cloud (processing.py:455-466)."""
+    reference's preprocess_point_cloud (processing.py:455-466).
+
+    The downsample keeps fixed [N] shapes; surviving voxels are host-compacted
+    (padded to a 2048-multiple bucket) before the quadratic-cost feature stages so
+    normals/FPFH/RANSAC cost scales with the downsampled count, not the input
+    slot count — the compaction is the same export-boundary pattern as
+    ops/triangulate.compact_cloud."""
     cols = colors if colors is not None else np.zeros_like(points, dtype=np.uint8)
     p, c, v = pc.voxel_downsample(jnp.asarray(points), jnp.asarray(cols),
                                   jnp.asarray(valid), voxel_size)
+    keep = np.asarray(v)
+    p_c = np.asarray(p)[keep]
+    n = len(p_c)
+    # bucket the padded size (multiple of 2048) so consecutive views of similar
+    # density reuse the same compiled kNN/FPFH/RANSAC executables
+    n_pad = -n % 2048
+    if n_pad:
+        p_c = np.concatenate([p_c, np.full((n_pad, 3), 1e9, np.float32)])
+    v_c = np.arange(n + n_pad) < n
+    p, v = jnp.asarray(p_c), jnp.asarray(v_c)
     nr = nrmlib.estimate_normals(p, v, k=30)
     feat = reg.fpfh_features(p, nr, v, radius=5.0 * voxel_size, k=48)
     return _Prep(p, v, nr, feat)
@@ -61,14 +78,8 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
     merged_c = [np.asarray(clouds[0][1], np.uint8)]
     transforms = [np.eye(4, dtype=np.float32)]
 
-    def maybe_sample(p, c, every):
-        if every and every > 1:
-            return p[::every], c[::every]
-        return p, c
-
-    prev_p, prev_c = clouds[0]
-    prev_p, prev_c = maybe_sample(np.asarray(prev_p), np.asarray(prev_c),
-                                  cfg.sample_before)
+    prev_p, prev_c = _sample_every(np.asarray(clouds[0][0]),
+                                   np.asarray(clouds[0][1]), cfg.sample_before)
     prev = preprocess_for_registration(prev_p, prev_c,
                                        np.ones(len(prev_p), bool), voxel)
     t_accum = np.eye(4, dtype=np.float32)
@@ -76,29 +87,18 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
     for i in range(1, len(clouds)):
         cur_p_full = np.asarray(clouds[i][0], np.float32)
         cur_c_full = np.asarray(clouds[i][1], np.uint8)
-        cur_p, cur_c = maybe_sample(cur_p_full, cur_c_full, cfg.sample_before)
+        cur_p, cur_c = _sample_every(cur_p_full, cur_c_full, cfg.sample_before)
         cur = preprocess_for_registration(cur_p, cur_c,
                                           np.ones(len(cur_p), bool), voxel)
 
-        glob = reg.ransac_global_registration(
-            cur.points, cur.features, cur.valid,
-            prev.points, prev.features, prev.valid,
-            max_dist=voxel * 1.5, trials=cfg.ransac_trials,
-        )
-        if float(glob.fitness) < 0.05:
+        t_local, gfit, icp = _register_pair(cur, prev, voxel, cfg)
+        if gfit < 0.05:
             log(f"[merge_360] WARNING view {i}: global fitness "
-                f"{float(glob.fitness):.3f} < 0.05 — alignment may fail "
+                f"{gfit:.3f} < 0.05 — alignment may fail "
                 f"(processing.py:566-569 semantics)")
-
-        icp = reg.icp_point_to_plane(
-            cur.points, cur.valid, prev.points, prev.valid, prev.normals,
-            init_transform=glob.transform,
-            max_dist=voxel * float(cfg.icp_dist_ratio), iters=cfg.icp_iters,
-        )
-        log(f"[merge_360] view {i}: global fit {float(glob.fitness):.3f} | "
+        log(f"[merge_360] view {i}: global fit {gfit:.3f} | "
             f"ICP fit {float(icp.fitness):.3f} rmse {float(icp.rmse):.3f}")
 
-        t_local = np.asarray(icp.transform, np.float32)
         t_accum = (t_accum @ t_local).astype(np.float32)
         transforms.append(t_accum.copy())
         moved = cur_p_full @ t_accum[:3, :3].T + t_accum[:3, 3]
@@ -110,10 +110,21 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
 
     points = np.concatenate(merged_p)
     colors = np.concatenate(merged_c)
+    points, colors = _postprocess_merged(points, colors, cfg)
+    return points, colors, transforms
 
-    # ---- post-processing chain (processing.py:605-629) ----
-    n = len(points)
-    valid = np.ones(n, bool)
+
+def _sample_every(p, c, every):
+    """Uniform pre-registration subsampling (sample_before semantics)."""
+    if every and every > 1:
+        return p[::every], c[::every]
+    return p, c
+
+
+def _postprocess_merged(points, colors, cfg: MergeConfig):
+    """Final voxel/sample/outlier chain shared by both merge modes
+    (processing.py:605-629)."""
+    valid = np.ones(len(points), bool)
     if cfg.final_voxel and cfg.final_voxel > 0:
         p, c, v = pc.voxel_downsample(jnp.asarray(points), jnp.asarray(colors),
                                       jnp.asarray(valid), float(cfg.final_voxel))
@@ -130,6 +141,93 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
             jnp.asarray(points), jnp.asarray(valid),
             cfg.outlier_nb, cfg.outlier_std))
         points, colors = points[m], colors[m]
+    return points, colors
+
+
+def _register_pair(cur: "_Prep", dst: "_Prep", voxel: float, cfg: MergeConfig):
+    """RANSAC global init + point-to-plane ICP refine of cur onto dst.
+    Returns (transform dst<-cur as np [4,4], global fitness, icp result)."""
+    glob = reg.ransac_global_registration(
+        cur.points, cur.features, cur.valid,
+        dst.points, dst.features, dst.valid,
+        max_dist=voxel * 1.5, trials=cfg.ransac_trials,
+    )
+    icp = reg.icp_point_to_plane(
+        cur.points, cur.valid, dst.points, dst.valid, dst.normals,
+        init_transform=glob.transform,
+        max_dist=voxel * float(cfg.icp_dist_ratio), iters=cfg.icp_iters,
+    )
+    return np.asarray(icp.transform, np.float32), float(glob.fitness), icp
+
+
+def merge_360_posegraph(clouds, cfg: MergeConfig | None = None, log=print,
+                        pg_iters: int = 20):
+    """Multiway pose-graph merge: the robust mode the reference keeps in its
+    legacy layer (Old/360Merge.py:50-78 — sequential edges + a first<->last
+    loop-closure edge, globally optimized with LM; Old/new360Merge.py adds the
+    per-pair FPFH/RANSAC init this uses too).
+
+    Returns (points, colors, transforms) with transforms[i] = world-from-view-i
+    after global optimization (world = view 0).
+    """
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        posegraph as pglib,
+    )
+
+    cfg = cfg or MergeConfig()
+    voxel = float(cfg.voxel_size)
+    n = len(clouds)
+    if n < 3:
+        return merge_360(clouds, cfg, log=log)
+
+    preps = []
+    for p_full, c_full in clouds:
+        p_s, c_s = _sample_every(np.asarray(p_full, np.float32),
+                                 np.asarray(c_full, np.uint8), cfg.sample_before)
+        preps.append(preprocess_for_registration(
+            p_s, c_s, np.ones(len(p_s), bool), voxel))
+
+    edges_i, edges_j, edge_T, edge_w = [], [], [], []
+    # odometry chain: edge (i-1 <- i)
+    init = [np.eye(4, dtype=np.float32)]
+    for i in range(1, n):
+        T, gfit, icp = _register_pair(preps[i], preps[i - 1], voxel, cfg)
+        log(f"[posegraph] edge {i - 1}<-{i}: global fit {gfit:.3f} | "
+            f"ICP fit {float(icp.fitness):.3f} rmse {float(icp.rmse):.3f}")
+        edges_i.append(i - 1)
+        edges_j.append(i)
+        edge_T.append(T)
+        edge_w.append(max(float(icp.fitness), 1e-3))
+        init.append((init[-1] @ T).astype(np.float32))
+    # loop closure: edge (0 <- n-1)
+    T_lc, gfit, icp = _register_pair(preps[n - 1], preps[0], voxel, cfg)
+    log(f"[posegraph] loop closure 0<-{n - 1}: global fit {gfit:.3f} | "
+        f"ICP fit {float(icp.fitness):.3f} rmse {float(icp.rmse):.3f}")
+    lc_ok = float(icp.fitness) >= 0.05
+    if lc_ok:
+        edges_i.append(0)
+        edges_j.append(n - 1)
+        edge_T.append(T_lc)
+        edge_w.append(max(float(icp.fitness), 1e-3))
+    else:
+        log("[posegraph] WARNING: loop closure rejected (fitness < 0.05); "
+            "result equals the odometry chain")
+
+    res = pglib.optimize_pose_graph(np.stack(init), edges_i, edges_j,
+                                    np.stack(edge_T), edge_w, iters=pg_iters)
+    log(f"[posegraph] residual rmse {float(res.initial_rmse):.4f} -> "
+        f"{float(res.residual_rmse[-1]):.4f} over {pg_iters} iters")
+    transforms = [np.asarray(res.poses[i], np.float32) for i in range(n)]
+
+    merged_p, merged_c = [], []
+    for i, (p_full, c_full) in enumerate(clouds):
+        T = transforms[i]
+        moved = np.asarray(p_full, np.float32) @ T[:3, :3].T + T[:3, 3]
+        merged_p.append(moved.astype(np.float32))
+        merged_c.append(np.asarray(c_full, np.uint8))
+    points = np.concatenate(merged_p)
+    colors = np.concatenate(merged_c)
+    points, colors = _postprocess_merged(points, colors, cfg)
     return points, colors, transforms
 
 

@@ -1,0 +1,209 @@
+"""SE(3) pose-graph optimization for multiway registration.
+
+Capability parity: the reference's robust 360-degree merge builds a pose graph
+over the turntable views — sequential odometry edges plus a first<->last loop
+closure — and runs Open3D's Levenberg-Marquardt global optimization
+(Old/360Merge.py:50-78, Old/new360Merge.py:96-130). That solver is a C++
+sparse LM; here the graph is tiny (24 nodes x 6 dof) so the TPU-native design
+is a DENSE Gauss-Newton/LM iteration built from batched SE(3) ops: all edge
+residuals and Jacobian blocks are computed vmapped, scattered into the
+[6N, 6N] normal matrix, and solved with one Cholesky per iteration inside
+``lax.scan`` — fixed shapes, fixed iteration count, no data-dependent control
+flow.
+
+Conventions: poses are world-from-view 4x4 matrices; edge (i, j, Z) measures
+view-i-from-view-j (points_j mapped into frame i). Residual per edge:
+``Log(Z^-1 · T_i^-1 · T_j)`` with right-multiplicative perturbations
+``T <- T · exp(xi)`` and the small-residual Jacobian approximation
+``dr/dxi_j = I``, ``dr/dxi_i = -Ad(E^-1)`` — standard g2o-style linearization.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["exp_se3", "log_se3", "adjoint_se3", "optimize_pose_graph",
+           "PoseGraphResult"]
+
+
+def _skew(v):
+    z = jnp.zeros_like(v[..., 0])
+    return jnp.stack([
+        jnp.stack([z, -v[..., 2], v[..., 1]], -1),
+        jnp.stack([v[..., 2], z, -v[..., 0]], -1),
+        jnp.stack([-v[..., 1], v[..., 0], z], -1),
+    ], -2)
+
+
+def exp_se3(xi):
+    """xi = [w(3), v(3)] -> 4x4. Batched over leading dims."""
+    w, v = xi[..., :3], xi[..., 3:]
+    theta2 = (w * w).sum(-1)[..., None, None]
+    theta = jnp.sqrt(theta2 + 1e-24)
+    k = _skew(w)
+    k2 = k @ k
+    eye = jnp.eye(3, dtype=xi.dtype)
+    # closed-form with small-angle-safe coefficients
+    a = jnp.sin(theta) / theta
+    b = (1 - jnp.cos(theta)) / theta2.clip(1e-24)
+    c = (theta - jnp.sin(theta)) / (theta2.clip(1e-24) * theta)
+    small = theta2[..., 0, 0] < 1e-12
+    a = jnp.where(small[..., None, None], 1.0, a)
+    b = jnp.where(small[..., None, None], 0.5, b)
+    c = jnp.where(small[..., None, None], 1.0 / 6.0, c)
+    R = eye + a * k + b * k2
+    V = eye + b * k + c * k2
+    t = jnp.einsum("...ij,...j->...i", V, v)
+    bot = jnp.broadcast_to(jnp.asarray([0, 0, 0, 1], xi.dtype),
+                           R.shape[:-2] + (1, 4))
+    return jnp.concatenate(
+        [jnp.concatenate([R, t[..., :, None]], -1), bot], -2)
+
+
+def _log_so3(R):
+    """Rotation matrix -> axis-angle, batched; safe at 0 and near pi."""
+    tr = R[..., 0, 0] + R[..., 1, 1] + R[..., 2, 2]
+    cos = jnp.clip((tr - 1) / 2, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    ax = jnp.stack([R[..., 2, 1] - R[..., 1, 2],
+                    R[..., 0, 2] - R[..., 2, 0],
+                    R[..., 1, 0] - R[..., 0, 1]], -1)
+    s = jnp.maximum(2 * jnp.sin(theta), 1e-12)[..., None]
+    w_generic = ax * (theta[..., None] / s)
+    # near pi: axis from the diagonal of (R + I)/2
+    diag = jnp.stack([R[..., 0, 0], R[..., 1, 1], R[..., 2, 2]], -1)
+    axis2 = jnp.clip((diag + 1) / 2, 0, 1)
+    axis = jnp.sqrt(axis2)
+    # fix signs from off-diagonals
+    sx = jnp.where(R[..., 2, 1] - R[..., 1, 2] >= 0, 1.0, -1.0)
+    sy = jnp.where(R[..., 0, 2] - R[..., 2, 0] >= 0, 1.0, -1.0)
+    sz = jnp.where(R[..., 1, 0] - R[..., 0, 1] >= 0, 1.0, -1.0)
+    axis = axis * jnp.stack([sx, sy, sz], -1)
+    nrm = jnp.maximum(jnp.linalg.norm(axis, axis=-1, keepdims=True), 1e-12)
+    w_pi = axis / nrm * theta[..., None]
+    near_pi = (jnp.pi - theta) < 1e-3
+    w = jnp.where(near_pi[..., None], w_pi, w_generic)
+    return jnp.where((theta < 1e-7)[..., None], ax / 2, w)
+
+
+def log_se3(T):
+    """4x4 -> xi = [w, v], batched."""
+    R = T[..., :3, :3]
+    t = T[..., :3, 3]
+    w = _log_so3(R)
+    theta2 = (w * w).sum(-1)[..., None, None]
+    theta = jnp.sqrt(theta2 + 1e-24)
+    k = _skew(w)
+    k2 = k @ k
+    eye = jnp.eye(3, dtype=T.dtype)
+    b = (1 - jnp.cos(theta)) / theta2.clip(1e-24)
+    c = (theta - jnp.sin(theta)) / (theta2.clip(1e-24) * theta)
+    small = theta2[..., 0, 0] < 1e-12
+    b = jnp.where(small[..., None, None], 0.5, b)
+    c = jnp.where(small[..., None, None], 1.0 / 6.0, c)
+    V = eye + b * k + c * k2
+    v = jnp.linalg.solve(V, t[..., :, None])[..., 0]
+    return jnp.concatenate([w, v], -1)
+
+
+def adjoint_se3(T):
+    """6x6 adjoint of a 4x4 pose (w-then-v twist ordering), batched."""
+    R = T[..., :3, :3]
+    t = T[..., :3, 3]
+    z = jnp.zeros_like(R)
+    top = jnp.concatenate([R, z], -1)
+    bot = jnp.concatenate([_skew(t) @ R, R], -1)
+    return jnp.concatenate([top, bot], -2)
+
+
+class PoseGraphResult(NamedTuple):
+    poses: jax.Array          # [N, 4, 4] optimized world-from-view
+    residual_rmse: jax.Array  # [iters] per-iteration edge residual RMS
+    initial_rmse: jax.Array
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def _optimize_jit(poses0, ei, ej, Z, w_edge, iters: int, damping):
+    n = poses0.shape[0]
+    Zinv = jnp.linalg.inv(Z)
+
+    def residuals(poses):
+        Ti_inv = jnp.linalg.inv(poses[ei])
+        E = Zinv @ Ti_inv @ poses[ej]
+        return log_se3(E), E
+
+    def gn_step(poses, _):
+        r, E = residuals(poses)                     # [E,6], [E,4,4]
+        # right-perturbation T_i <- T_i exp(xi_i) gives E <- E exp(-Ad(A^-1) xi_i)
+        # with A = T_i^-1 T_j, so dr/dxi_i = -Ad(A^-1); dr/dxi_j = +I
+        A_inv = jnp.linalg.inv(poses[ej]) @ poses[ei]
+        Ji = -adjoint_se3(A_inv)                    # [E,6,6]
+        wgt = w_edge[:, None]
+        # normal equations over stacked 6-dof blocks; node 0 held fixed by
+        # masking its block to identity
+        H = jnp.zeros((n * 6, n * 6), poses.dtype)
+        g = jnp.zeros((n * 6,), poses.dtype)
+
+        eye6 = jnp.eye(6, dtype=poses.dtype)
+        JiT_Ji = jnp.einsum("eki,e,ekj->eij", Ji, w_edge, Ji)
+        JiT_Jj = jnp.einsum("eki,e->eik", Ji, w_edge)      # Ji^T W I
+        JjT_Jj = w_edge[:, None, None] * eye6
+        JiT_r = jnp.einsum("eki,ek->ei", Ji, w_edge[:, None] * r * 1.0)
+        JjT_r = wgt * r
+
+        def scatter_block(H, rows, cols, blocks):
+            # rows/cols: [E] node ids; blocks: [E,6,6]
+            ri = rows[:, None] * 6 + jnp.arange(6)[None, :]
+            ci = cols[:, None] * 6 + jnp.arange(6)[None, :]
+            return H.at[ri[:, :, None], ci[:, None, :]].add(blocks)
+
+        H = scatter_block(H, ei, ei, JiT_Ji)
+        H = scatter_block(H, ei, ej, JiT_Jj)
+        H = scatter_block(H, ej, ei, jnp.swapaxes(JiT_Jj, -1, -2))
+        H = scatter_block(H, ej, ej, JjT_Jj)
+        g = g.at[(ei[:, None] * 6 + jnp.arange(6)[None, :])].add(-JiT_r)
+        g = g.at[(ej[:, None] * 6 + jnp.arange(6)[None, :])].add(-JjT_r)
+
+        # gauge fix: clamp node 0 (its 6x6 block -> large diagonal)
+        anchor = jnp.zeros(n * 6, poses.dtype).at[:6].set(1e12)
+        H = H + jnp.diag(anchor) + damping * jnp.eye(n * 6, dtype=poses.dtype)
+        xi = jnp.linalg.solve(H, g).reshape(n, 6)
+        poses_new = poses @ exp_se3(xi)
+        r_new, _ = residuals(poses_new)   # residual AFTER this update
+        rmse = jnp.sqrt((w_edge * (r_new * r_new).sum(-1)).sum()
+                        / jnp.maximum(w_edge.sum(), 1e-9))
+        return poses_new, rmse
+
+    r0, _ = residuals(poses0)
+    rmse0 = jnp.sqrt((w_edge * (r0 * r0).sum(-1)).sum()
+                     / jnp.maximum(w_edge.sum(), 1e-9))
+    poses, rmse_hist = jax.lax.scan(gn_step, poses0, None, length=iters)
+    return poses, rmse_hist, rmse0
+
+
+def optimize_pose_graph(init_poses, edges_i, edges_j, edge_transforms,
+                        edge_weights=None, iters: int = 20,
+                        damping: float = 1e-6) -> PoseGraphResult:
+    """Globally optimize world-from-view poses against relative-pose edges.
+
+    init_poses: [N,4,4]; edges_{i,j}: int arrays [E]; edge_transforms: [E,4,4]
+    measuring frame-i-from-frame-j; edge_weights: [E] information weights
+    (e.g. registration fitness). Node 0 is the gauge anchor.
+    """
+    poses0 = jnp.asarray(init_poses, jnp.float32)
+    ei = jnp.asarray(edges_i, jnp.int32)
+    ej = jnp.asarray(edges_j, jnp.int32)
+    Z = jnp.asarray(edge_transforms, jnp.float32)
+    w = jnp.ones(ei.shape[0], jnp.float32) if edge_weights is None \
+        else jnp.asarray(edge_weights, jnp.float32)
+    poses, hist, rmse0 = _optimize_jit(poses0, ei, ej, Z, w, iters,
+                                       jnp.float32(damping))
+    # re-orthonormalize rotations after accumulated float updates
+    u, _, vt = jnp.linalg.svd(poses[:, :3, :3])
+    Rn = u @ vt
+    poses = poses.at[:, :3, :3].set(Rn)
+    return PoseGraphResult(poses, hist, rmse0)

@@ -1,17 +1,127 @@
 """CLI subcommand registry.
 
-Pipeline stages self-register their CLI surface here; cli.py stays a thin shell.
+Every GUI tab of the reference (server/gui.py:176-205) plus its one legacy CLI
+(Old/process_cloud.py:221-236) maps to a subcommand here; each is a thin
+wrapper over pipeline/stages.py so CLI, tests, and any GUI share one
+implementation.
+
+  reconstruct   tab 1/2  decode + triangulate scan folders -> PLY
+  clean         tab 3    background / cluster / radius / statistical chain
+  merge-360     tab 4    sequential or pose-graph registration merge
+  mesh          tab 5/7  Poisson / surface-nets mesh -> STL or PLY
+  calibrate     tab 8    analyze poses, prune by error, stereo solve -> calib
+  inspect-calib (O11)    human-readable calibration summary
+  patterns      (A4)     write the Gray-code pattern stack to disk
+  serve         (A2)     run the phone-capture HTTP server standalone
+  scan          tab 1    capture one structured-light sequence
+  auto-scan     tab 6    full turntable sweep (12 x 30 degrees)
+  synth         (new)    render a synthetic scan dataset for tests/demos
 """
 from __future__ import annotations
 
 import argparse
+import json
+import os
 from typing import Callable
 
 _RUNNERS: dict[str, Callable[[argparse.Namespace], int]] = {}
 
 
+def _cfg(args: argparse.Namespace):
+    from structured_light_for_3d_model_replication_tpu import load_config
+    from structured_light_for_3d_model_replication_tpu.cli import parse_overrides
+
+    return load_config(getattr(args, "config", None),
+                       parse_overrides(getattr(args, "set", [])))
+
+
+def _runner(name: str):
+    def deco(fn):
+        _RUNNERS[name] = fn
+        return fn
+    return deco
+
+
 def register(sub: argparse._SubParsersAction, add_config_args) -> None:
-    """Register all pipeline subcommands. Populated as stages land."""
+    p = sub.add_parser("reconstruct",
+                       help="decode + triangulate scan folder(s) into PLY clouds")
+    p.add_argument("target", help="scan folder (single), parent folder (batch), "
+                                  "or comma-separated file list (files)")
+    p.add_argument("--calib", required=True, help="calibration file (.mat/.npz)")
+    p.add_argument("--mode", choices=["single", "batch", "files"],
+                   default="single")
+    p.add_argument("--output", default=None,
+                   help="output .ply (single) or output directory (batch/files)")
+    add_config_args(p)
+
+    p = sub.add_parser("clean", help="point-cloud cleanup chain on one PLY")
+    p.add_argument("input")
+    p.add_argument("output")
+    p.add_argument("--steps", default="background,cluster,radius,statistical",
+                   help="comma list drawn from background,cluster,radius,statistical")
+    add_config_args(p)
+
+    p = sub.add_parser("merge-360",
+                       help="register+merge a folder of per-view PLYs")
+    p.add_argument("input_folder")
+    p.add_argument("output")
+    p.add_argument("--method", choices=["sequential", "posegraph"], default=None,
+                   help="override merge.method")
+    p.add_argument("--save-transforms", default=None,
+                   help="write per-view 4x4 transforms as JSON")
+    add_config_args(p)
+
+    p = sub.add_parser("mesh", help="mesh a cloud PLY into STL or mesh-PLY")
+    p.add_argument("input")
+    p.add_argument("output", help=".stl or .ply output path")
+    p.add_argument("--save-normals", default=None,
+                   help="also dump the oriented-normals debug cloud (PLY)")
+    add_config_args(p)
+
+    p = sub.add_parser("calibrate",
+                       help="analyze calibration poses and solve the stereo rig")
+    p.add_argument("base_dir", help="folder of per-pose capture folders")
+    p.add_argument("--output", default=None,
+                   help="calibration output file (default: <base_dir>/calib.mat)")
+    p.add_argument("--analyze-only", action="store_true",
+                   help="only print per-pose reprojection errors")
+    p.add_argument("--poses", default=None,
+                   help="comma list of pose folder names to use (default: auto "
+                        "pruning by error ceilings)")
+    p.add_argument("--max-cam-err", type=float, default=1.0)
+    p.add_argument("--max-proj-err", type=float, default=2.0)
+    add_config_args(p)
+
+    p = sub.add_parser("inspect-calib",
+                       help="human-readable calibration summary (quality bands)")
+    p.add_argument("calib", help="calibration file (.mat/.npz)")
+    add_config_args(p)
+
+    p = sub.add_parser("patterns", help="write the Gray-code pattern stack")
+    p.add_argument("out_dir")
+    add_config_args(p)
+
+    p = sub.add_parser("serve", help="run the phone-capture HTTP server")
+    p.add_argument("--save-dir", default="captures",
+                   help="where manual /upload images land")
+    add_config_args(p)
+
+    p = sub.add_parser("scan", help="capture one structured-light sequence")
+    p.add_argument("save_dir")
+    add_config_args(p)
+
+    p = sub.add_parser("auto-scan", help="full 360-degree turntable sweep")
+    p.add_argument("output_root")
+    p.add_argument("--base-name", default="scan")
+    add_config_args(p)
+
+    p = sub.add_parser("synth",
+                       help="render a synthetic turntable scan dataset")
+    p.add_argument("output_root")
+    p.add_argument("--views", type=int, default=4)
+    p.add_argument("--cam", default="320x240", help="camera WxH")
+    p.add_argument("--proj", default="256x128", help="projector WxH")
+    add_config_args(p)
 
 
 def run(args: argparse.Namespace) -> int:
@@ -19,3 +129,217 @@ def run(args: argparse.Namespace) -> int:
     if runner is None:
         raise SystemExit(f"unknown command: {args.command}")
     return runner(args)
+
+
+@_runner("reconstruct")
+def _cmd_reconstruct(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+    report = stages.reconstruct(args.calib, args.target, mode=args.mode,
+                                output=args.output, cfg=_cfg(args))
+    return 0 if report.outputs and not report.failed else (2 if report.outputs else 1)
+
+
+@_runner("clean")
+def _cmd_clean(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+    steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
+    stages.clean_cloud(args.input, args.output, cfg=_cfg(args), steps=steps)
+    return 0
+
+
+@_runner("merge-360")
+def _cmd_merge(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+    cfg = _cfg(args)
+    if args.method:
+        cfg.merge.method = args.method
+    _, _, transforms = stages.merge_views(args.input_folder, args.output, cfg=cfg)
+    if args.save_transforms:
+        with open(args.save_transforms, "w") as f:
+            json.dump([np_t.tolist() for np_t in transforms], f, indent=2)
+    return 0
+
+
+@_runner("mesh")
+def _cmd_mesh(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+    stages.mesh_cloud(args.input, args.output, cfg=_cfg(args),
+                      save_normals_path=args.save_normals)
+    return 0
+
+
+@_runner("calibrate")
+def _cmd_calibrate(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.calib import chessboard as cb
+    from structured_light_for_3d_model_replication_tpu.calib import inspect as ci
+    from structured_light_for_3d_model_replication_tpu.calib import pipeline as cp
+
+    cfg = _cfg(args)
+    board = cb.BoardSpec(rows=cfg.checkerboard.rows, cols=cfg.checkerboard.cols,
+                         square_size=cfg.checkerboard.square_size_mm)
+    proj_size = (cfg.projector.width, cfg.projector.height)
+    errors, observations, img_shape = cp.analyze_calibration(
+        args.base_dir, board=board, proj_size=proj_size)
+    print(f"{'pose':<20} {'cam px':>8} {'proj px':>8}  quality")
+    for pose, (ec, ep) in sorted(errors.items()):
+        print(f"{pose:<20} {ec:>8.3f} {ep:>8.3f}  {ci.quality_band(ec)}")
+    if args.analyze_only:
+        return 0
+    if args.poses:
+        selected = [p.strip() for p in args.poses.split(",") if p.strip()]
+    else:
+        selected = cp.select_poses(errors, args.max_cam_err, args.max_proj_err)
+    print(f"using {len(selected)}/{len(errors)} poses: {', '.join(sorted(selected))}")
+    output = args.output or os.path.join(args.base_dir, "calib.mat")
+    cp.calibrate_and_save(args.base_dir, output, selected_poses=selected,
+                          board=board, proj_size=proj_size,
+                          observations=observations, img_shape=img_shape)
+    return 0
+
+
+@_runner("inspect-calib")
+def _cmd_inspect(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.calib import inspect as ci
+    from structured_light_for_3d_model_replication_tpu.io import matfile
+
+    calib = matfile.load_calibration(args.calib)
+    print(ci.format_summary(ci.summarize_calibration(calib)))
+    return 0
+
+
+@_runner("patterns")
+def _cmd_patterns(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+    stages.write_patterns(args.out_dir, cfg=_cfg(args))
+    return 0
+
+
+@_runner("serve")
+def _cmd_serve(args) -> int:
+    import time
+
+    from structured_light_for_3d_model_replication_tpu.acquire.server import (
+        CaptureServer,
+    )
+
+    cfg = _cfg(args).acquire
+    os.makedirs(args.save_dir, exist_ok=True)
+    srv = CaptureServer(cfg.http_host, cfg.http_port,
+                        poll_hold=cfg.long_poll_hold_s,
+                        disconnect_after=cfg.disconnect_after_s,
+                        upload_dir=args.save_dir).start()
+    print(f"capture server on http://{cfg.http_host}:{srv.port} "
+          f"(open this on the phone; ctrl-C to stop)")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+def _build_capture_rig(cfg):
+    """Projector + capture server + sequencer + turntable from AcquireConfig."""
+    from structured_light_for_3d_model_replication_tpu.acquire.projector import (
+        open_projector,
+    )
+    from structured_light_for_3d_model_replication_tpu.acquire.sequencer import (
+        CaptureSequencer,
+    )
+    from structured_light_for_3d_model_replication_tpu.acquire.server import (
+        CaptureServer,
+    )
+    from structured_light_for_3d_model_replication_tpu.acquire.turntable import (
+        open_turntable,
+    )
+
+    a = cfg.acquire
+    server = CaptureServer(a.http_host, a.http_port,
+                           poll_hold=a.long_poll_hold_s,
+                           disconnect_after=a.disconnect_after_s).start()
+    projector = open_projector("virtual" if a.simulate else "auto",
+                               screen_offset_x=cfg.projector.screen_offset_x)
+    sequencer = CaptureSequencer(
+        projector,
+        lambda path: server.trigger_capture(path, timeout=a.capture_timeout_s),
+        proj_size=(cfg.projector.width, cfg.projector.height),
+        brightness=cfg.projector.brightness,
+        downsample=cfg.projector.downsample,
+        scan_settle_ms=a.settle_ms_scan, calib_settle_ms=a.settle_ms_calib,
+    )
+    turntable = open_turntable("sim" if a.simulate else "auto",
+                               port=a.serial_port or None)
+    return server, projector, sequencer, turntable
+
+
+@_runner("scan")
+def _cmd_scan(args) -> int:
+    cfg = _cfg(args)
+    server, projector, sequencer, turntable = _build_capture_rig(cfg)
+    try:
+        sequencer.capture_scan(args.save_dir)
+    finally:
+        projector.close()
+        server.stop()
+        if hasattr(turntable, "close"):
+            turntable.close()
+    return 0
+
+
+@_runner("auto-scan")
+def _cmd_auto_scan(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.acquire.autoscan import (
+        auto_scan_360,
+    )
+
+    cfg = _cfg(args)
+    server, projector, sequencer, turntable = _build_capture_rig(cfg)
+    try:
+        result = auto_scan_360(
+            sequencer, turntable, args.output_root,
+            turns=cfg.acquire.turns, step_deg=cfg.acquire.degrees_per_turn,
+            base_name=args.base_name, rotate_timeout=cfg.acquire.rotate_timeout_s,
+        )
+    finally:
+        projector.close()
+        server.stop()
+        if hasattr(turntable, "close"):
+            turntable.close()
+    return 0 if result.view_dirs else 1
+
+
+@_runner("synth")
+def _cmd_synth(args) -> int:
+    import numpy as np
+
+    from structured_light_for_3d_model_replication_tpu.io import images as imio
+    from structured_light_for_3d_model_replication_tpu.io import matfile
+    from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+
+    def wh(s):
+        w, h = s.lower().split("x")
+        return int(w), int(h)
+
+    cam, proj = wh(args.cam), wh(args.proj)
+    rig = syn.default_rig(cam_size=cam, proj_size=proj)
+    scene = syn.sphere_on_background()
+    obj, background = scene.objects  # turntable rotates the object, not the wall
+    os.makedirs(args.output_root, exist_ok=True)
+    matfile.save_calibration(os.path.join(args.output_root, "calib.mat"),
+                             rig.calibration())
+    step = 360.0 / args.views
+    pivot = np.array([0.0, 0.0, 420.0])  # sphere_on_background center depth
+    for i, (R, t) in enumerate(syn.turntable_poses(args.views, step, pivot)):
+        view_scene = syn.Scene([obj.transformed(R, t), background])
+        frames, _ = syn.render_scene(rig, view_scene)
+        d = os.path.join(args.output_root,
+                         f"scan_{int(round(i * step)):03d}deg_scan")
+        imio.save_stack(d, frames)
+        print(f"[synth] view {i + 1}/{args.views} -> {d}")
+    print(f"[synth] calib + {args.views} views under {args.output_root}")
+    return 0

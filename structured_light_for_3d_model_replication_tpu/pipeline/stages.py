@@ -1,0 +1,339 @@
+"""File-level pipeline stages: the artifact-per-stage contract.
+
+Every stage reads its input artifact from disk and persists its output, so any
+stage can be re-entered from files alone — the checkpoint/resume model the
+reference uses throughout (images -> calib.mat -> per-view .ply -> cleaned .ply
+-> merged .ply -> .stl; server/gui.py tabs 2-7 are pure functions of files).
+These functions are the single implementation shared by the CLI, tests, and any
+future GUI front-end.
+
+Capability parity: process_multi_ply (server/processing.py:251-334), the tab-3
+cleanup chain (server/gui.py:1391-1522), merge_pro_360 (processing.py:489-629),
+mesh_360/reconstruct_stl (processing.py:632-860).
+"""
+from __future__ import annotations
+
+import os
+import re
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io import images as imio
+from structured_light_for_3d_model_replication_tpu.io import matfile, ply
+from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
+from structured_light_for_3d_model_replication_tpu.ops import triangulate as tri
+
+__all__ = [
+    "BatchReport", "reconstruct_source", "reconstruct", "clean_cloud",
+    "merge_views", "mesh_cloud", "sort_ply_paths_by_angle", "write_patterns",
+]
+
+_DEG_RE = re.compile(r"(\d+(?:\.\d+)?)\s*deg", re.IGNORECASE)
+
+
+@dataclass
+class BatchReport:
+    """Per-item success/failure accounting (processing.py:314-334 semantics)."""
+
+    outputs: list[str] = field(default_factory=list)
+    failed: list[tuple[str, str]] = field(default_factory=list)
+    elapsed_s: float = 0.0
+
+    @property
+    def summary(self) -> str:
+        total = len(self.outputs) + len(self.failed)
+        return f"{len(self.outputs)}/{total} succeeded in {self.elapsed_s:.1f}s"
+
+
+def sort_ply_paths_by_angle(paths: list[str]) -> list[str]:
+    """Order merge inputs by the ``"<n>deg"`` tag in the filename, falling back
+    to lexical order for untagged files (server/processing.py:499-519)."""
+
+    def key(p):
+        m = _DEG_RE.search(os.path.basename(p))
+        return (0, float(m.group(1)), p) if m else (1, 0.0, p)
+
+    return sorted(paths, key=key)
+
+
+def _scan_sources(target: str, mode: str, need: int) -> list[str]:
+    """Resolve `target` to a list of scan-folder sources per the reference's
+    single/batch/files modes (processing.py:300-322)."""
+    if mode == "single":
+        return [target]
+    if mode == "batch":
+        subs = sorted(
+            os.path.join(target, d) for d in os.listdir(target)
+            if os.path.isdir(os.path.join(target, d))
+        )
+        out = []
+        for s in subs:
+            try:
+                if len(imio.list_frame_files(s)) >= need:
+                    out.append(s)
+            except (FileNotFoundError, NotADirectoryError):
+                continue
+        return out
+    if mode == "files":
+        return [p.strip() for p in target.split(",") if p.strip()]
+    raise ValueError(f"unknown reconstruct mode {mode!r} (single|batch|files)")
+
+
+def reconstruct_source(source, calib: dict, cfg: Config, scanner=None):
+    """One scan source (folder or file list) -> (points, colors) compact arrays.
+
+    Backend-switched: ``cfg.parallel.backend == 'numpy'`` runs the bit-exact
+    CPU path; ``'jax'`` runs the fused TPU program (SLScanner when provided,
+    else the module-level jit kernels).
+    """
+    dcfg, tcfg = cfg.decode, cfg.triangulate
+    ds = cfg.projector.downsample  # must match the capture-time D_SAMPLE_PROJ
+    frames, texture = imio.load_stack(source)
+    if cfg.parallel.backend == "numpy":
+        dec = gc.decode_stack_np(
+            frames, texture, n_cols=dcfg.n_cols, n_rows=dcfg.n_rows,
+            n_sets_col=dcfg.n_sets_col, n_sets_row=dcfg.n_sets_row,
+            thresh_mode=dcfg.thresh_mode, shadow_val=dcfg.shadow_val,
+            contrast_val=dcfg.contrast_val, downsample=ds,
+        )
+        cloud = tri.triangulate_np(
+            dec.col_map, dec.row_map, dec.mask, dec.texture, calib,
+            row_mode=tcfg.row_mode, epipolar_tol=tcfg.epipolar_tol,
+        )
+    elif scanner is not None:
+        cloud = scanner.forward(frames, thresh_mode=dcfg.thresh_mode,
+                                shadow_val=dcfg.shadow_val,
+                                contrast_val=dcfg.contrast_val)
+    else:
+        import jax.numpy as jnp
+
+        dec = gc.decode_stack(
+            jnp.asarray(frames), jnp.asarray(texture),
+            n_cols=dcfg.n_cols, n_rows=dcfg.n_rows,
+            n_sets_col=dcfg.n_sets_col, n_sets_row=dcfg.n_sets_row,
+            thresh_mode=dcfg.thresh_mode, shadow_val=dcfg.shadow_val,
+            contrast_val=dcfg.contrast_val, downsample=ds,
+        )
+        cloud = tri.triangulate(
+            dec.col_map, dec.row_map, dec.mask, dec.texture, calib,
+            row_mode=tcfg.row_mode, epipolar_tol=tcfg.epipolar_tol,
+        )
+    return tri.compact_cloud(cloud)
+
+
+def reconstruct(calib_path: str, target: str, mode: str = "single",
+                output: str | None = None, cfg: Config | None = None,
+                log=print) -> BatchReport:
+    """Scan folder(s) -> per-view colored PLY (process_multi_ply parity).
+
+    ``output``: for single mode a .ply path (default: <target>.ply); for
+    batch/files a directory (default: alongside each source).
+    """
+    cfg = cfg or Config()
+    calib = matfile.load_calibration(calib_path)
+    need = gc.frames_per_view(cfg.decode.n_cols, cfg.decode.n_rows,
+                              cfg.projector.downsample)
+    sources = _scan_sources(target, mode, need)
+    if not sources:
+        raise ValueError(f"no scan sources found under {target!r} (mode={mode})")
+
+    scanner = None
+    if cfg.parallel.backend != "numpy":
+        from structured_light_for_3d_model_replication_tpu.models.scanner import (
+            SLScanner,
+        )
+        first = imio.list_frame_files(sources[0])
+        probe = imio.load_gray(first[0])
+        scanner = SLScanner(
+            calib, (probe.shape[1], probe.shape[0]),
+            proj_size=(cfg.decode.n_cols, cfg.decode.n_rows),
+            row_mode=cfg.triangulate.row_mode,
+            epipolar_tol=cfg.triangulate.epipolar_tol,
+            n_sets_col=cfg.decode.n_sets_col, n_sets_row=cfg.decode.n_sets_row,
+            downsample=cfg.projector.downsample,
+        )
+
+    report = BatchReport()
+    t0 = time.monotonic()
+    for src in sources:
+        name = os.path.basename(os.path.normpath(src)) or "cloud"
+        try:
+            pts, cols = reconstruct_source(src, calib, cfg, scanner)
+            if mode == "single" and output:
+                out_path = output
+            elif output:
+                os.makedirs(output, exist_ok=True)
+                out_path = os.path.join(output, f"{name}.ply")
+            else:
+                out_path = os.path.normpath(src) + ".ply"
+            ply.write_ply(out_path, pts, cols)
+            log(f"[reconstruct] {name}: {len(pts):,} points -> {out_path}")
+            report.outputs.append(out_path)
+        except Exception as e:  # per-item tolerance (processing.py:323-330)
+            log(f"[reconstruct] {name} FAILED: {e}")
+            report.failed.append((src, str(e)))
+    report.elapsed_s = time.monotonic() - t0
+    log(f"[reconstruct] {report.summary}")
+    return report
+
+
+_CLEAN_STEPS = ("background", "cluster", "radius", "statistical")
+
+
+def clean_cloud(input_ply: str, output_ply: str, cfg: Config | None = None,
+                steps: tuple[str, ...] | list[str] = _CLEAN_STEPS,
+                log=print) -> dict:
+    """Cleanup chain on one cloud: background plane removal -> largest cluster
+    -> radius outlier -> statistical outlier (the tab-3 chain, gui.py:1391-1522;
+    ops per processing.py:337-448). Steps are individually selectable."""
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.ops import pointcloud as pc
+
+    cfg = cfg or Config()
+    ccfg = cfg.clean
+    data = ply.read_ply(input_ply)
+    pts = np.asarray(data["points"], np.float32)
+    cols = np.asarray(data.get("colors")) if data.get("colors") is not None \
+        else np.zeros_like(pts, dtype=np.uint8)
+    use_np = cfg.parallel.backend == "numpy"
+    counts = {"input": len(pts)}
+
+    for step in steps:
+        if step not in _CLEAN_STEPS:
+            raise ValueError(f"unknown clean step {step!r}; valid: {_CLEAN_STEPS}")
+        valid = np.ones(len(pts), bool)
+        if step == "background" and ccfg.remove_background_plane:
+            # the reference keeps the INVERSE of the plane inliers
+            # (processing.py:349-354)
+            if use_np:
+                _, inliers = pc.segment_plane_np(
+                    pts, valid, distance_threshold=ccfg.plane_ransac_dist,
+                    num_iterations=ccfg.plane_ransac_trials)
+            else:
+                _, inliers = pc.segment_plane(
+                    jnp.asarray(pts), jnp.asarray(valid),
+                    distance_threshold=ccfg.plane_ransac_dist,
+                    num_iterations=ccfg.plane_ransac_trials)
+            keep = valid & ~np.asarray(inliers)
+        elif step == "cluster":
+            fn = pc.largest_cluster_mask_np if use_np else pc.largest_cluster_mask
+            keep = np.asarray(fn(pts if use_np else jnp.asarray(pts),
+                                 valid if use_np else jnp.asarray(valid),
+                                 eps=ccfg.cluster_eps,
+                                 min_points=ccfg.cluster_min_points))
+        elif step == "radius":
+            fn = pc.radius_outlier_mask_np if use_np else pc.radius_outlier_mask
+            keep = np.asarray(fn(pts if use_np else jnp.asarray(pts),
+                                 valid if use_np else jnp.asarray(valid),
+                                 radius=ccfg.radius,
+                                 nb_points=ccfg.radius_nb_points))
+        elif step == "statistical":
+            fn = (pc.statistical_outlier_mask_np if use_np
+                  else pc.statistical_outlier_mask)
+            keep = np.asarray(fn(pts if use_np else jnp.asarray(pts),
+                                 valid if use_np else jnp.asarray(valid),
+                                 ccfg.outlier_nb_neighbors,
+                                 ccfg.outlier_std_ratio))
+        else:
+            continue
+        pts, cols = pts[keep], cols[keep]
+        counts[step] = len(pts)
+        log(f"[clean] {step}: {len(pts):,} points remain")
+        if len(pts) == 0:
+            log("[clean] WARNING: all points removed; aborting chain")
+            break
+
+    ply.write_ply(output_ply, pts, cols)
+    log(f"[clean] wrote {output_ply} ({len(pts):,} points)")
+    return counts
+
+
+def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
+                log=print):
+    """Folder of per-view PLYs -> one registered 360-degree cloud
+    (merge_pro_360 parity; ``cfg.merge.method`` picks greedy sequential (A18)
+    or pose-graph global optimization (Old/360Merge.py:50-78 capability))."""
+    from structured_light_for_3d_model_replication_tpu.models import (
+        reconstruction as recon,
+    )
+
+    cfg = cfg or Config()
+    out_abs = os.path.abspath(output_ply)
+    paths = sort_ply_paths_by_angle([
+        p for f in os.listdir(input_folder)
+        if f.lower().endswith(".ply")
+        and os.path.abspath(p := os.path.join(input_folder, f)) != out_abs
+    ])
+    if len(paths) < 2:
+        raise ValueError(f"need >= 2 PLY views in {input_folder}, found {len(paths)}")
+    log(f"[merge] {len(paths)} views: " + ", ".join(os.path.basename(p) for p in paths))
+    clouds = []
+    for p in paths:
+        d = ply.read_ply(p)
+        c = d.get("colors")
+        if c is None:
+            c = np.zeros_like(d["points"], dtype=np.uint8)
+        clouds.append((np.asarray(d["points"], np.float32), np.asarray(c, np.uint8)))
+
+    if cfg.merge.method == "posegraph":
+        points, colors, transforms = recon.merge_360_posegraph(
+            clouds, cfg.merge, log=log)
+    else:
+        points, colors, transforms = recon.merge_360(clouds, cfg.merge, log=log)
+    ply.write_ply(output_ply, points, colors)
+    log(f"[merge] wrote {output_ply} ({len(points):,} points)")
+    return points, colors, transforms
+
+
+def mesh_cloud(input_ply: str, output_path: str, cfg: Config | None = None,
+               save_normals_path: str | None = None, log=print):
+    """Cloud PLY -> mesh (.stl or .ply by extension): reconstruct_stl/mesh_360
+    parity including the optional normals debug dump (processing.py:690-693)."""
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.models import meshing
+    from structured_light_for_3d_model_replication_tpu.ops import normals as nrm
+
+    cfg = cfg or Config()
+    data = ply.read_ply(input_ply)
+    pts = np.asarray(data["points"], np.float32)
+    valid = np.ones(len(pts), bool)
+
+    normals = data.get("normals")
+    if normals is None:
+        nr = nrm.estimate_normals(jnp.asarray(pts), jnp.asarray(valid),
+                                  k=cfg.mesh.normal_max_nn)
+        nr = nrm.orient_normals(jnp.asarray(pts), nr, jnp.asarray(valid),
+                                mode=cfg.mesh.orientation)
+        normals = np.asarray(nr)
+        log(f"[mesh] estimated normals (k={cfg.mesh.normal_max_nn}, "
+            f"{cfg.mesh.orientation} orientation)")
+    if save_normals_path:
+        ply.write_ply(save_normals_path, pts, data.get("colors"), normals)
+        log(f"[mesh] normals debug cloud -> {save_normals_path}")
+
+    verts, faces = meshing.reconstruct_mesh(pts, valid, normals,
+                                            cfg=cfg.mesh, log=log)
+    if output_path.lower().endswith(".stl"):
+        meshing.mesh_to_stl(output_path, verts, faces)
+    else:
+        ply.write_mesh_ply(output_path, verts, faces)
+    log(f"[mesh] wrote {output_path} ({len(verts):,} verts, {len(faces):,} faces)")
+    return verts, faces
+
+
+def write_patterns(out_dir: str, cfg: Config | None = None, log=print) -> list[str]:
+    """Persist the projector pattern stack as numbered images — the offline
+    equivalent of generate_patterns (server/sl_system.py:44-86)."""
+    cfg = cfg or Config()
+    p = cfg.projector
+    frames = gc.generate_pattern_stack(p.width, p.height,
+                                       brightness=p.brightness,
+                                       downsample=p.downsample)
+    paths = imio.save_stack(out_dir, frames)
+    log(f"[patterns] {len(paths)} frames -> {out_dir}")
+    return paths

@@ -266,3 +266,23 @@ def test_capture_page_served_when_configured():
             assert b"capture" in r.read()
     finally:
         srv.stop()
+
+
+def test_unarmed_upload_falls_back_to_dir(tmp_path):
+    """serve-mode contract: with an upload_dir configured, an upload with no
+    armed capture lands there instead of 409ing."""
+    srv = CaptureServer(host="127.0.0.1", port=0, poll_hold=0.3,
+                        upload_dir=str(tmp_path / "drops"))
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        req = urllib.request.Request(base + "/upload", data=b"manualframe",
+                                     headers={"Content-Type":
+                                              "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+        drops = list((tmp_path / "drops").iterdir())
+        assert len(drops) == 1
+        assert drops[0].read_bytes() == b"manualframe"
+    finally:
+        srv.stop()
