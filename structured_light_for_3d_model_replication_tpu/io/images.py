@@ -88,19 +88,32 @@ def load_stack(source, expected: int | None = None):
     The texture is the white frame (frame 0) in color, per the reference's use
     of files[0] as the point-cloud color source (processing.py:124).
     """
+    from structured_light_for_3d_model_replication_tpu.io import native
+
     files = list_frame_files(source)
     if expected is not None and len(files) < expected:
         raise ValueError(f"{source}: expected >= {expected} frames, found {len(files)}")
     if len(files) < 4:
         raise ValueError(f"{source}: need at least 4 frames, found {len(files)}")
-    first = load_gray(files[0])
-    frames = np.empty((len(files),) + first.shape, np.uint8)
-    frames[0] = first
-    for i, p in enumerate(files[1:], start=1):
-        img = load_gray(p)
-        if img.shape != first.shape:
-            raise ValueError(f"{p}: frame size {img.shape} != {first.shape}")
-        frames[i] = img
+    # native thread-pooled decoder first: byte-exact for grayscale PNGs (the
+    # pattern frames this framework writes); color-PNG gray conversion may
+    # differ from cv2's SIMD path by +-1 level (inside every threshold's
+    # tolerance). Header-only probe avoids decoding frame 0 twice.
+    stack = None
+    probe = native.probe_png(files[0])
+    if probe is not None:
+        stack = native.load_gray_stack(files, probe[0], probe[1])
+    if stack is not None:
+        frames = stack
+    else:
+        first = load_gray(files[0])
+        frames = np.empty((len(files),) + first.shape, np.uint8)
+        frames[0] = first
+        for i, p in enumerate(files[1:], start=1):
+            img = load_gray(p)
+            if img.shape != first.shape:
+                raise ValueError(f"{p}: frame size {img.shape} != {first.shape}")
+            frames[i] = img
     texture = load_color(files[0])
     return frames, texture
 

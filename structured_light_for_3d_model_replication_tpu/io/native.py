@@ -1,0 +1,144 @@
+"""ctypes binding for the native IO runtime (native/libslio.so).
+
+The reference's IO hot paths live in C++ (OpenCV imread, Open3D writers); the
+TPU build mirrors that with its own native library: thread-pooled PNG stack
+decode and buffered binary PLY/STL writers. Everything here degrades to the
+pure-Python implementations when the library hasn't been built
+(`make -C native`), so the framework has zero hard native dependencies.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+__all__ = ["available", "load_gray_stack", "write_ply_native",
+           "write_stl_native", "probe_png"]
+
+_LIB = None
+_TRIED = False
+
+
+def _find_lib():
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    candidates = [
+        os.environ.get("SLIO_LIBRARY", ""),
+        os.path.join(here, "native", "libslio.so"),
+    ]
+    for c in candidates:
+        if c and os.path.exists(c):
+            return c
+    return None
+
+
+def _lib():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _find_lib()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.slio_abi_version.restype = ctypes.c_int
+        if lib.slio_abi_version() != 1:
+            return None
+        lib.slio_probe_png.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.slio_probe_png.restype = ctypes.c_int
+        lib.slio_load_gray_stack.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int]
+        lib.slio_load_gray_stack.restype = ctypes.c_int
+        lib.slio_write_ply.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float)]
+        lib.slio_write_ply.restype = ctypes.c_int
+        lib.slio_write_stl.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.slio_write_stl.restype = ctypes.c_int
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def probe_png(path: str):
+    """(width, height, channels) of a PNG, or None on failure/unavailable."""
+    lib = _lib()
+    if lib is None:
+        return None
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    c = ctypes.c_int()
+    if lib.slio_probe_png(path.encode(), ctypes.byref(w), ctypes.byref(h),
+                          ctypes.byref(c)) != 0:
+        return None
+    return w.value, h.value, c.value
+
+
+def load_gray_stack(paths: list[str], width: int, height: int,
+                    n_threads: int = 0) -> np.ndarray | None:
+    """Parallel-decode PNGs to a uint8 [F, H, W] stack; None if unavailable
+    or any file fails (caller falls back to the Python loader)."""
+    lib = _lib()
+    if lib is None or not paths:
+        return None
+    if not all(p.lower().endswith(".png") for p in paths):
+        return None
+    out = np.empty((len(paths), height, width), np.uint8)
+    arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+    rc = lib.slio_load_gray_stack(
+        arr, len(paths), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        width, height, n_threads)
+    if rc != 0:
+        return None
+    return out
+
+
+def write_ply_native(path: str, points: np.ndarray,
+                     colors: np.ndarray | None = None,
+                     normals: np.ndarray | None = None) -> bool:
+    """Binary PLY via the native writer. Returns False if unavailable."""
+    lib = _lib()
+    if lib is None:
+        return False
+    pts = np.ascontiguousarray(points, np.float32)
+    n = len(pts)
+    rgb_ptr = None
+    nrm_ptr = None
+    if colors is not None:
+        rgb = np.ascontiguousarray(colors, np.uint8)
+        rgb_ptr = rgb.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    if normals is not None:
+        nrm = np.ascontiguousarray(normals, np.float32)
+        nrm_ptr = nrm.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    rc = lib.slio_write_ply(
+        path.encode(), n, pts.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        rgb_ptr, nrm_ptr)
+    return rc == 0
+
+
+def write_stl_native(path: str, vertices: np.ndarray,
+                     faces: np.ndarray) -> bool:
+    """Binary STL via the native writer. Returns False if unavailable."""
+    lib = _lib()
+    if lib is None:
+        return False
+    v = np.ascontiguousarray(vertices, np.float32)
+    f = np.ascontiguousarray(faces, np.int32)
+    rc = lib.slio_write_stl(
+        path.encode(), len(f),
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        f.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return rc == 0

@@ -42,6 +42,12 @@ def write_ply(path: str, points: np.ndarray, colors: np.ndarray | None = None,
     has_c = colors is not None
     has_n = normals is not None
 
+    if binary and n >= 100_000:
+        from structured_light_for_3d_model_replication_tpu.io import native
+
+        if native.write_ply_native(path, points, colors, normals):
+            return
+
     header = ["ply",
               "format binary_little_endian 1.0" if binary else "format ascii 1.0",
               f"element vertex {n}",

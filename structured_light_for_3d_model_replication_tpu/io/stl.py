@@ -26,6 +26,11 @@ def write_stl(path: str, vertices: np.ndarray, faces: np.ndarray,
     vertices = np.asarray(vertices, np.float32)
     faces = np.asarray(faces, np.int64)
     m = faces.shape[0]
+    if normals is None and m >= 50_000:
+        from structured_light_for_3d_model_replication_tpu.io import native
+
+        if native.write_stl_native(path, vertices, faces):
+            return
     if normals is None:
         normals = face_normals(vertices, faces)
     rec = np.zeros(m, np.dtype([

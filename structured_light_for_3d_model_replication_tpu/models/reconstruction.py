@@ -235,9 +235,19 @@ def chamfer_distance(a, b) -> float:
     """Symmetric mean nearest-neighbor distance between clouds [Na,3], [Nb,3].
     The accuracy metric BASELINE.json tracks (Chamfer vs CPU path)."""
     from structured_light_for_3d_model_replication_tpu.ops import grid as gridlib
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pallas_kernels as pk,
+    )
 
     a = jnp.asarray(a, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
+
+    if pk.use_pallas() and max(a.shape[0], b.shape[0]) <= 131072:
+        def one_way_nn(x, y):
+            _, d2 = pk.nn1(x, y)
+            return float(jnp.sqrt(jnp.maximum(d2, 0.0)).mean())
+
+        return 0.5 * (one_way_nn(a, b) + one_way_nn(b, a))
 
     def one_way(x, y):
         ext = np.asarray(jnp.max(y, 0) - jnp.min(y, 0), np.float64)

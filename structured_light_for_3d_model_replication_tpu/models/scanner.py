@@ -41,7 +41,7 @@ class SLScanner:
                  proj_size: tuple[int, int] = (1920, 1080),
                  row_mode: int = 1, epipolar_tol: float = 2.0,
                  n_sets_col: int = 11, n_sets_row: int = 11,
-                 downsample: int = 1):
+                 downsample: int = 1, plane_eval: str = "table"):
         cw, ch = cam_size
         self.cam_size = cam_size
         self.proj_size = proj_size
@@ -73,15 +73,31 @@ class SLScanner:
         self.plane_col = jnp.asarray(pc)
         self.plane_row = jnp.asarray(pr)
 
+        from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+            _check_plane_eval,
+        )
+
+        _check_plane_eval(plane_eval)
+        use_poly = plane_eval == "quadratic"
+        if use_poly:
+            from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+                poly_from_calib,
+            )
+
+            self.poly_col, self.poly_row = poly_from_calib(calib, jnp)
+        else:
+            self.poly_col = self.poly_row = jnp.zeros((3, 4), jnp.float32)
+
         # static compile key for the module-level jitted kernels; calibration
         # tensors are passed as ARGUMENTS (closure capture would bake them into
         # the executable as constants — megabytes of HLO payload)
         self._static = (proj_size[0], proj_size[1], n_sets_col, n_sets_row,
-                        downsample, self.row_mode)
+                        downsample, self.row_mode, use_poly)
 
     def _fwd(self, frames, shadow, contrast):
         return _scan_forward(frames, shadow, contrast, self.rays, self.oc,
                              self.plane_col, self.plane_row,
+                             self.poly_col, self.poly_row,
                              jnp.float32(self.epipolar_tol), cfg=self._static)
 
     def forward(self, frames, thresh_mode: str = "otsu",
@@ -107,18 +123,19 @@ class SLScanner:
         return _scan_forward_views(frames_v, jnp.asarray(ss, jnp.float32),
                                    jnp.asarray(cs, jnp.float32), self.rays,
                                    self.oc, self.plane_col, self.plane_row,
+                                   self.poly_col, self.poly_row,
                                    jnp.float32(self.epipolar_tol),
                                    cfg=self._static)
 
 
 def _forward_math(frames, shadow, contrast, rays, oc, plane_col, plane_row,
-                  epipolar_tol, cfg):
+                  poly_col, poly_row, epipolar_tol, cfg):
     from structured_light_for_3d_model_replication_tpu.ops.graycode import _decode_impl
     from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
         _triangulate_impl,
     )
 
-    n_cols, n_rows, n_sets_col, n_sets_row, downsample, row_mode = cfg
+    n_cols, n_rows, n_sets_col, n_sets_row, downsample, row_mode, use_poly = cfg
     texture = jnp.repeat(frames[0][..., None], 3, axis=-1).astype(jnp.uint8)
     dec = _decode_impl(frames, texture, shadow, contrast,
                        n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col,
@@ -127,20 +144,21 @@ def _forward_math(frames, shadow, contrast, rays, oc, plane_col, plane_row,
         dec.col_map, dec.row_map, dec.mask, dec.texture,
         rays, oc, plane_col, plane_row,
         row_mode=row_mode, epipolar_tol=epipolar_tol, xp=jnp,
+        poly=(poly_col, poly_row) if use_poly else None,
     )
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _scan_forward(frames, shadow, contrast, rays, oc, plane_col, plane_row,
-                  epipolar_tol, *, cfg):
+                  poly_col, poly_row, epipolar_tol, *, cfg):
     return _forward_math(frames, shadow, contrast, rays, oc, plane_col,
-                         plane_row, epipolar_tol, cfg)
+                         plane_row, poly_col, poly_row, epipolar_tol, cfg)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _scan_forward_views(frames_v, shadow_v, contrast_v, rays, oc, plane_col,
-                        plane_row, epipolar_tol, *, cfg):
+                        plane_row, poly_col, poly_row, epipolar_tol, *, cfg):
     return jax.vmap(
         lambda f, s, c: _forward_math(f, s, c, rays, oc, plane_col, plane_row,
-                                      epipolar_tol, cfg)
+                                      poly_col, poly_row, epipolar_tol, cfg)
     )(frames_v, shadow_v, contrast_v)

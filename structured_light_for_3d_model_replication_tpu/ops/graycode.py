@@ -217,6 +217,24 @@ def _decode_impl(
             f"for a {n_cols}x{n_rows} projector."
         )
 
+    if xp is not np:
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            pallas_kernels as pk,
+        )
+
+        h, w = frames.shape[1], frames.shape[2]
+        if (pk.use_pallas() and frames.dtype == jnp.uint8
+                and h % 8 == 0 and w % 128 == 0):
+            # fused Pallas path: one VMEM pass over the stack (bit-exact twin
+            # of the arithmetic below; gated to tile-aligned frames)
+            col, row, mask = pk.decode_maps_fused(
+                frames, shadow_thresh, contrast_thresh,
+                n_bits_col=max_col_bits, n_bits_row=max_row_bits,
+                n_use_col=n_use_col, n_use_row=n_use_row)
+            return DecodeResult((col * downsample).astype(xp.int32),
+                                (row * downsample).astype(xp.int32),
+                                mask, texture)
+
     fr = frames.astype(xp.int16)
     white = fr[0]
     black = fr[1]

@@ -170,6 +170,12 @@ def radius_count(points: jax.Array, valid: jax.Array, radius,
     """
     n = points.shape[0]
     if n <= _BRUTE_MAX:
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            pallas_kernels as pk,
+        )
+
+        if pk.use_pallas() and exclude_self:
+            return pk.radius_count_pallas(points, valid, radius)
         block_q, block_b, n_pad = _choose_blocks(n, block_q, block_b)
         points, valid = _pad_jax(points, valid, n_pad)
         return _radius_blocks(points, valid, jnp.float32(radius), block_q,

@@ -1,0 +1,314 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+Three kernels where fusing beats what XLA does on its own:
+
+  decode_fused    One pass over the [F, H, W] capture stack in VMEM tiles:
+                  shadow/contrast masks, all per-bit pattern>inverse compares,
+                  the Gray->binary XOR cascade and the coordinate rescale fuse
+                  into a single HBM read of the stack (the reference re-reads
+                  the stack per bit-plane, server/processing.py:88-111; XLA
+                  fuses the compares but still materializes the [bits, H, W]
+                  gray stack between the compare and the cascade).
+
+  nn1             Tiled brute-force nearest neighbor (k=1): the ICP
+                  correspondence step (processing.py:572-582's per-iteration
+                  NN query). Distances via an [Bq,8]x[8,Bb] dot on the MXU,
+                  running min/argmin in VMEM scratch — no sort needed, so it
+                  sidesteps Mosaic's missing top_k lowering.
+
+  radius_count    Neighbor counting for radius outlier removal
+                  (processing.py:430-448): same tiling, accumulates
+                  (d2 <= r^2) counts instead of minima.
+
+Each kernel has the jnp implementation as its twin (ops/knn.py, ops/graycode
+.py); `use_pallas()` gates dispatch to compiled kernels on TPU only, and the
+tests run the kernels in interpreter mode on CPU for bit parity.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["use_pallas", "nn1", "radius_count_pallas", "decode_maps_fused"]
+
+_FAR = 1e9
+
+
+def use_pallas() -> bool:
+    """True when the default backend is a real TPU (Mosaic compile path)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend probe
+        return False
+
+
+def _interpret() -> bool:
+    return not use_pallas()
+
+
+# ---------------------------------------------------------------------------
+# nn1: tiled brute-force 1-nearest-neighbor
+# ---------------------------------------------------------------------------
+
+def _nn1_kernel(q_ref, b_ref, d_ref, i_ref, *, block_b: int, n_base: int):
+    """One query block vs all base blocks. q_ref [Bq, 8], b_ref [Nb, 8]
+    (xyz padded with zeros); outputs d2 [Bq, 1] f32, idx [Bq, 1] i32."""
+    q = q_ref[:]
+    q2 = (q * q).sum(axis=1, keepdims=True)           # [Bq, 1]
+    nb = n_base // block_b
+
+    def body(bi, carry):
+        best_d, best_i = carry
+        b = b_ref[pl.ds(bi * block_b, block_b), :]    # [Bb, 8]
+        cross = jax.lax.dot_general(
+            q, b, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,  # full f32: the d2 expansion
+        )                                         # cancels catastrophically in bf16
+        b2 = (b * b).sum(axis=1)[None, :]             # [1, Bb]
+        d2 = q2 + b2 - 2.0 * cross
+        blk_d = jnp.min(d2, axis=1, keepdims=True)    # [Bq, 1]
+        blk_a = jnp.argmin(d2, axis=1).astype(jnp.int32)[:, None]
+        blk_i = blk_a + bi * block_b
+        better = blk_d < best_d
+        return (jnp.where(better, blk_d, best_d),
+                jnp.where(better, blk_i, best_i))
+
+    init = (jnp.full(q2.shape, jnp.inf, jnp.float32),
+            jnp.zeros(q2.shape, jnp.int32))
+    best_d, best_i = jax.lax.fori_loop(0, nb, body, init)
+    d_ref[:] = jnp.maximum(best_d, 0.0)
+    i_ref[:] = best_i
+
+
+def _pad8(points, valid, n_pad):
+    """[N,3]+mask -> [n_pad, 8] with invalid/padded rows parked far away."""
+    pts = jnp.where(valid[:, None], points.astype(jnp.float32),
+                    jnp.float32(_FAR))
+    n = pts.shape[0]
+    out = jnp.zeros((n_pad, 8), jnp.float32)
+    out = out.at[:n, :3].set(pts)
+    if n_pad > n:
+        out = out.at[n:, :3].set(_FAR)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_b", "interpret"))
+def _nn1_call(q8, b8, block_q: int, block_b: int, interpret: bool):
+    nq_pad = q8.shape[0]
+    nb_pad = b8.shape[0]
+    grid = (nq_pad // block_q,)
+    d2, idx = pl.pallas_call(
+        functools.partial(_nn1_kernel, block_b=block_b, n_base=nb_pad),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, 8), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nb_pad, 8), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((block_q, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_q, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((nq_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((nq_pad, 1), jnp.int32),
+        ),
+        interpret=interpret,
+    )(q8, b8)
+    return d2, idx
+
+
+def nn1(queries, base, base_valid=None, block_q: int = 1024,
+        block_b: int = 1024):
+    """Nearest valid base point for every query. Returns (idx [N] i32,
+    d2 [N] f32). Exact brute force; invalid base rows never match."""
+    queries = jnp.asarray(queries, jnp.float32)
+    base = jnp.asarray(base, jnp.float32)
+    if base_valid is None:
+        base_valid = jnp.ones(base.shape[0], bool)
+    nq = queries.shape[0]
+    nb = base.shape[0]
+    block_q = min(block_q, max(8, 1 << (nq - 1).bit_length()))
+    block_b = min(block_b, max(128, 1 << (nb - 1).bit_length()))
+    nq_pad = -(-nq // block_q) * block_q
+    nb_pad = -(-nb // block_b) * block_b
+    q8 = _pad8(queries, jnp.ones(nq, bool), nq_pad)
+    b8 = _pad8(base, base_valid, nb_pad)
+    d2, idx = _nn1_call(q8, b8, block_q, block_b, _interpret())
+    return idx[:nq, 0], d2[:nq, 0]
+
+
+# ---------------------------------------------------------------------------
+# radius_count: neighbor counting
+# ---------------------------------------------------------------------------
+
+def _radius_kernel(q_ref, b_ref, r2_ref, c_ref, *, block_b: int, n_base: int,
+                   block_q: int):
+    q = q_ref[:]
+    q2 = (q * q).sum(axis=1, keepdims=True)
+    r2 = r2_ref[0]
+    qi = pl.program_id(0)
+    nb = n_base // block_b
+
+    def body(bi, count):
+        b = b_ref[pl.ds(bi * block_b, block_b), :]
+        cross = jax.lax.dot_general(
+            q, b, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        b2 = (b * b).sum(axis=1)[None, :]
+        d2 = q2 + b2 - 2.0 * cross
+        within = d2 <= r2
+        # self-exclusion by global index equality
+        qidx = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_b), 0)
+        bidx = bi * block_b + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_b), 1)
+        within &= qidx != bidx
+        return count + within.sum(axis=1, keepdims=True, dtype=jnp.int32)
+
+    c_ref[:] = jax.lax.fori_loop(0, nb, body,
+                                 jnp.zeros((q.shape[0], 1), jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_b", "interpret"))
+def _radius_call(q8, radius2, block_q: int, block_b: int, interpret: bool):
+    n_pad = q8.shape[0]
+    grid = (n_pad // block_q,)
+    counts = pl.pallas_call(
+        functools.partial(_radius_kernel, block_b=block_b, n_base=n_pad,
+                          block_q=block_q),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, 8), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n_pad, 8), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((block_q, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        interpret=interpret,
+    )(q8, q8, radius2)
+    return counts
+
+
+def radius_count_pallas(points, valid, radius, block_q: int = 1024,
+                        block_b: int = 1024):
+    """Count of valid points within ``radius`` of each point (self excluded).
+    Twin of ops/knn.radius_count's brute path."""
+    points = jnp.asarray(points, jnp.float32)
+    if valid is None:
+        valid = jnp.ones(points.shape[0], bool)
+    n = points.shape[0]
+    block_q = min(block_q, max(8, 1 << (n - 1).bit_length()))
+    block_b = block_q
+    n_pad = -(-n // block_q) * block_q
+    p8 = _pad8(points, valid, n_pad)
+    r2 = jnp.asarray([jnp.float32(radius) ** 2], jnp.float32)
+    counts = _radius_call(p8, r2, block_q, block_b, _interpret())
+    return counts[:n, 0]
+
+
+# ---------------------------------------------------------------------------
+# decode_maps_fused: Gray decode in one pass over the frame stack
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(frames_ref, thr_ref, col_ref, row_ref, mask_ref, *,
+                   n_bits_col: int, n_bits_row: int, n_use_col: int,
+                   n_use_row: int):
+    """frames_ref [F, th, tw] u8 tile; thr_ref [2] f32 (shadow, contrast).
+
+    Bit compares, Gray->binary XOR cascade, rescale shift, and the
+    shadow+contrast mask — all on the tile while it sits in VMEM.
+    """
+    # Mosaic lacks a direct u8->f32 cast; widen through int32 first
+    white = frames_ref[0].astype(jnp.int32).astype(jnp.float32)
+    black = frames_ref[1].astype(jnp.int32).astype(jnp.float32)
+    shadow = thr_ref[0]
+    contrast = thr_ref[1]
+    mask = (white > shadow) & ((white - black) > contrast)
+
+    def decode_axis(start, n_bits, n_use):
+        shape = white.shape
+        binary = jnp.zeros(shape, jnp.int32)
+        gray_prev = jnp.zeros(shape, jnp.int32)
+        for b in range(n_use):  # static unroll: n_use <= 11
+            img_p = frames_ref[start + 2 * b].astype(jnp.int32)
+            img_i = frames_ref[start + 2 * b + 1].astype(jnp.int32)
+            g = (img_p > img_i).astype(jnp.int32)
+            bit = gray_prev ^ g          # XOR cascade: binary bit from gray
+            binary = (binary << 1) | bit
+            gray_prev = bit
+        return binary << (n_bits - n_use)  # coordinate rescale
+
+    col_ref[:] = decode_axis(2, n_bits_col, n_use_col)
+    row_ref[:] = decode_axis(2 + 2 * n_bits_col, n_bits_row, n_use_row)
+    mask_ref[:] = mask
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_bits_col", "n_bits_row", "n_use_col", "n_use_row", "tile_h", "tile_w",
+    "interpret"))
+def _decode_call(frames, thr, n_bits_col: int, n_bits_row: int,
+                 n_use_col: int, n_use_row: int, tile_h: int, tile_w: int,
+                 interpret: bool):
+    f, h, w = frames.shape
+    grid = (h // tile_h, w // tile_w)
+    col, row, mask = pl.pallas_call(
+        functools.partial(_decode_kernel, n_bits_col=n_bits_col,
+                          n_bits_row=n_bits_row, n_use_col=n_use_col,
+                          n_use_row=n_use_row),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((f, tile_h, tile_w), lambda i, j: (0, i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((tile_h, tile_w), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_h, tile_w), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_h, tile_w), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((h, w), jnp.int32),
+            jax.ShapeDtypeStruct((h, w), jnp.int32),
+            jax.ShapeDtypeStruct((h, w), jnp.bool_),
+        ),
+        interpret=interpret,
+    )(frames, thr)
+    return col, row, mask
+
+
+def decode_maps_fused(frames, shadow, contrast, *, n_bits_col: int,
+                      n_bits_row: int, n_use_col: int, n_use_row: int,
+                      tile_h: int = 8, tile_w: int = 256):
+    """Fused col/row/mask decode of a [F, H, W] uint8 stack.
+
+    Equivalent to ops/graycode._decode_impl's map computation (manual
+    thresholds); H and W must divide by the tile (1080p does: 1080 = 135*8,
+    1920 = 7.5*256 -> use tile_w=128 there).
+    """
+    frames = jnp.asarray(frames)
+    f, h, w = frames.shape
+    while h % tile_h:
+        tile_h //= 2
+    while w % tile_w:
+        tile_w //= 2
+    thr = jnp.asarray([shadow, contrast], jnp.float32)
+    return _decode_call(frames, thr, n_bits_col, n_bits_row, n_use_col,
+                        n_use_row, tile_h, tile_w, _interpret())

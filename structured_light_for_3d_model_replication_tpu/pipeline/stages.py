@@ -102,6 +102,7 @@ def reconstruct_source(source, calib: dict, cfg: Config, scanner=None):
         cloud = tri.triangulate_np(
             dec.col_map, dec.row_map, dec.mask, dec.texture, calib,
             row_mode=tcfg.row_mode, epipolar_tol=tcfg.epipolar_tol,
+            plane_eval=tcfg.plane_eval,
         )
     elif scanner is not None:
         cloud = scanner.forward(frames, thresh_mode=dcfg.thresh_mode,
@@ -120,6 +121,7 @@ def reconstruct_source(source, calib: dict, cfg: Config, scanner=None):
         cloud = tri.triangulate(
             dec.col_map, dec.row_map, dec.mask, dec.texture, calib,
             row_mode=tcfg.row_mode, epipolar_tol=tcfg.epipolar_tol,
+            plane_eval=tcfg.plane_eval,
         )
     return tri.compact_cloud(cloud)
 
@@ -154,6 +156,7 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
             epipolar_tol=cfg.triangulate.epipolar_tol,
             n_sets_col=cfg.decode.n_sets_col, n_sets_row=cfg.decode.n_sets_row,
             downsample=cfg.projector.downsample,
+            plane_eval=cfg.triangulate.plane_eval,
         )
 
     report = BatchReport()
