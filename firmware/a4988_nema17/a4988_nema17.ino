@@ -1,0 +1,55 @@
+// Turntable firmware: A4988 driver + NEMA-17, 1/16 microstepping.
+//
+// Serial protocol (115200 baud): receive "<degrees>\n", rotate (blocking),
+// reply "DONE\n". Negative degrees reverse direction. See firmware/README.md.
+
+#include <Arduino.h>
+
+// ---- wiring ----------------------------------------------------------------
+constexpr int PIN_STEP = 26;
+constexpr int PIN_DIR = 27;
+constexpr int PIN_ENABLE = 25;  // active low
+
+// ---- motion ----------------------------------------------------------------
+// 200 full steps/rev * 16 microsteps (MS1=MS2=MS3 high) = 3200 steps/rev
+constexpr long STEPS_PER_REV = 3200;
+constexpr unsigned int STEP_PULSE_US = 500;  // half-period; ~1 kHz step rate
+
+static String line;
+
+static void rotateDegrees(float deg) {
+  digitalWrite(PIN_DIR, deg >= 0 ? HIGH : LOW);
+  long steps = lroundf(fabsf(deg) * STEPS_PER_REV / 360.0f);
+  digitalWrite(PIN_ENABLE, LOW);  // energize
+  for (long i = 0; i < steps; ++i) {
+    digitalWrite(PIN_STEP, HIGH);
+    delayMicroseconds(STEP_PULSE_US);
+    digitalWrite(PIN_STEP, LOW);
+    delayMicroseconds(STEP_PULSE_US);
+  }
+  digitalWrite(PIN_ENABLE, HIGH);  // release (no holding torque needed)
+}
+
+void setup() {
+  pinMode(PIN_STEP, OUTPUT);
+  pinMode(PIN_DIR, OUTPUT);
+  pinMode(PIN_ENABLE, OUTPUT);
+  digitalWrite(PIN_ENABLE, HIGH);
+  Serial.begin(115200);
+  line.reserve(32);
+}
+
+void loop() {
+  while (Serial.available()) {
+    char ch = static_cast<char>(Serial.read());
+    if (ch == '\n' || ch == '\r') {
+      if (line.length()) {
+        rotateDegrees(line.toFloat());
+        Serial.println("DONE");
+        line = "";
+      }
+    } else {
+      line += ch;
+    }
+  }
+}

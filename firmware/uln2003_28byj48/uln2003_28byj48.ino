@@ -1,0 +1,58 @@
+// Turntable firmware: ULN2003 board + 28BYJ-48 geared stepper.
+//
+// Serial protocol (115200 baud): receive "<degrees>\n", rotate (blocking),
+// reply "DONE\n". See firmware/README.md.
+//
+// The 28BYJ-48's internal gearbox ratio is nominally 64:1 but actually
+// 63.68395:1, so steps-per-degree is calibrated as a float rather than
+// derived from the nominal 2048 steps/rev.
+
+#include <Arduino.h>
+#include <Stepper.h>
+
+// ---- wiring (IN1..IN4 on the ULN2003 board) --------------------------------
+constexpr int PIN_IN1 = 19;
+constexpr int PIN_IN2 = 18;
+constexpr int PIN_IN3 = 5;
+constexpr int PIN_IN4 = 17;
+
+// 32 steps/rev rotor * 63.68395 gearbox = 4075.7728 half-steps... the Stepper
+// library drives full steps: 2037.8864 per output rev -> 32.298 per 30 deg of
+// nominal 2048. Calibrated against a printed protractor.
+constexpr float STEPS_PER_DEGREE = 2037.8864f / 360.0f;
+constexpr int RPM = 12;
+
+// Stepper wants the coil order IN1-IN3-IN2-IN4 for this board
+Stepper stepper(2048, PIN_IN1, PIN_IN3, PIN_IN2, PIN_IN4);
+
+static String line;
+
+static void releaseCoils() {  // avoid cooking the motor while idle
+  digitalWrite(PIN_IN1, LOW);
+  digitalWrite(PIN_IN2, LOW);
+  digitalWrite(PIN_IN3, LOW);
+  digitalWrite(PIN_IN4, LOW);
+}
+
+void setup() {
+  stepper.setSpeed(RPM);
+  Serial.begin(115200);
+  line.reserve(32);
+}
+
+void loop() {
+  while (Serial.available()) {
+    char ch = static_cast<char>(Serial.read());
+    if (ch == '\n' || ch == '\r') {
+      if (line.length()) {
+        float deg = line.toFloat();
+        stepper.step(lroundf(deg * STEPS_PER_DEGREE));
+        releaseCoils();
+        Serial.println("DONE");
+        line = "";
+      }
+    } else {
+      line += ch;
+    }
+  }
+}

@@ -68,16 +68,23 @@ int decode_gray(const char* path, uint8_t* dst, int exp_w, int exp_h) {
   png_structp png =
       png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr, nullptr, nullptr);
   png_infop info = png ? png_create_info_struct(png) : nullptr;
+  // raw buffer, not std::vector: a libpng error longjmps to the setjmp below,
+  // which would skip a vector destructor (UB) — free on both exits instead
+  uint8_t* row = nullptr;
   if (!png || !info || setjmp(png_jmpbuf(png))) {
     png_destroy_read_struct(&png, &info, nullptr);
     std::fclose(f);
+    std::free(row);
     return 2;
   }
   png_init_io(png, f);
   png_read_info(png, info);
   int w = static_cast<int>(png_get_image_width(png, info));
   int h = static_cast<int>(png_get_image_height(png, info));
-  if (w != exp_w || h != exp_h) {
+  // per-row streaming below is wrong for Adam7 passes; hand interlaced files
+  // (rare re-exports) to the Python loader instead
+  if (w != exp_w || h != exp_h ||
+      png_get_interlace_type(png, info) != PNG_INTERLACE_NONE) {
     png_destroy_read_struct(&png, &info, nullptr);
     std::fclose(f);
     return 3;
@@ -91,15 +98,20 @@ int decode_gray(const char* path, uint8_t* dst, int exp_w, int exp_h) {
   png_read_update_info(png, info);
   int ch = static_cast<int>(png_get_channels(png, info));
 
-  std::vector<uint8_t> row(static_cast<size_t>(w) * ch);
+  row = static_cast<uint8_t*>(std::malloc(static_cast<size_t>(w) * ch));
+  if (!row) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    std::fclose(f);
+    return 4;
+  }
   for (int y = 0; y < h; ++y) {
-    png_read_row(png, row.data(), nullptr);
+    png_read_row(png, row, nullptr);
     uint8_t* out = dst + static_cast<size_t>(y) * w;
     if (ch == 1) {
-      std::memcpy(out, row.data(), w);
+      std::memcpy(out, row, w);
     } else if (ch >= 3) {  // RGB / RGBA
       for (int x = 0; x < w; ++x) {
-        const uint8_t* p = row.data() + static_cast<size_t>(x) * ch;
+        const uint8_t* p = row + static_cast<size_t>(x) * ch;
         // truncating descale tracks cv2 5.x's SIMD path (~99% exact, +-1)
         out[x] = static_cast<uint8_t>(
             (p[0] * 4899 + p[1] * 9617 + p[2] * 1868) >> 14);
@@ -110,6 +122,7 @@ int decode_gray(const char* path, uint8_t* dst, int exp_w, int exp_h) {
   }
   png_destroy_read_struct(&png, &info, nullptr);
   std::fclose(f);
+  std::free(row);
   return 0;
 }
 
@@ -167,7 +180,10 @@ int slio_write_ply(const char* path, int64_t n, const float* xyz,
     header +=
         "property uchar red\nproperty uchar green\nproperty uchar blue\n";
   header += "end_header\n";
-  std::fwrite(header.data(), 1, header.size(), f);
+  if (std::fwrite(header.data(), 1, header.size(), f) != header.size()) {
+    std::fclose(f);
+    return 2;
+  }
 
   const size_t stride =
       3 * sizeof(float) + (normals ? 3 * sizeof(float) : 0) + (rgb ? 3 : 0);
@@ -196,8 +212,8 @@ int slio_write_ply(const char* path, int64_t n, const float* xyz,
       return 2;
     }
   }
-  std::fclose(f);
-  return 0;
+  // fclose flushes stdio buffers — an ENOSPC can first surface here
+  return std::fclose(f) == 0 ? 0 : 3;
 }
 
 // ---------------------------------------------------------------------------
@@ -210,9 +226,11 @@ int slio_write_stl(const char* path, int64_t n_faces, const float* vertices,
   if (!f) return 1;
   uint8_t hdr[80] = {0};
   std::memcpy(hdr, "slio native stl", 15);
-  std::fwrite(hdr, 1, 80, f);
   uint32_t nf = static_cast<uint32_t>(n_faces);
-  std::fwrite(&nf, 4, 1, f);
+  if (std::fwrite(hdr, 1, 80, f) != 80 || std::fwrite(&nf, 4, 1, f) != 1) {
+    std::fclose(f);
+    return 2;
+  }
 
   struct __attribute__((packed)) Tri {
     float n[3];
@@ -255,8 +273,7 @@ int slio_write_stl(const char* path, int64_t n_faces, const float* vertices,
       return 2;
     }
   }
-  std::fclose(f);
-  return 0;
+  return std::fclose(f) == 0 ? 0 : 3;
 }
 
 // Version tag for the ctypes binding to sanity-check.

@@ -243,6 +243,18 @@ class _Handler(BaseHTTPRequestHandler):
         self._json({"status": "ok", "path": path})
 
 
+def default_capture_page() -> str | None:
+    """The bundled phone capture client (capture_page.html) — the browser-PWA
+    equivalent (frontend/App.tsx capability), served at GET /."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "capture_page.html")
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except OSError:  # pragma: no cover - packaging problem only
+        return None
+
+
 class CaptureServer:
     """Threaded capture server + the pipeline-side rendezvous API."""
 
@@ -250,6 +262,8 @@ class CaptureServer:
                  poll_hold: float = 2.0, disconnect_after: float = 5.0,
                  capture_page: str | None = None,
                  upload_dir: str | None = None):
+        if capture_page is None:
+            capture_page = default_capture_page()
         self.state = CaptureState(disconnect_after=disconnect_after,
                                   fallback_dir=upload_dir)
         self._httpd = ThreadingHTTPServer((host, port), _Handler)

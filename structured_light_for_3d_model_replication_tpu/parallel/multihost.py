@@ -1,0 +1,79 @@
+"""Multi-host (multi-process) device-mesh bring-up.
+
+The reference is single-node; its only cross-machine links are the
+acquisition-side HTTP/serial protocols (SURVEY.md section 5). The TPU build's
+compute-side equivalent is a jax.distributed process group over ICI/DCN:
+every host calls ``initialize()``, then builds one global Mesh spanning all
+hosts' devices with ``global_mesh()``; pjit/shard_map programs written
+against parallel/scan.py then run unchanged — XLA routes collectives over ICI
+within a slice and DCN across slices.
+
+On a single process this degrades gracefully: ``initialize()`` is a no-op and
+``global_mesh()`` equals the local mesh, so the same pipeline code serves a
+laptop, one TPU VM, or a multi-host pod.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["initialize", "global_mesh", "is_multiprocess", "process_summary"]
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """Join the jax.distributed process group when multi-host settings are
+    present (flags or the standard env vars); returns True when distributed
+    mode is active. Safe to call more than once."""
+    import jax
+
+    env_addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    addr = coordinator_address or env_addr
+    nproc = num_processes if num_processes is not None else (
+        int(env_np) if env_np else None)
+    if addr is None and nproc is None:
+        return jax.process_count() > 1  # auto-initialized runtimes (e.g. pods)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=nproc,
+            process_id=process_id if process_id is not None else (
+                int(os.environ.get("JAX_PROCESS_ID", "0"))),
+        )
+    except RuntimeError as e:  # already initialized
+        if "already" not in str(e).lower():
+            raise
+    return True
+
+
+def is_multiprocess() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def global_mesh(n_data: int | None = None, n_model: int | None = None):
+    """Mesh over every device of every process (vs make_mesh's local view).
+    Axis semantics match parallel/mesh.py: ('data', 'model') = (views,
+    pixel-rows)."""
+    import jax
+
+    from structured_light_for_3d_model_replication_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    return make_mesh(n_data=n_data, n_model=n_model, devices=jax.devices())
+
+
+def process_summary() -> dict:
+    """Topology facts for logs and failure reports."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "platform": jax.default_backend(),
+    }

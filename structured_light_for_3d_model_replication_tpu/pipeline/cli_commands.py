@@ -95,6 +95,9 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p = sub.add_parser("inspect-calib",
                        help="human-readable calibration summary (quality bands)")
     p.add_argument("calib", help="calibration file (.mat/.npz)")
+    p.add_argument("--plot", default=None, metavar="PNG",
+                   help="also render the 3-D rig geometry plot (Calib Check "
+                        "tab parity) to this PNG")
     add_config_args(p)
 
     p = sub.add_parser("patterns", help="write the Gray-code pattern stack")
@@ -208,6 +211,11 @@ def _cmd_inspect(args) -> int:
 
     calib = matfile.load_calibration(args.calib)
     print(ci.format_summary(ci.summarize_calibration(calib)))
+    if args.plot:
+        from structured_light_for_3d_model_replication_tpu.calib import visualize
+
+        info = visualize.plot_rig(calib, args.plot)
+        print(f"rig plot -> {info['plot']}")
     return 0
 
 

@@ -25,6 +25,7 @@ from structured_light_for_3d_model_replication_tpu.io import images as imio
 from structured_light_for_3d_model_replication_tpu.io import matfile, ply
 from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
 from structured_light_for_3d_model_replication_tpu.ops import triangulate as tri
+from structured_light_for_3d_model_replication_tpu.utils import profiling as prof
 
 __all__ = [
     "BatchReport", "reconstruct_source", "reconstruct", "clean_cloud",
@@ -160,11 +161,13 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
         )
 
     report = BatchReport()
+    timer = prof.StageTimer()
     t0 = time.monotonic()
     for src in sources:
         name = os.path.basename(os.path.normpath(src)) or "cloud"
         try:
-            pts, cols = reconstruct_source(src, calib, cfg, scanner)
+            with timer.stage(name), prof.trace():
+                pts, cols = reconstruct_source(src, calib, cfg, scanner)
             if mode == "single" and output:
                 out_path = output
             elif output:
@@ -180,6 +183,7 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
             report.failed.append((src, str(e)))
     report.elapsed_s = time.monotonic() - t0
     log(f"[reconstruct] {report.summary}")
+    prof.get_logger().debug("reconstruct stage timing:\n%s", timer.report())
     return report
 
 
@@ -282,11 +286,13 @@ def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
             c = np.zeros_like(d["points"], dtype=np.uint8)
         clouds.append((np.asarray(d["points"], np.float32), np.asarray(c, np.uint8)))
 
-    if cfg.merge.method == "posegraph":
-        points, colors, transforms = recon.merge_360_posegraph(
-            clouds, cfg.merge, log=log)
-    else:
-        points, colors, transforms = recon.merge_360(clouds, cfg.merge, log=log)
+    with prof.trace():
+        if cfg.merge.method == "posegraph":
+            points, colors, transforms = recon.merge_360_posegraph(
+                clouds, cfg.merge, log=log)
+        else:
+            points, colors, transforms = recon.merge_360(clouds, cfg.merge,
+                                                         log=log)
     ply.write_ply(output_ply, points, colors)
     log(f"[merge] wrote {output_ply} ({len(points):,} points)")
     return points, colors, transforms
@@ -319,8 +325,9 @@ def mesh_cloud(input_ply: str, output_path: str, cfg: Config | None = None,
         ply.write_ply(save_normals_path, pts, data.get("colors"), normals)
         log(f"[mesh] normals debug cloud -> {save_normals_path}")
 
-    verts, faces = meshing.reconstruct_mesh(pts, valid, normals,
-                                            cfg=cfg.mesh, log=log)
+    with prof.trace():
+        verts, faces = meshing.reconstruct_mesh(pts, valid, normals,
+                                                cfg=cfg.mesh, log=log)
     if output_path.lower().endswith(".stl"):
         meshing.mesh_to_stl(output_path, verts, faces)
     else:

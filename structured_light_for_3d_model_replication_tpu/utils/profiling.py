@@ -1,0 +1,134 @@
+"""Per-stage timing, structured logging, and TPU profiler hooks.
+
+The reference has no tracing or metrics at all — its only instrumentation is
+wall-clock elapsed/remaining in the auto-scan popup (server/gui.py:1740-1783)
+and bare print() calls with a Tk log_callback. This module supplies the
+observability layer SURVEY.md section 5 calls for:
+
+  - ``StageTimer``: nested context-managed stage timing with a queryable
+    report (the artifact-per-stage pipeline wraps each stage).
+  - ``trace``: context manager around ``jax.profiler`` so any stage can emit
+    a TensorBoard-loadable device trace (set ``SL3D_TRACE_DIR`` or pass a
+    path).
+  - ``get_logger``: stdlib logging with levels, honoring ``SL3D_LOG`` and
+    forwarding to reference-style ``log_callback`` sinks so GUI/CLI share one
+    stream.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+
+__all__ = ["StageTimer", "trace", "get_logger", "attach_callback"]
+
+_LOGGER_NAME = "sl3d"
+
+
+def get_logger(name: str = _LOGGER_NAME) -> logging.Logger:
+    """Framework logger; level from SL3D_LOG (DEBUG/INFO/WARNING, default INFO)."""
+    logger = logging.getLogger(name)
+    if not getattr(logger, "_sl3d_configured", False):
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S"))
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get("SL3D_LOG", "INFO").upper())
+        logger.propagate = False
+        logger._sl3d_configured = True  # type: ignore[attr-defined]
+    return logger
+
+
+class _CallbackHandler(logging.Handler):
+    def __init__(self, callback):
+        super().__init__()
+        self._cb = callback
+
+    def emit(self, record):  # pragma: no cover - passthrough
+        self._cb(self.format(record))
+
+
+def attach_callback(callback, level=logging.INFO) -> logging.Handler:
+    """Forward the framework log to a reference-style ``log_callback(str)``
+    sink (the Tk text-widget pattern, server/processing.py:272-274). Returns
+    the handler so callers can detach it."""
+    h = _CallbackHandler(callback)
+    h.setLevel(level)
+    h.setFormatter(logging.Formatter("%(message)s"))
+    get_logger().addHandler(h)
+    return h
+
+
+@dataclass
+class _Record:
+    name: str
+    elapsed_s: float
+    depth: int
+
+
+@dataclass
+class StageTimer:
+    """Nested stage timing:
+
+        timer = StageTimer()
+        with timer.stage("decode"):
+            ...
+        with timer.stage("merge"):
+            with timer.stage("merge/icp"):
+                ...
+        print(timer.report())
+    """
+
+    records: list[_Record] = field(default_factory=list)
+    _depth: int = 0
+
+    @contextlib.contextmanager
+    def stage(self, name: str, log=None):
+        t0 = time.perf_counter()
+        self._depth += 1
+        try:
+            yield self
+        finally:
+            self._depth -= 1
+            dt = time.perf_counter() - t0
+            self.records.append(_Record(name, dt, self._depth))
+            if log is not None:
+                log(f"[timing] {name}: {dt:.3f}s")
+
+    def total(self, name: str) -> float:
+        return sum(r.elapsed_s for r in self.records if r.name == name)
+
+    def as_dict(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for r in self.records:
+            out[r.name] = out.get(r.name, 0.0) + r.elapsed_s
+        return out
+
+    def report(self) -> str:
+        # records complete innermost-first; display in completion order with
+        # indentation from nesting depth
+        lines = [f"{'  ' * r.depth}{r.name:<32} {r.elapsed_s:9.3f}s"
+                 for r in self.records]
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(trace_dir: str | None = None):
+    """Device-level profiler trace around a block (TensorBoard format).
+
+    No-ops unless a directory is given or ``SL3D_TRACE_DIR`` is set — safe to
+    leave in production paths.
+    """
+    trace_dir = trace_dir or os.environ.get("SL3D_TRACE_DIR")
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

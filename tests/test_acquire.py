@@ -286,3 +286,14 @@ def test_unarmed_upload_falls_back_to_dir(tmp_path):
         assert drops[0].read_bytes() == b"manualframe"
     finally:
         srv.stop()
+
+
+def test_capture_page_served_at_root(server):
+    with urllib.request.urlopen(f"http://127.0.0.1:{server.port}/",
+                                timeout=5) as r:
+        body = r.read().decode()
+    assert r.headers["Content-Type"].startswith("text/html")
+    # the client must speak the wire protocol: poll + multipart upload + dedup
+    for token in ("/poll_command", "/upload", "lastProcessedId",
+                  "applyConstraints", "FormData"):
+        assert token in body, token

@@ -1,0 +1,89 @@
+"""Webcam backend (O9 parity), calib rig plot (Calib Check tab parity), and
+multi-host mesh helpers."""
+import os
+
+import numpy as np
+import pytest
+
+
+class _FakeCap:
+    def __init__(self, device):
+        self.device = device
+        self.opened = True
+        self.grabs = 0
+
+    def isOpened(self):
+        return True
+
+    def set(self, *_):
+        return True
+
+    def grab(self):
+        self.grabs += 1
+
+    def read(self):
+        frame = np.full((48, 64, 3), 90, np.uint8)
+        frame[10:20, 10:20] = 200
+        return True, frame
+
+    def release(self):
+        self.opened = False
+
+
+def test_webcam_capture_contract(tmp_path, monkeypatch):
+    import cv2
+
+    from structured_light_for_3d_model_replication_tpu.acquire import webcam
+
+    monkeypatch.setattr(cv2, "VideoCapture", _FakeCap)
+    out = str(tmp_path / "cap.png")
+    with webcam.WebcamCapture(device=0, warmup_frames=2) as cam:
+        path = cam(out)
+        assert cam.cap.grabs == 2  # AE settle frames consumed
+    assert path == out and os.path.exists(out)
+    assert not cam.cap.opened  # released on exit
+
+
+def test_webcam_in_sequencer(tmp_path, monkeypatch):
+    import cv2
+
+    from structured_light_for_3d_model_replication_tpu.acquire import webcam
+    from structured_light_for_3d_model_replication_tpu.acquire.projector import (
+        VirtualProjector,
+    )
+    from structured_light_for_3d_model_replication_tpu.acquire.sequencer import (
+        CaptureSequencer,
+    )
+
+    monkeypatch.setattr(cv2, "VideoCapture", _FakeCap)
+    cam = webcam.WebcamCapture()
+    seq = CaptureSequencer(VirtualProjector(), cam, proj_size=(64, 32),
+                           log=lambda *a: None)
+    paths = seq.capture_scan(str(tmp_path / "scan"))
+    assert len(paths) == 24  # 2 + 2*(6+5) for 64x32
+    assert all(os.path.exists(p) for p in paths)
+
+
+def test_plot_rig_renders_png(tmp_path):
+    from structured_light_for_3d_model_replication_tpu.calib import visualize
+    from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+
+    calib = syn.default_rig().calibration()
+    out = str(tmp_path / "rig.png")
+    info = visualize.plot_rig(calib, out)
+    assert os.path.exists(out) and os.path.getsize(out) > 10_000
+    assert info["baseline_mm"] == pytest.approx(
+        float(np.linalg.norm(np.asarray(calib["T"]))), rel=1e-6)
+
+
+def test_multihost_single_process():
+    import jax
+
+    from structured_light_for_3d_model_replication_tpu.parallel import multihost
+
+    assert multihost.is_multiprocess() is False
+    assert multihost.initialize() is False  # no coordinator configured
+    mesh = multihost.global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    s = multihost.process_summary()
+    assert s["process_count"] == 1 and s["global_devices"] >= 1

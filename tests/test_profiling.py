@@ -1,0 +1,55 @@
+"""Observability layer: stage timers, logger callback fan-out, trace no-op."""
+import logging
+import time
+
+from structured_light_for_3d_model_replication_tpu.utils import profiling as prof
+
+
+def test_stage_timer_nesting_and_totals():
+    t = prof.StageTimer()
+    with t.stage("outer"):
+        with t.stage("inner"):
+            time.sleep(0.01)
+        with t.stage("inner"):
+            time.sleep(0.01)
+    d = t.as_dict()
+    assert d["inner"] >= 0.02
+    assert d["outer"] >= d["inner"]
+    rep = t.report()
+    assert "outer" in rep and "  inner" in rep  # depth-indented
+
+
+def test_stage_timer_log_hook():
+    msgs = []
+    t = prof.StageTimer()
+    with t.stage("decode", log=msgs.append):
+        pass
+    assert msgs and msgs[0].startswith("[timing] decode:")
+
+
+def test_logger_callback_attach_detach():
+    lines = []
+    h = prof.attach_callback(lines.append)
+    logger = prof.get_logger()
+    logger.info("hello from test")
+    logger.removeHandler(h)
+    logger.info("after detach")
+    assert any("hello from test" in ln for ln in lines)
+    assert not any("after detach" in ln for ln in lines)
+
+
+def test_trace_noop_without_dir(monkeypatch):
+    monkeypatch.delenv("SL3D_TRACE_DIR", raising=False)
+    with prof.trace():
+        x = 1 + 1
+    assert x == 2
+
+
+def test_trace_writes_profile(tmp_path):
+    import jax.numpy as jnp
+
+    with prof.trace(str(tmp_path)):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    # the profiler lays down a plugins/profile/<ts>/ tree
+    found = list(tmp_path.rglob("*.xplane.pb"))
+    assert found, list(tmp_path.rglob("*"))
