@@ -1,18 +1,35 @@
-"""Benchmark: full 360-degree scan compute (24 views x 46 frames @ 1080p),
-Gray decode + ray-plane triangulation, TPU (flagship SLScanner path) vs the
-bit-exact NumPy CPU backend.
+"""Benchmark: the full 360-degree scan compute of the north star
+(BASELINE.json): decode+triangulate of 24 views x 46 frames @1080p, the
+360-degree merge, and the Chamfer distance vs the bit-exact NumPy CPU path.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-  value        wall-clock seconds for all 24 views on the TPU (data resident
-               in HBM, steady state, best of 3)
-  vs_baseline  NumPy-backend seconds for the same work / TPU seconds (speedup;
-               the reference publishes no numbers — BASELINE.md records
-               "published: {}" — so its own single-process CPU path, which our
-               NumPy backend reproduces, is the baseline)
+Prints exactly ONE JSON line on stdout:
+
+  {"metric": "full_360_decode_triangulate_merge_wall", "value": <s>,
+   "unit": "s",
+   "vs_baseline": <numpy_baseline_s / decode_triangulate_s — the speedup on
+                   the phase the NumPy reference path actually runs (the
+                   reference has no merge twin to time)>,
+   "decode_triangulate_s", "mpix_per_s", "merge_s", "chamfer_mm",
+   "backend", "pallas", "views_measured", "error"}
+
+Robustness contract (round-1 verdict item 1):
+  - the synthetic 1080p scene + 24 turntable merge clouds are rendered ONCE
+    and cached in .bench_cache.npz; subsequent runs skip the ~60 s render
+  - every jax phase runs in a CHILD process under a hard timeout; a hung
+    TPU backend (axon init flakiness, observed >240 s in round 1) is killed
+    and the run retried with a forced-CPU child — a JSON line is ALWAYS
+    printed, carrying partial results plus an "error" note when degraded
+  - the child persists partial results after each phase so a mid-run hang
+    still yields the completed phases
+  - all progress goes to stderr; stdout carries only the final JSON line
 """
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -20,72 +37,335 @@ import numpy as np
 N_VIEWS = 24
 CAM = (1920, 1080)
 PROJ = (1920, 1080)
-NP_MEASURE_VIEWS = 3  # NumPy path is linear in views; measure 3, scale
+MERGE_CAM = (480, 360)      # merge-phase views: camera res of the turntable rig
+MERGE_PROJ = (512, 256)
+CPU_FALLBACK_VIEWS = 4      # forced-CPU child measures 4 views, extrapolates
+ROOT = os.path.dirname(os.path.abspath(__file__))
+CACHE = os.path.join(ROOT, ".bench_cache.npz")
+CHILD_TIMEOUT_TPU = 420
+CHILD_TIMEOUT_CPU = 600
+PARENT_DEADLINE = 1500      # absolute last resort: emit an error line and exit
 
 
-def make_view_stack(rig) -> np.ndarray:
-    """Render the canonical sphere-on-wall scene through the full rig so the
-    decode+triangulate output carries real valid points (not just masked
-    throughput)."""
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# scene cache (parent, numpy only — must never touch the jax backend)
+# ---------------------------------------------------------------------------
+
+def _merge_scene():
+    """An asymmetric rigid object (3 spheres) — a single sphere is rotation-
+    invariant about the turntable axis and would make registration ill-posed."""
     from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
 
-    frames, _ = syn.render_scene(rig, syn.sphere_on_background())
-    return frames
+    return syn.Scene([
+        syn.Sphere(np.array([0.0, 0.0, 420.0]), 70.0),
+        syn.Sphere(np.array([55.0, -40.0, 360.0]), 28.0),
+        syn.Sphere(np.array([-48.0, 35.0, 370.0]), 22.0),
+    ])
 
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
-
-    from structured_light_for_3d_model_replication_tpu.models.scanner import SLScanner
+def build_cache() -> dict:
     from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
     from structured_light_for_3d_model_replication_tpu.ops import triangulate as tri
     from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
 
-    rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
-    calib = rig.calibration()
-    frames = make_view_stack(rig)
-
-    # ---- NumPy CPU backend (the reference-equivalent path) ----
     t0 = time.perf_counter()
-    for _ in range(NP_MEASURE_VIEWS):
+    log("cache miss: rendering 1080p flagship scene...")
+    rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+    frames, _ = syn.render_scene(rig, syn.sphere_on_background())
+
+    log(f"rendering {N_VIEWS} turntable views at {MERGE_CAM} + NumPy decode...")
+    mrig = syn.default_rig(cam_size=MERGE_CAM, proj_size=MERGE_PROJ)
+    mcalib = mrig.calibration()
+    scene = _merge_scene()
+    poses = syn.turntable_poses(N_VIEWS, 360.0 / N_VIEWS,
+                                pivot=np.array([0.0, 0.0, 400.0]))
+    pts_list, col_list = [], []
+    for R, t in poses:
+        vf, _ = syn.render_scene(mrig, scene.transformed(R, t))
+        dec = gc.decode_stack_np(vf, n_cols=MERGE_PROJ[0], n_rows=MERGE_PROJ[1],
+                                 thresh_mode="manual")
+        cloud = tri.triangulate_np(dec.col_map, dec.row_map, dec.mask,
+                                   dec.texture, mcalib, row_mode=1)
+        p, c = tri.compact_cloud(cloud)
+        pts_list.append(p.astype(np.float32))
+        col_list.append(c.astype(np.uint8))
+
+    log("NumPy-backend 1080p reference cloud (Chamfer baseline)...")
+    dec = gc.decode_stack_np(frames, thresh_mode="manual")
+    cloud = tri.triangulate_np(dec.col_map, dec.row_map, dec.mask, dec.texture,
+                               rig.calibration(), row_mode=1)
+    np_pts, _ = tri.compact_cloud(cloud)
+
+    off = np.cumsum([0] + [len(p) for p in pts_list]).astype(np.int64)
+    data = dict(frames=frames,
+                np_pts=np_pts.astype(np.float32),
+                merge_pts=np.concatenate(pts_list),
+                merge_cols=np.concatenate(col_list),
+                merge_off=off)
+    np.savez(CACHE, **data)
+    log(f"cache built in {time.perf_counter() - t0:.1f}s -> {CACHE}")
+    return data
+
+
+def load_cache() -> dict:
+    if os.path.exists(CACHE):
+        try:
+            with np.load(CACHE) as z:
+                data = {k: z[k] for k in z.files}
+            if data["frames"].shape[1:] == (CAM[1], CAM[0]):
+                log(f"cache hit: {CACHE}")
+                return data
+            log("cache shape mismatch; rebuilding")
+        except Exception as e:  # corrupt cache: rebuild
+            log(f"cache unreadable ({e}); rebuilding")
+    return build_cache()
+
+
+# ---------------------------------------------------------------------------
+# child: all jax work, per-phase persisted results
+# ---------------------------------------------------------------------------
+
+def child_main(out_path: str, views: int, force_cpu: bool) -> None:
+    res: dict = {"backend": None, "pallas": None}
+
+    def save() -> None:
+        with open(out_path + ".tmp", "w") as f:
+            json.dump(res, f)
+        os.replace(out_path + ".tmp", out_path)
+
+    import jax
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        dev = jax.devices()[0]
+    except Exception as e:
+        log(f"child: backend init failed ({type(e).__name__}); forcing CPU")
+        jax.config.update("jax_platforms", "cpu")
+        dev = jax.devices()[0]
+        res["backend_error"] = str(e)[:200]
+        views = min(views, CPU_FALLBACK_VIEWS)  # CPU can't afford 24 full views
+    res["backend"] = dev.platform
+    log(f"child: backend={dev.platform} device={dev}")
+
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.models.reconstruction import (
+        chamfer_distance, merge_360,
+    )
+    from structured_light_for_3d_model_replication_tpu.models.scanner import SLScanner
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pallas_kernels as pk,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+
+    res["pallas"] = pk.pallas_mode()
+    log(f"child: pallas={res['pallas']}")
+    save()
+
+    cache = load_cache()
+
+    # ---- phase A: decode+triangulate, `views` views @1080p, ONE launch ----
+    rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+    scanner = SLScanner(rig.calibration(), CAM, PROJ, row_mode=1)
+    base = jax.block_until_ready(jnp.asarray(cache["frames"]))
+    t0 = time.perf_counter()
+    # distinct per-view content via device-side rolls (one 95 MB upload, not 24)
+    views_dev = jax.block_until_ready(
+        jnp.stack([jnp.roll(base, i * 7, axis=2) for i in range(views)]))
+    log(f"child: view stack {views_dev.shape} resident "
+        f"({time.perf_counter() - t0:.1f}s)")
+
+    def run():
+        out = scanner.forward_views(views_dev, thresh_mode="manual",
+                                    shadow_val=40.0, contrast_val=10.0)
+        jax.block_until_ready(out.points)
+        return out
+
+    t0 = time.perf_counter()
+    out = run()  # compile + warm
+    log(f"child: phase A compile+warm {time.perf_counter() - t0:.1f}s")
+    n_rep = 3 if res["backend"] != "cpu" else 1
+    best = np.inf
+    for _ in range(n_rep):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    scale = N_VIEWS / views
+    res["decode_triangulate_s"] = round(best * scale, 4)
+    res["views_measured"] = views
+    res["mpix_per_s"] = round(N_VIEWS * CAM[0] * CAM[1] / (best * scale) / 1e6, 1)
+    n_valid0 = int(np.asarray(out.valid[0]).sum())
+    assert n_valid0 > 0, "bench scene produced no valid points"
+    log(f"child: phase A best {best:.3f}s for {views} views "
+        f"(={res['mpix_per_s']} Mpix/s, {n_valid0} valid pts in view 0)")
+    save()
+
+    # ---- phase C before B (cheap): Chamfer vs the NumPy reference cloud ----
+    jx_pts = np.asarray(out.points[0])[np.asarray(out.valid[0])]
+    np_pts = cache["np_pts"]
+    res["chamfer_mm"] = round(
+        float(chamfer_distance(jx_pts[::8], np_pts[::8])), 6)
+    log(f"child: Chamfer jax-vs-numpy = {res['chamfer_mm']} mm "
+        f"({len(jx_pts)} vs {len(np_pts)} pts)")
+    save()
+
+    # ---- phase B: 360-degree merge of the turntable clouds ----
+    off = cache["merge_off"]
+    clouds = [(cache["merge_pts"][off[i]:off[i + 1]],
+               cache["merge_cols"][off[i]:off[i + 1]])
+              for i in range(len(off) - 1)]
+    fits: list[float] = []
+
+    def merge_log(msg):
+        if "fit" in msg:
+            try:
+                fits.append(float(msg.split("ICP fit ")[1].split(" ")[0]))
+            except Exception:
+                pass
+        log(f"child: {msg}")
+
+    t0 = time.perf_counter()
+    merged_p, _, _ = merge_360(clouds, log=merge_log)
+    res["merge_s"] = round(time.perf_counter() - t0, 3)
+    res["merge_points"] = int(len(merged_p))
+    res["merge_icp_fit_mean"] = round(float(np.mean(fits)), 3) if fits else None
+    log(f"child: phase B merge {res['merge_s']}s, {len(merged_p)} pts, "
+        f"mean ICP fitness {res['merge_icp_fit_mean']}")
+    save()
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestrate with hard timeouts; always print one JSON line
+# ---------------------------------------------------------------------------
+
+def _run_child(args: list[str], timeout: int) -> dict | None:
+    out_path = os.path.join(ROOT, ".bench_child.json")
+    if os.path.exists(out_path):
+        os.remove(out_path)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", out_path] + args
+    log(f"spawning child: {' '.join(cmd[2:])} (timeout {timeout}s)")
+    try:
+        # child stdout -> our stderr: the parent's stdout must carry ONLY the
+        # final JSON line, and backend init noise would corrupt it
+        proc = subprocess.run(cmd, stdout=sys.stderr, timeout=timeout)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        log("child TIMED OUT (killed)")
+        rc = -9
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            res = json.load(f)
+        res["child_rc"] = rc
+        return res
+    return None
+
+
+def emit(final: dict) -> None:
+    print(json.dumps(final), flush=True)
+
+
+def main() -> None:
+    final = {
+        "metric": "full_360_decode_triangulate_merge_wall",
+        "value": None, "unit": "s", "vs_baseline": None, "error": None,
+    }
+
+    def alarm_handler(signum, frame):
+        final["error"] = (final.get("error") or "") + "; parent deadline hit"
+        emit(final)
+        os._exit(0)
+
+    signal.signal(signal.SIGALRM, alarm_handler)
+    signal.alarm(PARENT_DEADLINE)
+
+    try:
+        cache = load_cache()
+
+        # NumPy baseline: 1 view @1080p decode+triangulate, scaled to 24
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            graycode as gc,
+            triangulate as tri,
+        )
+        from structured_light_for_3d_model_replication_tpu.utils import (
+            synthetic as syn,
+        )
+
+        rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+        calib = rig.calibration()
+        frames = cache["frames"]
+        t0 = time.perf_counter()
         dec = gc.decode_stack_np(frames, thresh_mode="manual")
         tri.triangulate_np(dec.col_map, dec.row_map, dec.mask, dec.texture,
                            calib, row_mode=1)
-    np_s = (time.perf_counter() - t0) / NP_MEASURE_VIEWS * N_VIEWS
+        np_s = (time.perf_counter() - t0) * N_VIEWS
+        log(f"NumPy baseline: {np_s / N_VIEWS:.2f}s/view -> {np_s:.1f}s for "
+            f"{N_VIEWS} views")
+        final["numpy_baseline_s"] = round(np_s, 2)
 
-    # ---- TPU flagship path: per-view stacks resident in HBM ----
-    scanner = SLScanner(calib, CAM, PROJ, row_mode=1)
-    base_dev = jnp.asarray(frames)
-    views = [jnp.roll(base_dev, i * 7, axis=2) for i in range(N_VIEWS)]
-    views = [jax.block_until_ready(v) for v in views]
-    s = jnp.float32(40.0)
-    c = jnp.float32(10.0)
+        res = _run_child([f"--views={N_VIEWS}"], CHILD_TIMEOUT_TPU)
+        complete = res is not None and "merge_s" in res
+        if not complete:
+            note = "ambient-backend child incomplete"
+            if res is not None:
+                note += f" (got phases: {sorted(res.keys())})"
+            log(note + "; retrying with forced CPU")
+            final["error"] = "tpu child failed; cpu fallback"
+            res_cpu = _run_child(
+                [f"--views={CPU_FALLBACK_VIEWS}", "--force-cpu"],
+                CHILD_TIMEOUT_CPU)
+            if res is None:
+                res = res_cpu
+            elif res_cpu is not None:
+                for k, v in res_cpu.items():
+                    if res.get(k) is None:
+                        res[k] = v  # fill phases the TPU child missed
 
-    def run_all():
-        outs = [scanner._fwd(v, s, c) for v in views]  # async dispatch
-        jax.block_until_ready([o.points for o in outs])
-        return outs
+        if res is None:
+            # last resort: report the NumPy number itself so a real number
+            # exists on the record
+            final["value"] = round(np_s, 2)
+            final["vs_baseline"] = 1.0
+            final["backend"] = "numpy"
+            final["error"] = (final.get("error") or "") + "; all jax children failed"
+            emit(final)
+            return
 
-    outs = run_all()  # compile + warm
-    best = min(
-        (lambda t: (run_all(), time.perf_counter() - t)[1])(time.perf_counter())
-        for _ in range(3)
-    )
-    # sanity AFTER timing: a device->host readback degrades the axon tunnel's
-    # pipelined dispatch for subsequent async batches (measured 0.1ms ->
-    # ~35ms per launch), so nothing may touch host memory mid-benchmark
-    n_valid = int(np.asarray(outs[0].valid).sum())
-    assert n_valid > 0, "bench scene produced no valid points"
-
-    mpix = N_VIEWS * CAM[0] * CAM[1] / best / 1e6
-    print(json.dumps({
-        "metric": "decode_triangulate_360_24view_1080p_wall",
-        "value": round(best, 4),
-        "unit": f"s (={mpix:.0f} Mpix/s)",
-        "vs_baseline": round(np_s / best, 2),
-    }))
+        for k in ("decode_triangulate_s", "mpix_per_s", "merge_s", "chamfer_mm",
+                  "backend", "pallas", "views_measured", "merge_points",
+                  "merge_icp_fit_mean", "backend_error"):
+            if k in res and res[k] is not None:
+                final[k] = res[k]
+        dt = res.get("decode_triangulate_s")
+        mg = res.get("merge_s")
+        if dt is not None:
+            final["value"] = round(dt + (mg or 0.0), 3)
+            final["vs_baseline"] = round(np_s / dt, 2)
+            if mg is None:
+                final["error"] = (final.get("error") or "") + "; merge phase missing"
+        else:
+            final["value"] = round(np_s, 2)
+            final["vs_baseline"] = 1.0
+            final["error"] = (final.get("error") or "") + "; decode phase missing"
+    except Exception as e:
+        final["error"] = (final.get("error") or "") + f"; {type(e).__name__}: {e}"
+    signal.alarm(0)
+    emit(final)
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        views = N_VIEWS
+        force_cpu = False
+        for a in sys.argv[3:]:
+            if a.startswith("--views="):
+                views = int(a.split("=")[1])
+            elif a == "--force-cpu":
+                force_cpu = True
+        child_main(sys.argv[2], views, force_cpu)
+        sys.exit(0)
     main()

@@ -69,8 +69,10 @@ int decode_gray(const char* path, uint8_t* dst, int exp_w, int exp_h) {
       png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr, nullptr, nullptr);
   png_infop info = png ? png_create_info_struct(png) : nullptr;
   // raw buffer, not std::vector: a libpng error longjmps to the setjmp below,
-  // which would skip a vector destructor (UB) — free on both exits instead
-  uint8_t* row = nullptr;
+  // which would skip a vector destructor (UB) — free on both exits instead.
+  // volatile: `row` is assigned between setjmp and a potential longjmp from
+  // png_read_row; without it the error path may free a stale value (C UB)
+  uint8_t* volatile row = nullptr;
   if (!png || !info || setjmp(png_jmpbuf(png))) {
     png_destroy_read_struct(&png, &info, nullptr);
     std::fclose(f);

@@ -16,7 +16,7 @@ point blocks shard on the "model" axis.
 
 Subpackage map (reference parity in parentheses, see SURVEY.md section 2):
   ops/       pure array math: graycode, masks, triangulate, knn, pointcloud,
-             registration, normals, poisson, marching_cubes (A4, A8, A9, A12-A20)
+             registration, normals, poisson, surface_nets (A4, A8, A9, A12-A20)
   models/    end-to-end "model" pipelines: scanner forward pass, 360 reconstruction
   parallel/  device mesh, shardings, collective helpers (new; reference is 1-node)
   calib/     chessboard + Gray-corner stereo calibration (A6)

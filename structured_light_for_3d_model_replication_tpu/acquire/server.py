@@ -104,18 +104,26 @@ class CaptureState:
         under a timestamped name — the standalone ``serve`` flow, where a
         phone uploads without a command round-trip.
         """
+        fallback_path = None
         with self._lock:
             if self.command != "capture" or self.save_path is None:
                 if self.fallback_dir is None:
                     raise ValueError("no capture armed")
-                os.makedirs(self.fallback_dir, exist_ok=True)
                 name = time.strftime("upload_%Y%m%d_%H%M%S")
-                path = os.path.join(self.fallback_dir, f"{name}_{os.getpid()}"
-                                    f"_{self._fallback_seq}.png")
+                fallback_path = os.path.join(
+                    self.fallback_dir,
+                    f"{name}_{os.getpid()}_{self._fallback_seq}.png")
                 self._fallback_seq += 1
-                with open(path, "wb") as f:
-                    f.write(payload)
-                return path
+        if fallback_path is not None:
+            # file IO outside the lock: a slow multi-MB phone upload must not
+            # stall /poll_command handlers and connection-state tracking
+            os.makedirs(self.fallback_dir, exist_ok=True)
+            with open(fallback_path, "wb") as f:
+                f.write(payload)
+            return fallback_path
+        with self._lock:
+            if self.command != "capture" or self.save_path is None:
+                raise ValueError("capture disarmed during upload")
             if upload_id and upload_id != self.command_id:
                 raise ValueError(
                     f"stale upload for command {upload_id[:8]}..., "

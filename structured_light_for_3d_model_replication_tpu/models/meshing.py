@@ -43,9 +43,11 @@ def reconstruct_mesh(points, valid=None, normals=None,
     v = jnp.asarray(valid) if valid is not None else jnp.ones(pts.shape[0], bool)
 
     if normals is None:
-        nr = nrmlib.estimate_normals(pts, v, k=cfg.normal_max_nn)
+        nr = nrmlib.estimate_normals(pts, v, k=cfg.normal_max_nn,
+                                     radius=cfg.normal_radius or None)
         nr = nrmlib.orient_normals(pts, nr, v, mode="radial")
-        log(f"[mesh] normals estimated (k={cfg.normal_max_nn}, radial orient)")
+        log(f"[mesh] normals estimated (hybrid r={cfg.normal_radius}, "
+            f"max_nn={cfg.normal_max_nn}, radial orient)")
     else:
         nr = jnp.asarray(normals, jnp.float32)
 

@@ -226,14 +226,20 @@ def _decode_impl(
         if (pk.use_pallas() and frames.dtype == jnp.uint8
                 and h % 8 == 0 and w % 128 == 0):
             # fused Pallas path: one VMEM pass over the stack (bit-exact twin
-            # of the arithmetic below; gated to tile-aligned frames)
-            col, row, mask = pk.decode_maps_fused(
-                frames, shadow_thresh, contrast_thresh,
-                n_bits_col=max_col_bits, n_bits_row=max_row_bits,
-                n_use_col=n_use_col, n_use_row=n_use_row)
-            return DecodeResult((col * downsample).astype(xp.int32),
-                                (row * downsample).astype(xp.int32),
-                                mask, texture)
+            # of the arithmetic below; gated to tile-aligned frames).  The
+            # except arm only helps eager callers — under an outer jit a
+            # Mosaic failure surfaces at that jit's compile; the compiled-
+            # kernel probe in pallas_mode() is the guard for that case.
+            try:
+                col, row, mask = pk.decode_maps_fused(
+                    frames, shadow_thresh, contrast_thresh,
+                    n_bits_col=max_col_bits, n_bits_row=max_row_bits,
+                    n_use_col=n_use_col, n_use_row=n_use_row)
+                return DecodeResult((col * downsample).astype(xp.int32),
+                                    (row * downsample).astype(xp.int32),
+                                    mask, texture)
+            except Exception:
+                pass  # fall through to the jnp twin below
 
     fr = frames.astype(xp.int16)
     white = fr[0]

@@ -175,7 +175,10 @@ def radius_count(points: jax.Array, valid: jax.Array, radius,
         )
 
         if pk.use_pallas() and exclude_self:
-            return pk.radius_count_pallas(points, valid, radius)
+            try:
+                return pk.radius_count_pallas(points, valid, radius)
+            except Exception:  # Mosaic compile failure at this shape: jnp twin
+                pass
         block_q, block_b, n_pad = _choose_blocks(n, block_q, block_b)
         points, valid = _pad_jax(points, valid, n_pad)
         return _radius_blocks(points, valid, jnp.float32(radius), block_q,

@@ -66,11 +66,18 @@ def smallest_eigvec_sym3(cov):
                      fallback)
 
 
-def estimate_normals(points, valid, k: int = 30):
-    """Unit normals [N,3] from PCA of each point's k-neighborhood."""
-    idx, _ = knnlib.knn(points, valid, k)
+def estimate_normals(points, valid, k: int = 30, radius: float | None = None):
+    """Unit normals [N,3] from PCA of each point's k-neighborhood.
+
+    ``radius``: hybrid query semantics (Open3D KDTreeSearchParamHybrid,
+    processing.py:455-466 and :653-655 — radius=2*voxel, max_nn cap): of the
+    k nearest neighbors, only those within ``radius`` enter the plane fit.
+    None keeps the pure-kNN neighborhood."""
+    idx, d2 = knnlib.knn(points, valid, k)
     neigh = points[idx]  # [N, k, 3]
     ok = valid[idx]      # [N, k] — padded/invalid neighbors excluded
+    if radius is not None:
+        ok = ok & (d2 <= jnp.float32(radius) ** 2)
     w = ok.astype(jnp.float32)[..., None]
     cnt = jnp.maximum(w.sum(1), 1.0)
     mean = (neigh * w).sum(1) / cnt
@@ -79,18 +86,22 @@ def estimate_normals(points, valid, k: int = 30):
     return smallest_eigvec_sym3(cov)
 
 
-def estimate_normals_np(points, valid, k: int = 30):
-    """Reference: numpy eigh over cKDTree neighborhoods."""
+def estimate_normals_np(points, valid, k: int = 30,
+                        radius: float | None = None):
+    """Reference: numpy eigh over cKDTree neighborhoods (hybrid semantics
+    when ``radius`` is given, as in estimate_normals)."""
     if valid is None:
         valid = np.ones(points.shape[0], bool)
-    idx, _ = knnlib.knn_np(points, valid, k)
+    idx, d2 = knnlib.knn_np(points, valid, k)
     normals = np.zeros((points.shape[0], 3), np.float32)
     for i in range(points.shape[0]):
         if not valid[i]:
             normals[i] = (0, 0, 1)
             continue
-        nb = points[idx[i]]
-        nb = nb[valid[idx[i]]]
+        keep = valid[idx[i]]
+        if radius is not None:
+            keep = keep & (d2[i] <= radius * radius)
+        nb = points[idx[i]][keep]
         if nb.shape[0] < 3:
             normals[i] = (0, 0, 1)
             continue

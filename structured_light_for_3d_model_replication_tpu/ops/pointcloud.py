@@ -46,13 +46,17 @@ __all__ = [
 # ---------------------------------------------------------------------------
 
 def _stat_outlier_from_knn(mean_d, valid, std_ratio, xp):
-    big = xp.asarray(np.float32(np.inf))
-    n_valid = xp.maximum(valid.sum(), 1)
-    m = xp.where(valid, mean_d, 0.0)
+    # A non-finite mean distance means the k-th neighbor fell outside the
+    # grid search range — farther than any in-range point, an outlier by
+    # construction. It must also stay OUT of mu/var: one inf would make the
+    # threshold NaN and wipe the whole cloud (observed on 24-view merges).
+    ok = valid & xp.isfinite(mean_d)
+    n_valid = xp.maximum(ok.sum(), 1)
+    m = xp.where(ok, mean_d, 0.0)
     mu = m.sum() / n_valid
-    var = (xp.where(valid, (mean_d - mu) ** 2, 0.0)).sum() / n_valid
+    var = (xp.where(ok, (mean_d - mu) ** 2, 0.0)).sum() / n_valid
     thresh = mu + std_ratio * xp.sqrt(var)
-    return valid & (mean_d <= thresh)
+    return ok & (mean_d <= thresh)
 
 
 def statistical_outlier_mask(points, valid, nb_neighbors: int = 20,
@@ -278,21 +282,20 @@ def voxel_downsample(points, colors, valid, voxel_size):
     vs = jnp.float32(voxel_size)
     origin = jnp.where(valid[:, None], points, jnp.inf).min(axis=0)
     ijk = jnp.floor((points - origin) / vs).astype(jnp.int32)
-    ijk = jnp.clip(ijk, 0, 2_000_000)
-    # collision-free voxel key within int32 range is impossible for big grids;
-    # use int64-in-two-int32 avoided by hashing on a 2^31 grid: pack via large
-    # primes (collisions astronomically unlikely for real scans, and the numpy
-    # backend is exact)
-    key = (ijk[:, 0] * jnp.int32(73856093)
-           ^ ijk[:, 1] * jnp.int32(19349663)
-           ^ ijk[:, 2] * jnp.int32(83492791))
-    key = jnp.where(valid, key, jnp.int32(2**31 - 1))
-    order = jnp.argsort(key)
-    k_s = key[order]
+    # exact grouping: lexicographic sort on the raw (i, j, k) triple — no
+    # packed/hashed key, so no collisions at any grid size (int32 can't hold
+    # a collision-free pack of three 2^20 axes; three chained stable sorts
+    # can). Invalid rows park at a sentinel cell past the clip range and
+    # group together at the end with cnt=0.
+    ijk = jnp.clip(ijk, 0, 2**20 - 1)
+    ijk = jnp.where(valid[:, None], ijk, jnp.int32(2**20))
+    order = jnp.lexsort((ijk[:, 2], ijk[:, 1], ijk[:, 0]))
+    k_s = ijk[order]
     p_s = points[order]
     c_s = colors[order].astype(jnp.float32)
     v_s = valid[order]
-    newgrp = jnp.concatenate([jnp.ones(1, bool), k_s[1:] != k_s[:-1]])
+    newgrp = jnp.concatenate(
+        [jnp.ones(1, bool), jnp.any(k_s[1:] != k_s[:-1], axis=1)])
     seg = jnp.cumsum(newgrp.astype(jnp.int32)) - 1  # segment id per sorted slot
     cnt = jnp.zeros((n,), jnp.float32).at[seg].add(v_s.astype(jnp.float32))
     psum = jnp.zeros((n, 3), jnp.float32).at[seg].add(

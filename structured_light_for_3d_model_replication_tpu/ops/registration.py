@@ -179,13 +179,16 @@ def icp_point_to_plane(src_pts, src_valid, dst_pts, dst_valid, dst_normals,
         else jnp.asarray(init_transform, jnp.float32)
 
     if pk.use_pallas() and dst.shape[0] <= 131072:
-        block_q = block_b = 1024
-        nb_pad = -(-dst.shape[0] // block_b) * block_b
-        dst8 = pk._pad8(dst, dvalid, nb_pad)
-        T, fit, rmse = _icp_jit_pallas(
-            src, svalid, dst8, dst, jnp.asarray(dst_normals, jnp.float32),
-            T0, jnp.float32(max_dist), iters, block_q, block_b)
-        return RegistrationResult(T, fit, rmse)
+        try:
+            block_q = block_b = 1024
+            nb_pad = -(-dst.shape[0] // block_b) * block_b
+            dst8 = pk._pad8(dst, dvalid, nb_pad)
+            T, fit, rmse = _icp_jit_pallas(
+                src, svalid, dst8, dst, jnp.asarray(dst_normals, jnp.float32),
+                T0, jnp.float32(max_dist), iters, block_q, block_b)
+            return RegistrationResult(T, fit, rmse)
+        except Exception:  # Mosaic compile/VMEM failure at this shape:
+            pass           # fall through to the grid-accelerated path
 
     # cell >= max_dist would guarantee exactness but can explode occupancy;
     # 2 rings at cell=max_dist/2 gives the same guarantee at bounded memory

@@ -79,12 +79,18 @@ def _plane_hit(planes, rays, oc, xp):
 def _poly_planes(coeffs, idx, n_planes, xp):
     """Evaluate the affine/quadratic plane form n4(i) = A + B i + C i^2 for
     per-pixel indices — the gather-free path (see
-    calib.geometry.plane_poly_coefficients). Returns [N, 4] unnormalized."""
+    calib.geometry.plane_poly_coefficients). Returns [N, 4] rescaled to unit
+    normals so downstream guards (_plane_hit's |denom| > 1e-6 degenerate-ray
+    test) and the epipolar distance are scale-invariant, matching the table
+    path (which stores unit normals)."""
     i = xp.clip(idx, 0, n_planes - 1).astype(xp.float32)[:, None]
     A = coeffs[0][None, :]
     B = coeffs[1][None, :]
     C = coeffs[2][None, :]
-    return A + i * (B + i * C)
+    p = A + i * (B + i * C)
+    nrm = xp.sqrt(xp.maximum(
+        p[:, 0] ** 2 + p[:, 1] ** 2 + p[:, 2] ** 2, 1e-30))
+    return p / nrm[:, None]
 
 
 def _triangulate_impl(
@@ -123,10 +129,6 @@ def _triangulate_impl(
             + pr[:, 2] * p_col[:, 2]
             + pr[:, 3]
         )
-        if poly is not None:
-            # poly planes are unnormalized; the table stores unit normals
-            nrm2 = pr[:, 0] ** 2 + pr[:, 1] ** 2 + pr[:, 2] ** 2
-            dist = dist / xp.sqrt(xp.maximum(nrm2, 1e-30))
         ok = valid & ok_col & (dist < epipolar_tol)
         return CloudResult(p_col.astype(xp.float32), tex, ok)
 

@@ -25,15 +25,28 @@ def initialize(coordinator_address: str | None = None,
     """Join the jax.distributed process group when multi-host settings are
     present (flags or the standard env vars); returns True when distributed
     mode is active. Safe to call more than once."""
-    import jax
-
     env_addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
     env_np = os.environ.get("JAX_NUM_PROCESSES")
     addr = coordinator_address or env_addr
     nproc = num_processes if num_processes is not None else (
         int(env_np) if env_np else None)
     if addr is None and nproc is None:
-        return jax.process_count() > 1  # auto-initialized runtimes (e.g. pods)
+        # No multi-host config. Do NOT call jax.process_count() here — it
+        # initializes the local backend as a side effect, after which a later
+        # explicit distributed initialize() in this process would fail.
+        # Report an already-initialized process group (auto-initialized pod
+        # runtimes) from jax.distributed's own state instead.
+        return _distributed_active()
+    if addr is None:
+        # a process count alone cannot join a group — the old code passed
+        # coordinator_address=None through and crashed inside jax.distributed
+        raise ValueError(
+            f"num_processes={nproc!r} given without a coordinator address; "
+            "set JAX_COORDINATOR_ADDRESS (JAX can auto-detect the process "
+            "count from the address on supported runtimes, but not the "
+            "reverse)")
+    import jax
+
     try:
         jax.distributed.initialize(
             coordinator_address=addr,
@@ -45,6 +58,19 @@ def initialize(coordinator_address: str | None = None,
         if "already" not in str(e).lower():
             raise
     return True
+
+
+def _distributed_active() -> bool:
+    """True iff a jax.distributed process group already exists, determined
+    WITHOUT initializing any backend (importing jax is backend-init-free;
+    only device/process queries trigger init — reading the distributed
+    client state does not)."""
+    try:
+        from jax._src import distributed  # private, but backend-init-free
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
 
 
 def is_multiprocess() -> bool:

@@ -162,3 +162,30 @@ def test_smallest_eigvec_matches_eigh(rng):
         w, v = np.linalg.eigh(cov[i])
         dot = abs(float(v_j[i] @ v[:, 0]))
         assert dot > 0.999, (i, dot)
+
+
+def test_voxel_downsample_collision_free_at_scale(rng):
+    # regression: the old XOR-prime int32 voxel key silently merged distinct
+    # voxels at 24-view-merge scale (observed: 173k vs 259k voxels on 302k
+    # points); the lexicographic 3-key grouping must match the exact numpy
+    # twin's voxel count on a large fine grid
+    pts = rng.uniform(0, 170, (120_000, 3)).astype(np.float32)
+    cols = np.zeros((120_000, 3), np.uint8)
+    p_j, c_j, v_j = pc.voxel_downsample(
+        jnp.asarray(pts), jnp.asarray(cols),
+        jnp.asarray(np.ones(len(pts), bool)), 0.5)
+    p_n, _, _ = pc.voxel_downsample_np(pts, cols, None, 0.5)
+    assert int(np.asarray(v_j).sum()) == p_n.shape[0]
+
+
+def test_statistical_outlier_inf_mean_distance(rng):
+    # regression: a point whose k-th neighbor is out of search range (inf
+    # mean distance) must be dropped WITHOUT poisoning mu/sigma and wiping
+    # the whole cloud (observed on 24-view merged clouds)
+    mean_d = jnp.asarray(
+        np.concatenate([np.full(999, 1.0, np.float32), [np.inf]]))
+    valid = jnp.ones(1000, bool)
+    m = np.asarray(pc._stat_outlier_from_knn(mean_d, valid,
+                                             jnp.float32(2.0), jnp))
+    assert not m[-1]          # the unreachable point is an outlier
+    assert m[:999].all()      # the uniform cloud survives
