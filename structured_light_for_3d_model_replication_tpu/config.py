@@ -97,12 +97,19 @@ class MeshConfig:
     mode: str = "watertight"     # 'watertight' (Poisson) | 'surface' (ball-pivot analog)
     depth: int = 8               # Poisson grid = 2^depth per axis
     density_trim_quantile: float = 0.02
-    normal_radius: float = 5.0
+    # hybrid normal search radius in WORLD units (Open3D Hybrid semantics);
+    # 0 = pure kNN (unit-safe default — a fixed radius is only meaningful
+    # once the cloud's scale is known)
+    normal_radius: float = 0.0
     normal_max_nn: int = 30
     orientation: str = "radial"  # 'radial' | 'tangent' | 'centroid'
     smooth_iters: int = 0
     smooth_method: str = "taubin"  # 'taubin' | 'laplacian'
     simplify_target_faces: int = 0  # 0 = no decimation
+    simplify_method: str = "quadric"  # 'quadric' (QEM) | 'cluster' (vertex grid)
+    close_holes_max_edges: int = 0  # fill boundary loops up to this size (0=off)
+    surface_alpha_factor: float = 2.5  # mode='surface': ball radius / avg NN dist
+    surface_k: int = 12               # mode='surface': neighbor fan size
 
 
 @dataclass

@@ -39,6 +39,9 @@ def reconstruct_mesh(points, valid=None, normals=None,
     and extracted faces wind outward (positive signed volume).
     """
     cfg = cfg or MeshConfig()
+    if cfg.mode not in ("watertight", "surface"):
+        raise ValueError(f"mesh.mode must be 'watertight' or 'surface', "
+                         f"got {cfg.mode!r}")
     pts = jnp.asarray(points, jnp.float32)
     v = jnp.asarray(valid) if valid is not None else jnp.ones(pts.shape[0], bool)
 
@@ -51,23 +54,40 @@ def reconstruct_mesh(points, valid=None, normals=None,
     else:
         nr = jnp.asarray(normals, jnp.float32)
 
-    res = poisson.poisson_solve(pts, nr, v, depth=cfg.depth)
-    log(f"[mesh] poisson depth={cfg.depth} iso={float(res.iso):.4f}")
-    verts, faces = surface_nets.extract_surface(res.chi, float(res.iso),
-                                                origin=np.asarray(res.origin),
-                                                cell=float(res.cell))
-    log(f"[mesh] surface nets: {len(verts):,} verts, {len(faces):,} faces")
+    if cfg.mode == "surface":
+        # ball-pivot analog (processing.py:711-728): interpolates the points,
+        # keeps sharp detail, leaves holes where sampling is sparse
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            surface_recon,
+        )
 
-    if cfg.density_trim_quantile and cfg.density_trim_quantile > 0:
-        # low-support crop (processing.py:707-709): sample the splat density
-        # at mesh vertices, drop the lowest quantile
-        coords = (jnp.asarray(verts) - res.origin) / res.cell
-        dens = np.asarray(trilinear_sample(res.density, coords))
-        thresh = np.quantile(dens, cfg.density_trim_quantile)
-        verts, faces = meshproc.filter_faces_by_vertex_mask(
-            verts, faces, dens >= thresh)
-        log(f"[mesh] density trim q={cfg.density_trim_quantile}: "
-            f"{len(verts):,} verts remain")
+        verts, faces = surface_recon.ball_pivot_surface(
+            pts, v, nr, k=cfg.surface_k, alpha_factor=cfg.surface_alpha_factor)
+        log(f"[mesh] ball-pivot surface: {len(verts):,} verts, "
+            f"{len(faces):,} faces")
+    else:
+        res = poisson.poisson_solve(pts, nr, v, depth=cfg.depth)
+        log(f"[mesh] poisson depth={cfg.depth} iso={float(res.iso):.4f}")
+        verts, faces = surface_nets.extract_surface(
+            res.chi, float(res.iso), origin=np.asarray(res.origin),
+            cell=float(res.cell))
+        log(f"[mesh] surface nets: {len(verts):,} verts, {len(faces):,} faces")
+
+        if cfg.density_trim_quantile and cfg.density_trim_quantile > 0:
+            # low-support crop (processing.py:707-709): sample the splat
+            # density at mesh vertices, drop the lowest quantile
+            coords = (jnp.asarray(verts) - res.origin) / res.cell
+            dens = np.asarray(trilinear_sample(res.density, coords))
+            thresh = np.quantile(dens, cfg.density_trim_quantile)
+            verts, faces = meshproc.filter_faces_by_vertex_mask(
+                verts, faces, dens >= thresh)
+            log(f"[mesh] density trim q={cfg.density_trim_quantile}: "
+                f"{len(verts):,} verts remain")
+
+    if cfg.close_holes_max_edges > 0:
+        verts, faces, n = meshproc.fill_holes(verts, faces,
+                                              cfg.close_holes_max_edges)
+        log(f"[mesh] closed {n} holes (<= {cfg.close_holes_max_edges} edges)")
 
     if cfg.smooth_iters > 0:
         if cfg.smooth_method == "taubin":
@@ -77,17 +97,22 @@ def reconstruct_mesh(points, valid=None, normals=None,
         log(f"[mesh] {cfg.smooth_method} smoothing x{cfg.smooth_iters}")
 
     if cfg.simplify_target_faces and len(faces) > cfg.simplify_target_faces:
-        # derive a clustering cell from the target face budget
-        bbox = verts.max(0) - verts.min(0)
-        area = 2 * (bbox[0] * bbox[1] + bbox[1] * bbox[2] + bbox[0] * bbox[2])
-        cell = float(np.sqrt(area / max(cfg.simplify_target_faces, 1)))
-        for _ in range(8):
-            nv, nf = meshproc.vertex_cluster_decimate(verts, faces, cell)
-            if len(nf) <= cfg.simplify_target_faces or len(nf) == 0:
-                break
-            cell *= 1.3
-        verts, faces = nv, nf
-        log(f"[mesh] decimated to {len(faces):,} faces")
+        if cfg.simplify_method == "quadric":
+            verts, faces = meshproc.quadric_decimate(
+                verts, faces, cfg.simplify_target_faces)
+        else:
+            # derive a clustering cell from the target face budget
+            bbox = verts.max(0) - verts.min(0)
+            area = 2 * (bbox[0] * bbox[1] + bbox[1] * bbox[2]
+                        + bbox[0] * bbox[2])
+            cell = float(np.sqrt(area / max(cfg.simplify_target_faces, 1)))
+            for _ in range(8):
+                nv, nf = meshproc.vertex_cluster_decimate(verts, faces, cell)
+                if len(nf) <= cfg.simplify_target_faces or len(nf) == 0:
+                    break
+                cell *= 1.3
+            verts, faces = nv, nf
+        log(f"[mesh] decimated ({cfg.simplify_method}) to {len(faces):,} faces")
 
     return verts, faces
 

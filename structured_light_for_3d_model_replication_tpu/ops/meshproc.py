@@ -1,17 +1,19 @@
-"""Mesh post-processing: smoothing, decimation, density trim, cleanup.
+"""Mesh post-processing: smoothing, decimation, hole close, density trim.
 
 Covers the reference's optional pymeshlab stage (server/processing.py:744-787:
 Taubin/Laplacian smoothing, quadric-edge-collapse simplification, hole close)
 and the Poisson density-quantile crop (:707-709, :845-853) with array-native
 equivalents: uniform-Laplacian smoothing via segment ops over the edge list,
-vertex-clustering decimation on a target-resolution grid, and mask-based face
-filtering with vertex compaction.
+batched-greedy quadric edge collapse (plus the cheaper vertex-clustering
+variant), boundary-loop hole filling, and mask-based face filtering with
+vertex compaction.
 """
 from __future__ import annotations
 
 import numpy as np
 
 __all__ = ["laplacian_smooth", "taubin_smooth", "vertex_cluster_decimate",
+           "quadric_decimate", "boundary_loops", "fill_holes",
            "filter_faces_by_vertex_mask", "remove_unreferenced", "mesh_volume"]
 
 
@@ -76,6 +78,159 @@ def remove_unreferenced(vertices, faces):
     remap = np.cumsum(used) - 1
     return (np.asarray(vertices)[used],
             remap[np.asarray(faces, np.int64)].astype(np.int32))
+
+
+def boundary_loops(faces, max_loops: int = 10000):
+    """Closed loops of boundary edges (edges referenced by exactly one face).
+
+    Returns a list of vertex-index arrays, each tracing one open hole in face
+    winding order. Non-manifold junctions (a boundary vertex with more than
+    one outgoing boundary edge) break the chain there; such fragments are
+    dropped rather than guessed at.
+    """
+    f = np.asarray(faces, np.int64)
+    if f.size == 0:
+        return []
+    # directed edges in winding order; a boundary edge is one whose reverse
+    # has no partner
+    e = np.concatenate([f[:, [0, 1]], f[:, [1, 2]], f[:, [2, 0]]])
+    key = e[:, 0] * (f.max() + 1) + e[:, 1]
+    rkey = e[:, 1] * (f.max() + 1) + e[:, 0]
+    boundary = e[~np.isin(key, rkey)]
+    if len(boundary) == 0:
+        return []
+    # hole loops run OPPOSITE to face winding; walk successor map b -> a
+    succ: dict[int, int] = {}
+    multi: set[int] = set()
+    for a, b in boundary:
+        if b in succ:
+            multi.add(b)
+        succ[int(b)] = int(a)
+    loops = []
+    visited: set[int] = set()
+    for start in list(succ):
+        if start in visited or start in multi:
+            continue
+        loop = [start]
+        visited.add(start)
+        cur = succ[start]
+        ok = True
+        while cur != start:
+            if cur in visited or cur in multi or cur not in succ:
+                ok = False  # broken / non-manifold chain
+                break
+            loop.append(cur)
+            visited.add(cur)
+            cur = succ[cur]
+        if ok and len(loop) >= 3:
+            loops.append(np.asarray(loop, np.int64))
+        if len(loops) >= max_loops:
+            break
+    return loops
+
+
+def fill_holes(vertices, faces, max_hole_edges: int = 200):
+    """Close boundary loops with a centroid fan (pymeshlab meshing_close_holes
+    parity, server/processing.py:769-771; ``max_hole_edges`` plays the role
+    of its maxholesize knob). Returns (vertices', faces', n_filled)."""
+    v = np.asarray(vertices, np.float32)
+    f = np.asarray(faces, np.int32)
+    loops = [lp for lp in boundary_loops(f) if len(lp) <= max_hole_edges]
+    if not loops:
+        return v, f, 0
+    new_v = [v]
+    new_f = [f]
+    next_idx = len(v)
+    for lp in loops:
+        centroid = v[lp].mean(axis=0, keepdims=True)
+        new_v.append(centroid.astype(np.float32))
+        nxt = np.roll(lp, -1)
+        # fan wound so the new faces match the surrounding surface orientation
+        # (the loop runs opposite to face winding; fan centroid->nxt->cur
+        # restores it)
+        fan = np.stack([np.full(len(lp), next_idx, np.int64), nxt, lp], axis=1)
+        new_f.append(fan.astype(np.int32))
+        next_idx += 1
+    return (np.concatenate(new_v), np.concatenate(new_f), len(loops))
+
+
+def _face_quadrics(v, f):
+    """Per-face plane quadric K = p p^T (p = [n, d], |n| = 1)."""
+    a, b, c = v[f[:, 0]], v[f[:, 1]], v[f[:, 2]]
+    n = np.cross(b - a, c - a)
+    nrm = np.linalg.norm(n, axis=1, keepdims=True)
+    n = n / np.maximum(nrm, 1e-20)
+    d = -(n * a).sum(1)
+    p = np.concatenate([n, d[:, None]], axis=1)  # [F, 4]
+    return np.einsum("fi,fj->fij", p, p)
+
+
+def quadric_decimate(vertices, faces, target_faces: int,
+                     max_rounds: int = 40):
+    """Garland-Heckbert quadric edge collapse, batched-greedy.
+
+    Instead of a serial priority queue, every round scores ALL edges by the
+    summed endpoint quadric (error of the best of {a, b, midpoint}), picks an
+    independent set of cheap edges (no shared vertices — each vertex accepts
+    only its minimum-rank incident edge, found with scatter-min), collapses
+    them simultaneously, and repeats until the face budget is met. Shape
+    fidelity matches serial QEM closely while every round is vectorized
+    numpy (no per-edge Python loop).
+
+    pymeshlab parity: meshing_decimation_quadric_edge_collapse
+    (server/processing.py:773-787). Returns (vertices', faces').
+    """
+    v = np.asarray(vertices, np.float64).copy()
+    f = np.asarray(faces, np.int64).copy()
+    if target_faces <= 0 or len(f) <= target_faces:
+        return v.astype(np.float32), f.astype(np.int32)
+
+    for _ in range(max_rounds):
+        if len(f) <= target_faces:
+            break
+        # vertex quadrics from current faces
+        kf = _face_quadrics(v, f)
+        q = np.zeros((len(v), 4, 4))
+        for col in range(3):
+            np.add.at(q, f[:, col], kf)
+        # candidate edges (undirected, deduped)
+        e = np.concatenate([f[:, [0, 1]], f[:, [1, 2]], f[:, [2, 0]]])
+        e = np.unique(np.sort(e, axis=1), axis=0)
+        qe = q[e[:, 0]] + q[e[:, 1]]                      # [E, 4, 4]
+        cand = np.stack([v[e[:, 0]], v[e[:, 1]],
+                         0.5 * (v[e[:, 0]] + v[e[:, 1]])], axis=1)  # [E, 3, 3]
+        ch = np.concatenate([cand, np.ones((len(e), 3, 1))], axis=2)
+        cost3 = np.einsum("eci,eij,ecj->ec", ch, qe, ch)
+        pick = cost3.argmin(axis=1)
+        cost = cost3[np.arange(len(e)), pick]
+        target = cand[np.arange(len(e)), pick]
+
+        # independent set: an edge collapses iff it is the cheapest (by rank)
+        # edge at BOTH endpoints — vectorized via scatter-min of edge ranks
+        rank = np.empty(len(e), np.int64)
+        rank[np.argsort(cost)] = np.arange(len(e))
+        vmin = np.full(len(v), len(e), np.int64)
+        np.minimum.at(vmin, e[:, 0], rank)
+        np.minimum.at(vmin, e[:, 1], rank)
+        sel = (vmin[e[:, 0]] == rank) & (vmin[e[:, 1]] == rank)
+        chosen = np.nonzero(sel)[0]
+        if len(chosen) == 0:
+            break
+        # cap collapses so a single round can't undershoot the budget badly
+        budget = max((len(f) - target_faces) // 2 + 1, 1)
+        if len(chosen) > budget:
+            chosen = chosen[np.argsort(cost[chosen])[:budget]]
+        # collapse b -> a, a moves to the optimal position
+        remap = np.arange(len(v))
+        remap[e[chosen, 1]] = e[chosen, 0]
+        v[e[chosen, 0]] = target[chosen]
+        f = remap[f]
+        keep = ((f[:, 0] != f[:, 1]) & (f[:, 1] != f[:, 2])
+                & (f[:, 0] != f[:, 2]))
+        f = f[keep]
+
+    v32, f32 = remove_unreferenced(v.astype(np.float32), f.astype(np.int32))
+    return v32, f32
 
 
 def mesh_volume(vertices, faces) -> float:

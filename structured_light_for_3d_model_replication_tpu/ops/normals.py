@@ -77,7 +77,12 @@ def estimate_normals(points, valid, k: int = 30, radius: float | None = None):
     neigh = points[idx]  # [N, k, 3]
     ok = valid[idx]      # [N, k] — padded/invalid neighbors excluded
     if radius is not None:
-        ok = ok & (d2 <= jnp.float32(radius) ** 2)
+        ok_r = ok & (d2 <= jnp.float32(radius) ** 2)
+        # a plane fit needs >= 3 points: where the radius leaves fewer (cloud
+        # scale coarser than the radius), fall back to the pure-kNN
+        # neighborhood for that point instead of degenerating to +z
+        enough = ok_r.sum(axis=1, keepdims=True) >= 3
+        ok = jnp.where(enough, ok_r, ok)
     w = ok.astype(jnp.float32)[..., None]
     cnt = jnp.maximum(w.sum(1), 1.0)
     mean = (neigh * w).sum(1) / cnt

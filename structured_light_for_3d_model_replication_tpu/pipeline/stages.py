@@ -315,7 +315,8 @@ def mesh_cloud(input_ply: str, output_path: str, cfg: Config | None = None,
     normals = data.get("normals")
     if normals is None:
         nr = nrm.estimate_normals(jnp.asarray(pts), jnp.asarray(valid),
-                                  k=cfg.mesh.normal_max_nn)
+                                  k=cfg.mesh.normal_max_nn,
+                                  radius=cfg.mesh.normal_radius or None)
         nr = nrm.orient_normals(jnp.asarray(pts), nr, jnp.asarray(valid),
                                 mode=cfg.mesh.orientation)
         normals = np.asarray(nr)

@@ -115,3 +115,53 @@ def test_decimation_reduces_faces(rng):
     assert 0 < len(nf) < 0.5 * len(faces)
     r = np.linalg.norm(nv - (g / 2 - 0.5), axis=1)
     assert abs(np.median(r) - 15.0) < 1.5
+
+
+def test_surface_mode_ball_pivot(rng):
+    # mesh.mode='surface' (processing.py:711-728 parity): interpolating
+    # triangulation, non-Poisson
+    pts, _ = _sphere_cloud(rng, n=3000)
+    cfg = MeshConfig(mode="surface")
+    verts, faces = meshing.reconstruct_mesh(pts, cfg=cfg, log=lambda *a: None)
+    assert len(faces) > 1500
+    # BPA property: vertices ARE input points (Poisson's are grid-born)
+    r = np.linalg.norm(verts, axis=1)
+    np.testing.assert_allclose(r, 50.0, atol=1e-3)
+    assert meshproc.mesh_volume(verts, faces) > 0.6 * 4 / 3 * np.pi * 50**3
+
+    # differs from watertight mode output on the same cloud
+    vw, fw = meshing.reconstruct_mesh(
+        pts, cfg=MeshConfig(mode="watertight", depth=6), log=lambda *a: None)
+    rw = np.linalg.norm(vw, axis=1)
+    assert np.abs(rw - 50.0).max() > 0.1  # grid vertices, not samples
+
+
+def test_reconstruct_mesh_rejects_unknown_mode(rng):
+    pts, _ = _sphere_cloud(rng, n=500)
+    with pytest.raises(ValueError):
+        meshing.reconstruct_mesh(pts, cfg=MeshConfig(mode="nope"),
+                                 log=lambda *a: None)
+
+
+def test_close_holes_config_path(rng):
+    # surface mode on an under-sampled cloud leaves holes; the
+    # close_holes_max_edges knob then seals the small ones
+    pts, _ = _sphere_cloud(rng, n=800)
+    v1, f1 = meshing.reconstruct_mesh(
+        pts, cfg=MeshConfig(mode="surface"), log=lambda *a: None)
+    n_holes_before = len(meshproc.boundary_loops(f1))
+    v2, f2 = meshing.reconstruct_mesh(
+        pts, cfg=MeshConfig(mode="surface", close_holes_max_edges=30),
+        log=lambda *a: None)
+    n_holes_after = len(meshproc.boundary_loops(f2))
+    assert n_holes_after <= n_holes_before
+
+
+def test_quadric_decimation_config_path(rng):
+    pts, _ = _sphere_cloud(rng, n=6000)
+    cfg = MeshConfig(depth=6, simplify_target_faces=500,
+                     simplify_method="quadric")
+    verts, faces = meshing.reconstruct_mesh(pts, cfg=cfg, log=lambda *a: None)
+    assert 0 < len(faces) <= 550
+    r = np.linalg.norm(verts, axis=1)
+    assert abs(np.median(r) - 50.0) < 3.0
