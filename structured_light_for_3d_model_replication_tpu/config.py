@@ -95,7 +95,11 @@ class MeshConfig:
     """Meshing (reference: server/processing.py:632-860)."""
 
     mode: str = "watertight"     # 'watertight' (Poisson) | 'surface' (ball-pivot analog)
-    depth: int = 8               # Poisson grid = 2^depth per axis
+    # Poisson grid = 2^depth per axis; matches the reference default
+    # (server/gui.py:118). <=9 solves dense on one chip; 10+ dispatches to
+    # the slab-sharded multi-device solver (steps down to 9 with a warning
+    # when only one device is present)
+    depth: int = 10
     density_trim_quantile: float = 0.02
     # hybrid normal search radius in WORLD units (Open3D Hybrid semantics);
     # 0 = pure kNN (unit-safe default — a fixed radius is only meaningful

@@ -66,8 +66,7 @@ def reconstruct_mesh(points, valid=None, normals=None,
         log(f"[mesh] ball-pivot surface: {len(verts):,} verts, "
             f"{len(faces):,} faces")
     else:
-        res = poisson.poisson_solve(pts, nr, v, depth=cfg.depth)
-        log(f"[mesh] poisson depth={cfg.depth} iso={float(res.iso):.4f}")
+        res = _poisson_dispatch(pts, nr, v, cfg.depth, log)
         verts, faces = surface_nets.extract_surface(
             res.chi, float(res.iso), origin=np.asarray(res.origin),
             cell=float(res.cell))
@@ -115,6 +114,40 @@ def reconstruct_mesh(points, valid=None, normals=None,
         log(f"[mesh] decimated ({cfg.simplify_method}) to {len(faces):,} faces")
 
     return verts, faces
+
+
+def _poisson_dispatch(pts, nr, v, depth: int, log):
+    """Dense single-chip Poisson up to depth 9; depth 10+ runs the
+    slab-sharded solver across the device mesh (the reference's octree
+    default is depth 10, server/gui.py:118 / processing.py:697-709). With
+    too few devices for the requested grid the depth is stepped down with a
+    warning rather than failing the pipeline."""
+    import jax
+
+    if depth <= 9:
+        res = poisson.poisson_solve(pts, nr, v, depth=depth)
+        log(f"[mesh] poisson depth={depth} iso={float(res.iso):.4f}")
+        return res
+
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        poisson_sharded,
+    )
+
+    n_dev = len(jax.devices())
+    # virtual CPU devices share one host's RAM — slabbing buys no memory
+    # there, so only real accelerator meshes raise the ceiling
+    accel = jax.default_backend() != "cpu"
+    if accel and n_dev >= 2 and (1 << depth) % n_dev == 0:
+        res = poisson_sharded.poisson_solve_sharded(pts, nr, v, depth=depth)
+        log(f"[mesh] poisson depth={depth} sharded over {n_dev} devices "
+            f"iso={float(res.iso):.4f}")
+        return res
+    log(f"[mesh] WARNING: depth {depth} needs a multi-device accelerator "
+        f"mesh (have {n_dev} {jax.default_backend()}); stepping down to "
+        f"depth 9 dense")
+    res = poisson.poisson_solve(pts, nr, v, depth=9)
+    log(f"[mesh] poisson depth=9 iso={float(res.iso):.4f}")
+    return res
 
 
 def mesh_to_stl(path: str, vertices, faces) -> None:

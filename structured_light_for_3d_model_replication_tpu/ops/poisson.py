@@ -166,8 +166,10 @@ def poisson_solve(points, normals, valid=None, depth: int = 8,
     for its octree, processing.py:697-699; dense grids cap at 9 for HBM).
     """
     if depth > 9:
-        raise ValueError(f"depth {depth} > 9: a dense {1<<depth}^3 fp32 grid "
-                         "does not fit TPU HBM; use depth <= 9")
+        raise ValueError(
+            f"depth {depth} > 9: a dense {1 << depth}^3 fp32 grid does not "
+            "fit one chip's HBM; use ops/poisson_sharded.poisson_solve_"
+            "sharded (slab-decomposed across the device mesh) for depth 10+")
     points = jnp.asarray(points, jnp.float32)
     normals = jnp.asarray(normals, jnp.float32)
     if valid is None:

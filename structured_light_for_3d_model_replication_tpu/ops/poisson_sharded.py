@@ -1,0 +1,240 @@
+"""Multi-device screened Poisson: the dense grid sharded into axis-0 slabs.
+
+Raises the depth ceiling past the single-chip dense limit (ops/poisson.py
+guards depth <= 9: a 1024^3 fp32 CG state does not fit one chip's HBM; the
+reference's octree default is depth 10 with a <=16 guard,
+server/processing.py:697-709). Across D devices each holds a [G/D, G, G]
+slab, and the 7-point Laplacian / central-difference divergence exchange one
+boundary plane per side per application via ``jax.lax.ppermute`` over ICI —
+the classic distributed-stencil halo pattern. CG dot products are ``psum``
+reductions. The splat is computed per-slab (every device masks the trilinear
+corner contributions that land in its slab), so no scatter ever crosses
+devices.
+
+Numerics match ops/poisson.py up to fp32 reduction order; tests assert
+dense-vs-sharded agreement on the 8-virtual-device CPU mesh.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from structured_light_for_3d_model_replication_tpu.ops.poisson import (
+    PoissonResult,
+)
+
+__all__ = ["poisson_solve_sharded"]
+
+_AXIS = "slab"
+
+
+def _slab_mesh(devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), (_AXIS,))
+
+
+def _halo_from_prev(plane, n_dev):
+    """Each device receives ``plane`` from its predecessor (zeros on dev 0)."""
+    return jax.lax.ppermute(plane, _AXIS,
+                            [(i, i + 1) for i in range(n_dev - 1)])
+
+
+def _halo_from_next(plane, n_dev):
+    return jax.lax.ppermute(plane, _AXIS,
+                            [(i + 1, i) for i in range(n_dev - 1)])
+
+
+def _neighbors_axis0(u, n_dev):
+    """(u[i-1], u[i+1]) along the sharded axis with edge replication at the
+    global boundary — one halo plane exchanged per side."""
+    zi = jax.lax.axis_index(_AXIS)
+    prev_last = _halo_from_prev(u[-1:], n_dev)
+    prev_last = jnp.where(zi == 0, u[:1], prev_last)
+    next_first = _halo_from_next(u[:1], n_dev)
+    next_first = jnp.where(zi == n_dev - 1, u[-1:], next_first)
+    up = jnp.concatenate([prev_last, u[:-1]], axis=0)   # u[i-1]
+    dn = jnp.concatenate([u[1:], next_first], axis=0)   # u[i+1]
+    return up, dn
+
+
+def _inplane_neighbors(u, axis):
+    """(u[j-1], u[j+1]) along an unsharded axis with edge replication."""
+    fwd = jnp.roll(u, -1, axis)
+    bwd = jnp.roll(u, 1, axis)
+    idx_last = [slice(None)] * 3
+    idx_last[axis] = -1
+    fwd = fwd.at[tuple(idx_last)].set(u[tuple(idx_last)])
+    idx_first = [slice(None)] * 3
+    idx_first[axis] = 0
+    bwd = bwd.at[tuple(idx_first)].set(u[tuple(idx_first)])
+    return bwd, fwd
+
+
+def _laplacian_slab(u, n_dev):
+    up, dn = _neighbors_axis0(u, n_dev)
+    lap = -6.0 * u + up + dn
+    for axis in (1, 2):
+        bwd, fwd = _inplane_neighbors(u, axis)
+        lap = lap + bwd + fwd
+    return lap
+
+
+def _splat_slab(coords, values, zi, slab, g):
+    """Trilinear scatter of [N, C] values into this device's [slab, G, G, C]
+    piece; corner contributions outside the slab are masked, so summing the
+    slabs reproduces ops/poisson._trilinear_scatter exactly."""
+    base = jnp.floor(coords).astype(jnp.int32)
+    frac = coords - base
+    out = jnp.zeros((slab, g, g, values.shape[-1]), jnp.float32)
+    x0 = zi * slab
+    for dx in (0, 1):
+        for dy in (0, 1):
+            for dz in (0, 1):
+                w = (jnp.abs(1 - dx - frac[:, 0])
+                     * jnp.abs(1 - dy - frac[:, 1])
+                     * jnp.abs(1 - dz - frac[:, 2]))
+                gx = jnp.clip(base[:, 0] + dx, 0, g - 1)
+                iy = jnp.clip(base[:, 1] + dy, 0, g - 1)
+                iz = jnp.clip(base[:, 2] + dz, 0, g - 1)
+                lx = gx - x0
+                in_slab = (lx >= 0) & (lx < slab)
+                w = jnp.where(in_slab, w, 0.0)
+                lx = jnp.clip(lx, 0, slab - 1)
+                out = out.at[lx, iy, iz].add(values * w[:, None])
+    return out
+
+
+def _divergence_slab(vfield, n_dev):
+    """Central-difference divergence of a [slab, G, G, 3] field (cell units),
+    edge-replicated at the global boundary like the dense solver."""
+    div = jnp.zeros(vfield.shape[:3], jnp.float32)
+    f0 = vfield[..., 0]
+    up, dn = _neighbors_axis0(f0, n_dev)
+    div = div + 0.5 * (dn - up)
+    for axis in (1, 2):
+        f = vfield[..., axis]
+        bwd, fwd = _inplane_neighbors(f, axis)
+        div = div + 0.5 * (fwd - bwd)
+    return div
+
+
+def _psum(x):
+    return jax.lax.psum(x, _AXIS)
+
+
+def poisson_solve_sharded(points, normals, valid=None, depth: int = 10,
+                          devices=None, cg_iters: int = 350,
+                          screen: float = 4.0,
+                          margin: float = 0.08) -> PoissonResult:
+    """Screened grid Poisson across a device mesh. Same contract as
+    ops/poisson.poisson_solve; chi/density come back sharded on axis 0
+    (np.asarray gathers them for extraction).
+
+    The reference's depth guard is <= 16 (processing.py:697-699); here depth
+    is bounded by aggregate HBM: D devices fit depth d when each [2^d / D,
+    2^d, 2^d] fp32 slab times ~6 CG arrays fits one chip (depth 10 on 8 x
+    v5e comfortably).
+    """
+    if depth > 16:
+        raise ValueError(f"depth {depth} > 16 (the reference's own guard: "
+                         "processing.py:697-699)")
+    mesh = _slab_mesh(devices)
+    n_dev = mesh.devices.size
+    g = 1 << depth
+    if g % n_dev:
+        raise ValueError(f"grid {g} not divisible by {n_dev} devices")
+    slab = g // n_dev
+
+    points = jnp.asarray(points, jnp.float32)
+    normals = jnp.asarray(normals, jnp.float32)
+    if valid is None:
+        valid = jnp.ones(points.shape[0], bool)
+    valid = jnp.asarray(valid)
+
+    # grid frame (host, fp32 — mirrors ops/poisson._poisson_jit)
+    pnp = np.asarray(points)
+    vnp = np.asarray(valid)
+    lo = np.min(np.where(vnp[:, None], pnp, np.inf), axis=0)
+    hi = np.max(np.where(vnp[:, None], pnp, -np.inf), axis=0)
+    extent = np.float32(np.max(hi - lo) * (1.0 + 2.0 * margin))
+    cell = np.float32(extent / g)
+    origin = (0.5 * (lo + hi) - 0.5 * extent).astype(np.float32)
+
+    spec_grid = P(_AXIS, None, None)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(), P()),
+        out_specs=(spec_grid, spec_grid),
+        check_rep=False,
+    )
+    def solve(pts, nrm, w):
+        zi = jax.lax.axis_index(_AXIS)
+        coords = (pts - origin) / cell - 0.5
+        coords = jnp.where(w[:, None] > 0, coords, -10.0)
+        splat = _splat_slab(coords, jnp.concatenate([nrm * w[:, None], w[:, None]],
+                                                    axis=-1), zi, slab, g)
+        vfield = splat[..., :3]
+        density = splat[..., 3]
+        div = _divergence_slab(vfield, n_dev)
+
+        dmax = jax.lax.pmax(jnp.max(density), _AXIS)
+        wgt = density / jnp.maximum(dmax, 1e-12)
+
+        def a_mul(x):
+            return -_laplacian_slab(x, n_dev) + screen * wgt * x
+
+        b = -div
+
+        def cg_step(state, _):
+            x, r, p, rs = state
+            ap = a_mul(p)
+            alpha = rs / jnp.maximum(_psum((p * ap).sum()), 1e-20)
+            x = x + alpha * p
+            r = r - alpha * ap
+            rs_new = _psum((r * r).sum())
+            beta = rs_new / jnp.maximum(rs, 1e-20)
+            p = r + beta * p
+            return (x, r, p, rs_new), rs_new
+
+        state0 = (jnp.zeros_like(b), b, b, _psum((b * b).sum()))
+        (chi, _, _, _), _ = jax.lax.scan(cg_step, state0, None,
+                                         length=cg_iters)
+        return chi, density
+
+    w = valid.astype(jnp.float32)
+    chi, density = solve(points, normals, w)
+
+    # iso on host: weighted mean of chi at the sample points (the gathered
+    # chi is the extraction input anyway)
+    chi_np = np.asarray(chi)
+    coords = (pnp - origin) / cell - 0.5
+    iso = _trilinear_sample_np(chi_np, np.where(vnp[:, None], coords, 0.0))
+    wnp = vnp.astype(np.float32)
+    iso = np.float32((iso * wnp).sum() / max(wnp.sum(), 1.0))
+
+    return PoissonResult(chi, jnp.float32(iso), density,
+                         jnp.asarray(origin + 0.5 * cell), jnp.float32(cell))
+
+
+def _trilinear_sample_np(field, coords):
+    g = field.shape
+    base = np.floor(coords).astype(np.int64)
+    frac = (coords - base).astype(np.float32)
+    acc = np.zeros(coords.shape[0], np.float32)
+    for dx in (0, 1):
+        for dy in (0, 1):
+            for dz in (0, 1):
+                w = (np.abs(1 - dx - frac[:, 0])
+                     * np.abs(1 - dy - frac[:, 1])
+                     * np.abs(1 - dz - frac[:, 2]))
+                ix = np.clip(base[:, 0] + dx, 0, g[0] - 1)
+                iy = np.clip(base[:, 1] + dy, 0, g[1] - 1)
+                iz = np.clip(base[:, 2] + dz, 0, g[2] - 1)
+                acc += w * field[ix, iy, iz]
+    return acc
