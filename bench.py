@@ -8,9 +8,13 @@ Prints exactly ONE JSON line on stdout:
    "unit": "s",
    "vs_baseline": <numpy_baseline_s / decode_triangulate_s — the speedup on
                    the phase the NumPy reference path actually runs (the
-                   reference has no merge twin to time)>,
-   "decode_triangulate_s", "mpix_per_s", "merge_s", "chamfer_mm",
-   "backend", "pallas", "views_measured", "error"}
+                   reference has no merge twin to time); null whenever the
+                   ambient child degraded to a fallback>,
+   "decode_triangulate_s", "decode_compile_s", "mpix_per_s",
+   "merge_s" (steady), "merge_compile_s", "chamfer_mm",
+   "decode_backend"/"merge_backend"/"chamfer_backend" (per-phase provenance;
+   top-level "backend" is their join, e.g. "cpu+tpu" for a fallback-filled
+   run), "pallas", "views_measured", "error"}
 
 Robustness contract (round-1 verdict item 1):
   - the synthetic 1080p scene + 24 turntable merge clouds are rendered ONCE
@@ -42,8 +46,8 @@ MERGE_PROJ = (512, 256)
 CPU_FALLBACK_VIEWS = 4      # forced-CPU child measures 4 views, extrapolates
 ROOT = os.path.dirname(os.path.abspath(__file__))
 CACHE = os.path.join(ROOT, ".bench_cache.npz")
-CHILD_TIMEOUT_TPU = 420
-CHILD_TIMEOUT_CPU = 600
+CHILD_TIMEOUT_TPU = 900     # one host core: first-run XLA compiles dominate
+CHILD_TIMEOUT_CPU = 480
 PARENT_DEADLINE = 1500      # absolute last resort: emit an error line and exit
 
 
@@ -151,6 +155,15 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
         views = min(views, CPU_FALLBACK_VIEWS)  # CPU can't afford 24 full views
     res["backend"] = dev.platform
     log(f"child: backend={dev.platform} device={dev}")
+    # persistent executable cache: a re-run (or the driver's run after a local
+    # warmup) skips XLA compilation, so the compile-vs-steady split below
+    # reflects what a warmed deployment sees
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(ROOT, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # older jax without the knob
+        log(f"child: compilation cache unavailable ({e})")
 
     import jax.numpy as jnp
 
@@ -167,11 +180,17 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
     log(f"child: pallas={res['pallas']}")
     save()
 
+    backend = res["backend"]
     cache = load_cache()
 
     # ---- phase A: decode+triangulate, `views` views @1080p, ONE launch ----
+    # plane_eval="quadratic": the gather-free light-plane path — the stored
+    # table gather is the one op that is not HBM-bandwidth-shaped on TPU
+    # (~11x slower end to end, see BENCH notes); accuracy vs the NumPy table
+    # path is pinned by the Chamfer phase below
     rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
-    scanner = SLScanner(rig.calibration(), CAM, PROJ, row_mode=1)
+    scanner = SLScanner(rig.calibration(), CAM, PROJ, row_mode=1,
+                        plane_eval="quadratic")
     base = jax.block_until_ready(jnp.asarray(cache["frames"]))
     t0 = time.perf_counter()
     # distinct per-view content via device-side rolls (one 95 MB upload, not 24)
@@ -188,8 +207,9 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
 
     t0 = time.perf_counter()
     out = run()  # compile + warm
-    log(f"child: phase A compile+warm {time.perf_counter() - t0:.1f}s")
-    n_rep = 3 if res["backend"] != "cpu" else 1
+    decode_first = time.perf_counter() - t0
+    log(f"child: phase A compile+warm {decode_first:.1f}s")
+    n_rep = 3 if backend != "cpu" else 1
     best = np.inf
     for _ in range(n_rep):
         t0 = time.perf_counter()
@@ -197,6 +217,8 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
         best = min(best, time.perf_counter() - t0)
     scale = N_VIEWS / views
     res["decode_triangulate_s"] = round(best * scale, 4)
+    res["decode_compile_s"] = round(max(decode_first - best, 0.0), 2)
+    res["decode_backend"] = backend
     res["views_measured"] = views
     res["mpix_per_s"] = round(N_VIEWS * CAM[0] * CAM[1] / (best * scale) / 1e6, 1)
     n_valid0 = int(np.asarray(out.valid[0]).sum())
@@ -210,6 +232,7 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
     np_pts = cache["np_pts"]
     res["chamfer_mm"] = round(
         float(chamfer_distance(jx_pts[::8], np_pts[::8])), 6)
+    res["chamfer_backend"] = backend
     log(f"child: Chamfer jax-vs-numpy = {res['chamfer_mm']} mm "
         f"({len(jx_pts)} vs {len(np_pts)} pts)")
     save()
@@ -229,13 +252,31 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
                 pass
         log(f"child: {msg}")
 
+    tm: dict = {}
     t0 = time.perf_counter()
-    merged_p, _, _ = merge_360(clouds, log=merge_log)
-    res["merge_s"] = round(time.perf_counter() - t0, 3)
+    merged_p, _, _ = merge_360(clouds, log=merge_log, timings=tm)
+    merge_first = time.perf_counter() - t0
+    res["merge_s"] = round(merge_first, 3)
+    res["merge_backend"] = backend
     res["merge_points"] = int(len(merged_p))
+    res["merge_stage_s"] = tm
     res["merge_icp_fit_mean"] = round(float(np.mean(fits)), 3) if fits else None
-    log(f"child: phase B merge {res['merge_s']}s, {len(merged_p)} pts, "
-        f"mean ICP fitness {res['merge_icp_fit_mean']}")
+    log(f"child: phase B merge first run {merge_first:.2f}s (stages {tm})")
+    save()
+    # second run reuses every executable (in-process + the persistent cache):
+    # the steady/compile split (verdict round 2 #8 — TPU merges were
+    # compile-dominated). Skipped when the first run already blew the budget
+    # (the split is then visible in the persistent-cache-warmed next run).
+    if merge_first < 120 and backend != "cpu":
+        t0 = time.perf_counter()
+        merge_360(clouds, log=lambda m: None)
+        merge_steady = time.perf_counter() - t0
+        res["merge_steady_s"] = round(merge_steady, 3)
+        res["merge_compile_s"] = round(max(merge_first - merge_steady, 0.0), 3)
+        res["merge_s"] = round(merge_steady, 3)
+        log(f"child: phase B merge steady {merge_steady:.2f}s "
+            f"(+{res['merge_compile_s']}s compile on first run), "
+            f"{len(merged_p)} pts, mean ICP fitness {res['merge_icp_fit_mean']}")
     save()
 
 
@@ -265,6 +306,29 @@ def _run_child(args: list[str], timeout: int) -> dict | None:
     return None
 
 
+_PHASE_KEYS = {
+    "decode_triangulate_s": ("decode_triangulate_s", "decode_compile_s",
+                             "decode_backend", "mpix_per_s", "views_measured",
+                             "pallas"),
+    "chamfer_mm": ("chamfer_mm", "chamfer_backend"),
+    "merge_s": ("merge_s", "merge_steady_s", "merge_compile_s",
+                "merge_backend", "merge_points", "merge_icp_fit_mean",
+                "merge_stage_s"),
+}
+
+
+def _fill_missing_phases(dst: dict, src: dict) -> None:
+    """Copy whole phases (value + its provenance tags together) from the
+    fallback child for phases the ambient child never completed. Copying
+    per-key let a dead TPU child's backend labels survive over CPU numbers
+    in round 2."""
+    for marker, keys in _PHASE_KEYS.items():
+        if dst.get(marker) is None and src.get(marker) is not None:
+            for k in keys:
+                if src.get(k) is not None:
+                    dst[k] = src[k]
+
+
 def emit(final: dict) -> None:
     print(json.dumps(final), flush=True)
 
@@ -282,6 +346,7 @@ def main() -> None:
 
     signal.signal(signal.SIGALRM, alarm_handler)
     signal.alarm(PARENT_DEADLINE)
+    t_alarm = time.monotonic()
 
     try:
         cache = load_cache()
@@ -308,48 +373,65 @@ def main() -> None:
         final["numpy_baseline_s"] = round(np_s, 2)
 
         res = _run_child([f"--views={N_VIEWS}"], CHILD_TIMEOUT_TPU)
-        complete = res is not None and "merge_s" in res
+        complete = res is not None and res.get("merge_s") is not None
         if not complete:
             note = "ambient-backend child incomplete"
             if res is not None:
                 note += f" (got phases: {sorted(res.keys())})"
             log(note + "; retrying with forced CPU")
-            final["error"] = "tpu child failed; cpu fallback"
-            res_cpu = _run_child(
-                [f"--views={CPU_FALLBACK_VIEWS}", "--force-cpu"],
-                CHILD_TIMEOUT_CPU)
-            if res is None:
-                res = res_cpu
-            elif res_cpu is not None:
-                for k, v in res_cpu.items():
-                    if res.get(k) is None:
-                        res[k] = v  # fill phases the TPU child missed
+            final["error"] = "ambient child failed; cpu fallback"
+            # fit the fallback inside what's left of the parent deadline
+            # (60 s reserve for result assembly); skip it when nothing
+            # useful could finish
+            remaining = PARENT_DEADLINE - (time.monotonic() - t_alarm) - 60
+            if remaining >= 120:
+                res_cpu = _run_child(
+                    [f"--views={CPU_FALLBACK_VIEWS}", "--force-cpu"],
+                    int(min(CHILD_TIMEOUT_CPU, remaining)))
+                if res is None:
+                    res = res_cpu
+                elif res_cpu is not None:
+                    _fill_missing_phases(res, res_cpu)
+            else:
+                log("no parent budget left for a CPU fallback child")
 
         if res is None:
             # last resort: report the NumPy number itself so a real number
             # exists on the record
             final["value"] = round(np_s, 2)
-            final["vs_baseline"] = 1.0
+            final["vs_baseline"] = None
             final["backend"] = "numpy"
             final["error"] = (final.get("error") or "") + "; all jax children failed"
             emit(final)
             return
 
-        for k in ("decode_triangulate_s", "mpix_per_s", "merge_s", "chamfer_mm",
-                  "backend", "pallas", "views_measured", "merge_points",
-                  "merge_icp_fit_mean", "backend_error"):
+        for k in ("decode_triangulate_s", "decode_compile_s", "decode_backend",
+                  "mpix_per_s", "merge_s", "merge_steady_s", "merge_compile_s",
+                  "merge_backend", "chamfer_mm", "chamfer_backend", "pallas",
+                  "views_measured", "merge_points", "merge_icp_fit_mean",
+                  "merge_stage_s", "backend_error"):
             if k in res and res[k] is not None:
                 final[k] = res[k]
+        # top-level backend is derived from the per-phase provenance tags —
+        # a fallback-filled run reads "tpu+cpu", never a bare "tpu" over CPU
+        # numbers (round-2 verdict weak #5)
+        backends = sorted({res.get(k) for k in
+                           ("decode_backend", "merge_backend",
+                            "chamfer_backend")} - {None})
+        final["backend"] = "+".join(backends) if backends else None
         dt = res.get("decode_triangulate_s")
         mg = res.get("merge_s")
         if dt is not None:
             final["value"] = round(dt + (mg or 0.0), 3)
-            final["vs_baseline"] = round(np_s / dt, 2)
+            # the NumPy reference implements only decode+triangulate (the
+            # reference has no merge twin to time): the ratio is phase-like
+            # -for-like, and suppressed entirely on a degraded run
+            if final["error"] is None:
+                final["vs_baseline"] = round(np_s / dt, 2)
             if mg is None:
                 final["error"] = (final.get("error") or "") + "; merge phase missing"
         else:
             final["value"] = round(np_s, 2)
-            final["vs_baseline"] = 1.0
             final["error"] = (final.get("error") or "") + "; decode phase missing"
     except Exception as e:
         final["error"] = (final.get("error") or "") + f"; {type(e).__name__}: {e}"

@@ -145,9 +145,7 @@ def _poisson_dispatch(pts, nr, v, depth: int, log):
     log(f"[mesh] WARNING: depth {depth} needs a multi-device accelerator "
         f"mesh (have {n_dev} {jax.default_backend()}); stepping down to "
         f"depth 9 dense")
-    res = poisson.poisson_solve(pts, nr, v, depth=9)
-    log(f"[mesh] poisson depth=9 iso={float(res.iso):.4f}")
-    return res
+    return poisson.poisson_solve(pts, nr, v, depth=9)
 
 
 def mesh_to_stl(path: str, vertices, faces) -> None:

@@ -171,18 +171,28 @@ def _gray_to_binary(g, xp):
     return g
 
 
-def _decode_axis(frames_i16, start, max_bits, n_use, xp):
+def _decode_axis(frames_i16, start, max_bits, n_use, xp, n_frames=None):
     """Decode one axis from pattern/inverse pairs at frames[start : start+2*max_bits].
 
     Reads only the first ``n_use`` bit pairs (the rest are skipped with the frame
     pointer still advancing, per server/processing.py:88-111) and scales the result
     by 2^(max_bits - n_use) to keep projector coordinates full-range.
+
+    ``n_frames`` (the O2 truncated-stack variant, Old/multi_point_cloud_process
+    .py:96-105 early ``break``): bit pairs beyond the end of the stack decode
+    as 0 in the LSBs instead of raising — the pair count actually read is
+    ``min(n_use, (n_frames - start) // 2)``.
     """
-    pat = frames_i16[start : start + 2 * n_use : 2]      # [n_use, H, W]
-    inv = frames_i16[start + 1 : start + 2 * n_use : 2]  # [n_use, H, W]
-    bits = (pat > inv).astype(xp.int32)                  # [n_use, H, W]
-    weights = (1 << np.arange(n_use - 1, -1, -1, dtype=np.int32))  # MSB first
-    gray = xp.sum(bits * xp.asarray(weights)[:, None, None], axis=0)
+    avail = n_use if n_frames is None else max(0, min(n_use, (n_frames - start) // 2))
+    pat = frames_i16[start : start + 2 * avail : 2]      # [avail, H, W]
+    inv = frames_i16[start + 1 : start + 2 * avail : 2]  # [avail, H, W]
+    bits = (pat > inv).astype(xp.int32)                  # [avail, H, W]
+    # bit b is the MSB-first bit (n_use-1-b) of an n_use-bit gray value
+    weights = (1 << np.arange(n_use - 1, n_use - 1 - avail, -1, dtype=np.int32))
+    if avail == 0:
+        gray = xp.zeros(frames_i16.shape[1:], xp.int32)
+    else:
+        gray = xp.sum(bits * xp.asarray(weights)[:, None, None], axis=0)
     binary = _gray_to_binary(gray, xp)
     return binary * (1 << (max_bits - n_use))
 
@@ -199,6 +209,7 @@ def _decode_impl(
     n_sets_row: int,
     downsample: int,
     xp,
+    skip_remaining_before_row: bool = False,
 ):
     # patterns projected with downsample k carry bits of the k-decimated raster;
     # decode in that space, then scale by k to restore full projector coordinates
@@ -210,14 +221,19 @@ def _decode_impl(
     n_use_row = max(1, min(int(n_sets_row), max_row_bits))
 
     need = 2 + 2 * (max_col_bits + max_row_bits)
+    n_frames = None
     if frames.shape[0] < need:
-        raise ValueError(
-            f"Not enough frames: got {frames.shape[0]}, need {need} "
-            f"(white + black + 2*({max_col_bits} col + {max_row_bits} row bit-planes)) "
-            f"for a {n_cols}x{n_rows} projector."
-        )
+        if not skip_remaining_before_row:
+            raise ValueError(
+                f"Not enough frames: got {frames.shape[0]}, need {need} "
+                f"(white + black + 2*({max_col_bits} col + {max_row_bits} row bit-planes)) "
+                f"for a {n_cols}x{n_rows} projector. Pass "
+                f"skip_remaining_before_row=True for the legacy truncated-stack "
+                f"decode (Old/multi_point_cloud_process.py:96-125)."
+            )
+        n_frames = frames.shape[0]
 
-    if xp is not np:
+    if xp is not np and n_frames is None:
         from structured_light_for_3d_model_replication_tpu.ops import (
             pallas_kernels as pk,
         )
@@ -246,8 +262,10 @@ def _decode_impl(
     black = fr[1]
     mask = (white > shadow_thresh) & ((white - black) > contrast_thresh)
 
-    col_map = _decode_axis(fr, 2, max_col_bits, n_use_col, xp) * downsample
-    row_map = _decode_axis(fr, 2 + 2 * max_col_bits, max_row_bits, n_use_row, xp) * downsample
+    col_map = _decode_axis(fr, 2, max_col_bits, n_use_col, xp,
+                           n_frames=n_frames) * downsample
+    row_map = _decode_axis(fr, 2 + 2 * max_col_bits, max_row_bits, n_use_row,
+                           xp, n_frames=n_frames) * downsample
     return DecodeResult(col_map.astype(xp.int32), row_map.astype(xp.int32), mask, texture)
 
 
@@ -276,6 +294,15 @@ def _hists_device(frames):
     return _shadow_contrast_hists(white_u8, diff_u8, jnp)
 
 
+@jax.jit
+def _hists_device_views(frames_v):
+    def one(frames):
+        white_u8, diff_u8 = _white_diff_u8(frames, jnp)
+        return _shadow_contrast_hists(white_u8, diff_u8, jnp)
+
+    return jax.lax.map(one, frames_v)
+
+
 def resolve_thresholds(frames, thresh_mode: str, shadow_val: float, contrast_val: float,
                        xp=np) -> tuple[float, float]:
     """Shadow/contrast thresholds for a capture stack.
@@ -297,6 +324,25 @@ def resolve_thresholds(frames, thresh_mode: str, shadow_val: float, contrast_val
     return float(_otsu_from_hist(h_w, np)), float(_otsu_from_hist(h_d, np))
 
 
+def resolve_thresholds_views(frames_v, thresh_mode: str, shadow_val: float,
+                             contrast_val: float) -> tuple[np.ndarray, np.ndarray]:
+    """Per-view (shadow, contrast) threshold arrays [V] f32 for a [V, F, H, W]
+    capture stack. In ``otsu`` mode all V histogram pairs are built on-device
+    in one launch and fetched in ONE transfer, then scored host-side in exact
+    fp64 (same backend-parity contract as resolve_thresholds); the round-2
+    per-view host round-trip loop is gone."""
+    v = frames_v.shape[0]
+    if thresh_mode != "otsu":
+        return (np.full(v, shadow_val, np.float32),
+                np.full(v, contrast_val, np.float32))
+    h_w, h_d = _hists_device_views(frames_v)
+    h_w = np.asarray(h_w)
+    h_d = np.asarray(h_d)
+    ss = np.array([_otsu_from_hist(h_w[i], np) for i in range(v)], np.float32)
+    cs = np.array([_otsu_from_hist(h_d[i], np) for i in range(v)], np.float32)
+    return ss, cs
+
+
 def decode_stack_np(
     frames: np.ndarray,
     texture: np.ndarray | None = None,
@@ -309,6 +355,7 @@ def decode_stack_np(
     shadow_val: float = 40.0,
     contrast_val: float = 10.0,
     downsample: int = 1,
+    skip_remaining_before_row: bool = False,
 ) -> DecodeResult:
     """NumPy (bit-exact CPU reference) decode of a [F, H, W] capture stack."""
     if texture is None:
@@ -318,16 +365,19 @@ def decode_stack_np(
         frames, texture, shadow, contrast,
         n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col, n_sets_row=n_sets_row,
         downsample=downsample, xp=np,
+        skip_remaining_before_row=skip_remaining_before_row,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_cols", "n_rows", "n_sets_col", "n_sets_row", "otsu_device", "downsample"),
+    static_argnames=("n_cols", "n_rows", "n_sets_col", "n_sets_row", "otsu_device",
+                     "downsample", "skip_remaining_before_row"),
 )
 def _decode_jit(
     frames, texture, shadow_val, contrast_val,
     *, n_cols, n_rows, n_sets_col, n_sets_row, otsu_device, downsample,
+    skip_remaining_before_row,
 ):
     if otsu_device:
         white_u8, diff_u8 = _white_diff_u8(frames, jnp)
@@ -339,6 +389,7 @@ def _decode_jit(
         frames, texture, shadow, contrast,
         n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col, n_sets_row=n_sets_row,
         downsample=downsample, xp=jnp,
+        skip_remaining_before_row=skip_remaining_before_row,
     )
 
 
@@ -354,6 +405,7 @@ def decode_stack(
     shadow_val: float = 40.0,
     contrast_val: float = 10.0,
     downsample: int = 1,
+    skip_remaining_before_row: bool = False,
 ) -> DecodeResult:
     """JAX/TPU decode of a [F, H, W] capture stack.
 
@@ -377,4 +429,5 @@ def decode_stack(
         jnp.asarray(shadow_val, jnp.float32), jnp.asarray(contrast_val, jnp.float32),
         n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col, n_sets_row=n_sets_row,
         otsu_device=otsu_device, downsample=downsample,
+        skip_remaining_before_row=skip_remaining_before_row,
     )

@@ -27,6 +27,7 @@ tests run the kernels in interpreter mode on CPU for bit parity.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,7 @@ __all__ = ["use_pallas", "pallas_mode", "nn1", "radius_count_pallas",
 _FAR = 1e9
 
 _PALLAS_MODE: str | None = None  # "compiled" | "interpret" (probe result, cached)
+_VIEWS_KERNEL_OK = True          # view-batched decode lowering probe result
 
 
 def _probe_compiled() -> bool:
@@ -68,17 +70,40 @@ def _probe_compiled() -> bool:
             np.tile(np.arange(256, dtype=np.uint8)[None, None, :], (10, 8, 1)))
         col, _, _ = _decode_call(frames, jnp.asarray([40.0, 10.0], jnp.float32),
                                  3, 1, 3, 1, 8, 256, False)
-        return col.shape == (8, 256)
+        if col.shape != (8, 256):
+            return False
     except Exception:
         return False
+
+    # the round-2 failure mode: under jax.vmap the kernel lowers through
+    # the batching rule (custom_vmap -> the view-batched kernel); probe it
+    # at a small batched shape so "probe passes, flagship crashes" cannot
+    # recur. A views-kernel failure does NOT disable the other kernels —
+    # the batching rule just falls back to lax.map of the single-view
+    # lowering (_VIEWS_KERNEL_OK gate).
+    global _VIEWS_KERNEL_OK
+    try:
+        colb, _, _ = _decode_call_views(
+            jnp.stack([frames, frames]),
+            jnp.asarray([[40.0, 10.0], [35.0, 8.0]], jnp.float32),
+            3, 1, 3, 1, 8, 256, False)
+        _VIEWS_KERNEL_OK = colb.shape == (2, 8, 256)
+    except Exception:
+        _VIEWS_KERNEL_OK = False
+    return True
 
 
 def pallas_mode() -> str:
     """'compiled' when the default backend compiles and runs Mosaic kernels
     correctly (probed once per process, cached); 'interpret' otherwise
-    (CPU tests, or a TPU whose Mosaic path fails to compile)."""
+    (CPU tests, or a TPU whose Mosaic path fails to compile, or the
+    ``SLSCAN_PALLAS=0`` operator kill switch)."""
     global _PALLAS_MODE
     if _PALLAS_MODE is None:
+        if os.environ.get("SLSCAN_PALLAS", "").strip().lower() in (
+                "0", "off", "false", "interpret"):
+            _PALLAS_MODE = "interpret"
+            return _PALLAS_MODE
         try:
             backend = jax.default_backend()
         except Exception:  # pragma: no cover - backend init failure
@@ -272,19 +297,14 @@ def radius_count_pallas(points, valid, radius, block_q: int = 1024,
 # decode_maps_fused: Gray decode in one pass over the frame stack
 # ---------------------------------------------------------------------------
 
-def _decode_kernel(frames_ref, thr_ref, col_ref, row_ref, mask_ref, *,
-                   n_bits_col: int, n_bits_row: int, n_use_col: int,
-                   n_use_row: int):
-    """frames_ref [F, th, tw] u8 tile; thr_ref [2] f32 (shadow, contrast).
-
-    Bit compares, Gray->binary XOR cascade, rescale shift, and the
-    shadow+contrast mask — all on the tile while it sits in VMEM.
-    """
+def _decode_tile(read_frame, shadow, contrast, *, n_bits_col: int,
+                 n_bits_row: int, n_use_col: int, n_use_row: int):
+    """Shared tile math: bit compares, Gray->binary XOR cascade, rescale
+    shift, and the shadow+contrast mask — all on one VMEM-resident tile.
+    ``read_frame(i)`` returns frame i of the tile as int32."""
     # Mosaic lacks a direct u8->f32 cast; widen through int32 first
-    white = frames_ref[0].astype(jnp.int32).astype(jnp.float32)
-    black = frames_ref[1].astype(jnp.int32).astype(jnp.float32)
-    shadow = thr_ref[0]
-    contrast = thr_ref[1]
+    white = read_frame(0).astype(jnp.float32)
+    black = read_frame(1).astype(jnp.float32)
     mask = (white > shadow) & ((white - black) > contrast)
 
     def decode_axis(start, n_bits, n_use):
@@ -292,17 +312,49 @@ def _decode_kernel(frames_ref, thr_ref, col_ref, row_ref, mask_ref, *,
         binary = jnp.zeros(shape, jnp.int32)
         gray_prev = jnp.zeros(shape, jnp.int32)
         for b in range(n_use):  # static unroll: n_use <= 11
-            img_p = frames_ref[start + 2 * b].astype(jnp.int32)
-            img_i = frames_ref[start + 2 * b + 1].astype(jnp.int32)
+            img_p = read_frame(start + 2 * b)
+            img_i = read_frame(start + 2 * b + 1)
             g = (img_p > img_i).astype(jnp.int32)
             bit = gray_prev ^ g          # XOR cascade: binary bit from gray
             binary = (binary << 1) | bit
             gray_prev = bit
         return binary << (n_bits - n_use)  # coordinate rescale
 
-    col_ref[:] = decode_axis(2, n_bits_col, n_use_col)
-    row_ref[:] = decode_axis(2 + 2 * n_bits_col, n_bits_row, n_use_row)
+    col = decode_axis(2, n_bits_col, n_use_col)
+    row = decode_axis(2 + 2 * n_bits_col, n_bits_row, n_use_row)
+    return col, row, mask
+
+
+def _decode_kernel(frames_ref, thr_ref, col_ref, row_ref, mask_ref, *,
+                   n_bits_col: int, n_bits_row: int, n_use_col: int,
+                   n_use_row: int):
+    """frames_ref [F, th, tw] u8 tile; thr_ref [2] f32 (shadow, contrast)."""
+    col, row, mask = _decode_tile(
+        lambda i: frames_ref[i].astype(jnp.int32), thr_ref[0], thr_ref[1],
+        n_bits_col=n_bits_col, n_bits_row=n_bits_row, n_use_col=n_use_col,
+        n_use_row=n_use_row)
+    col_ref[:] = col
+    row_ref[:] = row
     mask_ref[:] = mask
+
+
+def _decode_kernel_views(frames_ref, thr_ref, col_ref, row_ref, mask_ref, *,
+                         n_bits_col: int, n_bits_row: int, n_use_col: int,
+                         n_use_row: int):
+    """View-batched twin: frames_ref [1, F, th, tw] u8 (one view per grid
+    step along axis 0); thr_ref [V, 2] f32 lives whole in SMEM and is indexed
+    by the view grid coordinate — per-view thresholds enter through
+    program_id instead of picking up a vmap batch dimension (the round-2
+    Mosaic lowering failure: SMEM operands cannot be batched)."""
+    v = pl.program_id(0)
+    col, row, mask = _decode_tile(
+        lambda i: frames_ref[0, i].astype(jnp.int32),
+        thr_ref[v, 0], thr_ref[v, 1],
+        n_bits_col=n_bits_col, n_bits_row=n_bits_row, n_use_col=n_use_col,
+        n_use_row=n_use_row)
+    col_ref[0] = col
+    row_ref[0] = row
+    mask_ref[0] = mask
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -341,14 +393,88 @@ def _decode_call(frames, thr, n_bits_col: int, n_bits_row: int,
     return col, row, mask
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "n_bits_col", "n_bits_row", "n_use_col", "n_use_row", "tile_h", "tile_w",
+    "interpret"))
+def _decode_call_views(frames, thr, n_bits_col: int, n_bits_row: int,
+                       n_use_col: int, n_use_row: int, tile_h: int,
+                       tile_w: int, interpret: bool):
+    v, f, h, w = frames.shape
+    grid = (v, h // tile_h, w // tile_w)
+    col, row, mask = pl.pallas_call(
+        functools.partial(_decode_kernel_views, n_bits_col=n_bits_col,
+                          n_bits_row=n_bits_row, n_use_col=n_use_col,
+                          n_use_row=n_use_row),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, f, tile_h, tile_w), lambda v, i, j: (v, 0, i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # thr [V,2] whole in SMEM
+        ],
+        out_specs=(
+            pl.BlockSpec((1, tile_h, tile_w), lambda v, i, j: (v, i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_h, tile_w), lambda v, i, j: (v, i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_h, tile_w), lambda v, i, j: (v, i, j),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((v, h, w), jnp.int32),
+            jax.ShapeDtypeStruct((v, h, w), jnp.int32),
+            jax.ShapeDtypeStruct((v, h, w), jnp.bool_),
+        ),
+        interpret=interpret,
+    )(frames, thr)
+    return col, row, mask
+
+
+@functools.lru_cache(maxsize=None)
+def _decode_caller(n_bits_col: int, n_bits_row: int, n_use_col: int,
+                   n_use_row: int, tile_h: int, tile_w: int, interpret: bool):
+    """custom_vmap wrapper: a plain call runs the single-view kernel; a
+    ``jax.vmap`` over views dispatches to the natively view-batched kernel
+    (grid axis over views, SMEM thresholds indexed per view) instead of
+    Mosaic's generic batching rule, which rejects batched SMEM operands."""
+
+    @jax.custom_batching.custom_vmap
+    def call(frames, thr):
+        return _decode_call(frames, thr, n_bits_col, n_bits_row, n_use_col,
+                            n_use_row, tile_h, tile_w, interpret)
+
+    @call.def_vmap
+    def _batched(axis_size, in_batched, frames, thr):
+        frames_b, thr_b = in_batched
+        if not frames_b:
+            frames = jnp.broadcast_to(frames[None],
+                                      (axis_size,) + frames.shape)
+        if not thr_b:
+            thr = jnp.broadcast_to(thr[None], (axis_size, 2))
+        if _VIEWS_KERNEL_OK:
+            out = _decode_call_views(frames, thr, n_bits_col, n_bits_row,
+                                     n_use_col, n_use_row, tile_h, tile_w,
+                                     interpret)
+        else:  # views lowering unavailable: serialize over the single-view
+            out = jax.lax.map(
+                lambda ft: _decode_call(ft[0], ft[1], n_bits_col, n_bits_row,
+                                        n_use_col, n_use_row, tile_h, tile_w,
+                                        interpret),
+                (frames, thr))
+        return out, (True, True, True)
+
+    return call
+
+
 def decode_maps_fused(frames, shadow, contrast, *, n_bits_col: int,
                       n_bits_row: int, n_use_col: int, n_use_row: int,
-                      tile_h: int = 8, tile_w: int = 256):
+                      tile_h: int = 8, tile_w: int = 256,
+                      interpret: bool | None = None):
     """Fused col/row/mask decode of a [F, H, W] uint8 stack.
 
     Equivalent to ops/graycode._decode_impl's map computation (manual
     thresholds); H and W must divide by the tile (1080p does: 1080 = 135*8,
-    1920 = 7.5*256 -> use tile_w=128 there).
+    1920 = 7.5*256 -> use tile_w=128 there). vmap-safe over views (one
+    level): the batched call lowers to the view-batched kernel.
     """
     frames = jnp.asarray(frames)
     f, h, w = frames.shape
@@ -356,6 +482,9 @@ def decode_maps_fused(frames, shadow, contrast, *, n_bits_col: int,
         tile_h //= 2
     while w % tile_w:
         tile_w //= 2
-    thr = jnp.asarray([shadow, contrast], jnp.float32)
-    return _decode_call(frames, thr, n_bits_col, n_bits_row, n_use_col,
-                        n_use_row, tile_h, tile_w, _interpret())
+    thr = jnp.stack([jnp.asarray(shadow, jnp.float32),
+                     jnp.asarray(contrast, jnp.float32)])
+    itp = _interpret() if interpret is None else interpret
+    call = _decode_caller(n_bits_col, n_bits_row, n_use_col, n_use_row,
+                          tile_h, tile_w, itp)
+    return call(frames, thr)

@@ -13,6 +13,8 @@ implementation.
   inspect-calib (O11)    human-readable calibration summary
   patterns      (A4)     write the Gray-code pattern stack to disk
   serve         (A2)     run the phone-capture HTTP server standalone
+  viewer        (A22)    web viewer for per-stage clouds/meshes (the operator
+                         front-end: merge previews, cleanup inspection)
   scan          tab 1    capture one structured-light sequence
   auto-scan     tab 6    full turntable sweep (12 x 30 degrees)
   synth         (new)    render a synthetic scan dataset for tests/demos
@@ -69,6 +71,10 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
                    help="override merge.method")
     p.add_argument("--save-transforms", default=None,
                    help="write per-view 4x4 transforms as JSON")
+    p.add_argument("--artifacts", default=None,
+                   help="record per-step merge previews into this directory "
+                        "(browse them with 'viewer', the web equivalent of the "
+                        "reference's blocking per-step preview)")
     add_config_args(p)
 
     p = sub.add_parser("mesh", help="mesh a cloud PLY into STL or mesh-PLY")
@@ -107,6 +113,18 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p = sub.add_parser("serve", help="run the phone-capture HTTP server")
     p.add_argument("--save-dir", default="captures",
                    help="where manual /upload images land")
+    p.add_argument("--viewer", action="store_true",
+                   help="also serve the artifact web viewer (next port up)")
+    p.add_argument("--artifact-dir", default="artifacts",
+                   help="directory the --viewer browses")
+    add_config_args(p)
+
+    p = sub.add_parser("viewer",
+                       help="web viewer for per-stage artifacts (PLY/STL): "
+                            "the operator front-end (GUI tab parity, "
+                            "server/gui.py:1549-1564 preview flow)")
+    p.add_argument("artifact_dir")
+    p.add_argument("--port", type=int, default=5051)
     add_config_args(p)
 
     p = sub.add_parser("scan", help="capture one structured-light sequence")
@@ -159,7 +177,15 @@ def _cmd_merge(args) -> int:
     cfg = _cfg(args)
     if args.method:
         cfg.merge.method = args.method
-    _, _, transforms = stages.merge_views(args.input_folder, args.output, cfg=cfg)
+    step_cb = None
+    if args.artifacts:
+        from structured_light_for_3d_model_replication_tpu.acquire.viewer import (
+            StageRecorder,
+        )
+
+        step_cb = StageRecorder(args.artifacts).merge_step
+    _, _, transforms = stages.merge_views(args.input_folder, args.output,
+                                          cfg=cfg, step_callback=step_cb)
     if args.save_transforms:
         with open(args.save_transforms, "w") as f:
             json.dump([np_t.tolist() for np_t in transforms], f, indent=2)
@@ -243,11 +269,43 @@ def _cmd_serve(args) -> int:
                         upload_dir=args.save_dir).start()
     print(f"capture server on http://{cfg.http_host}:{srv.port} "
           f"(open this on the phone; ctrl-C to stop)")
+    view = None
+    if getattr(args, "viewer", False):
+        from structured_light_for_3d_model_replication_tpu.acquire.viewer import (
+            ViewerServer,
+        )
+
+        os.makedirs(args.artifact_dir, exist_ok=True)
+        view = ViewerServer(args.artifact_dir, cfg.http_host,
+                            srv.port + 1).start()
+        print(f"artifact viewer on http://{cfg.http_host}:{view.port}")
     try:
         while True:
             time.sleep(1)
     except KeyboardInterrupt:
         srv.stop()
+        if view is not None:
+            view.stop()
+    return 0
+
+
+@_runner("viewer")
+def _cmd_viewer(args) -> int:
+    import time
+
+    from structured_light_for_3d_model_replication_tpu.acquire.viewer import (
+        ViewerServer,
+    )
+
+    cfg = _cfg(args).acquire
+    view = ViewerServer(args.artifact_dir, cfg.http_host, args.port).start()
+    print(f"artifact viewer on http://{cfg.http_host}:{view.port} "
+          f"(serving {args.artifact_dir}; ctrl-C to stop)")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        view.stop()
     return 0
 
 

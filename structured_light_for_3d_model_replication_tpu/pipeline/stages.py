@@ -260,10 +260,13 @@ def clean_cloud(input_ply: str, output_ply: str, cfg: Config | None = None,
 
 
 def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
-                log=print):
+                log=print, step_callback=None):
     """Folder of per-view PLYs -> one registered 360-degree cloud
     (merge_pro_360 parity; ``cfg.merge.method`` picks greedy sequential (A18)
-    or pose-graph global optimization (Old/360Merge.py:50-78 capability))."""
+    or pose-graph global optimization (Old/360Merge.py:50-78 capability)).
+    ``step_callback(i, points, colors)`` mirrors the reference's per-step
+    merge preview hook (processing.py:600-603) — e.g. a
+    acquire.viewer.StageRecorder.merge_step for the web viewer."""
     from structured_light_for_3d_model_replication_tpu.models import (
         reconstruction as recon,
     )
@@ -289,10 +292,10 @@ def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
     with prof.trace():
         if cfg.merge.method == "posegraph":
             points, colors, transforms = recon.merge_360_posegraph(
-                clouds, cfg.merge, log=log)
+                clouds, cfg.merge, log=log, step_callback=step_callback)
         else:
-            points, colors, transforms = recon.merge_360(clouds, cfg.merge,
-                                                         log=log)
+            points, colors, transforms = recon.merge_360(
+                clouds, cfg.merge, log=log, step_callback=step_callback)
     ply.write_ply(output_ply, points, colors)
     log(f"[merge] wrote {output_ply} ({len(points):,} points)")
     return points, colors, transforms

@@ -132,3 +132,35 @@ def test_jax_decode_bit_exact_vs_numpy(mode, rng):
     np.testing.assert_array_equal(np.asarray(r_jx.col_map), r_np.col_map)
     np.testing.assert_array_equal(np.asarray(r_jx.row_map), r_np.row_map)
     np.testing.assert_array_equal(np.asarray(r_jx.mask), r_np.mask)
+
+
+def test_truncated_stack_skip_before_row_variant():
+    """O2 semantics (Old/multi_point_cloud_process.py:96-125): a stack that
+    ends mid-sequence decodes the bits present (missing bits -> 0 in the
+    LSBs) instead of raising; columns are unaffected."""
+    from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
+
+    fr = gc.generate_pattern_stack(64, 32)  # 2 + 2*(6 + 5) = 24 frames
+    full = gc.decode_stack_np(fr, n_cols=64, n_rows=32, thresh_mode="manual")
+    # keep white+black+all 6 col pairs+2 of 5 row pairs = 18 frames
+    tr = gc.decode_stack_np(fr[:18], n_cols=64, n_rows=32,
+                            thresh_mode="manual",
+                            skip_remaining_before_row=True)
+    assert (tr.col_map == full.col_map).all()
+    # row: 2 MSBs read, 3 LSBs zero -> gray value g = bit0<<4 | bit1<<3
+    bits = gc.gray_bits(32, 5)
+    g = (bits[0].astype(np.int32) << 4) | (bits[1].astype(np.int32) << 3)
+    b = g ^ (g >> 1)
+    b = b ^ (b >> 2)
+    b = b ^ (b >> 4)
+    expected = b  # n_use=5 of 5 -> no rescale
+    assert (tr.row_map[:, 0] == expected).all()
+    # jax twin matches
+    trj = gc.decode_stack(jnp.asarray(fr[:18]), n_cols=64, n_rows=32,
+                          thresh_mode="manual",
+                          skip_remaining_before_row=True)
+    assert (np.asarray(trj.row_map) == np.asarray(tr.row_map)).all()
+    assert (np.asarray(trj.col_map) == np.asarray(tr.col_map)).all()
+    # without the flag the truncated stack is an error (server semantics)
+    with pytest.raises(ValueError):
+        gc.decode_stack_np(fr[:18], n_cols=64, n_rows=32, thresh_mode="manual")

@@ -101,3 +101,56 @@ def test_merge_360_recovers_turntable_poses(rng):
 def test_chamfer_identical_is_zero(rng):
     a = _rand_cloud(rng, 2000)
     assert rec.chamfer_distance(a, a) < 1e-3
+
+
+def test_register_pairs_batched_matches_chain(rng):
+    """Three independent pairs registered in ONE launch recover their
+    ground-truth relative poses (the merge chain's odometry batch)."""
+    base = _rand_cloud(rng, 3000)
+    vd = jnp.ones(len(base), bool)
+    angles = [10.0, 15.0, 20.0]
+    srcs, dsts = [], []
+    for ang in angles:
+        R = np.asarray(syn.rotate_y(ang), np.float32)
+        t = np.array([3.0, -1.0, 2.0], np.float32)
+        srcs.append(_transform(R.T, -R.T @ t, base))
+        dsts.append(base)
+    nd = nrmlib.estimate_normals(jnp.asarray(base), vd, 20)
+    fd = reg.fpfh_features(jnp.asarray(base), nd, vd, radius=12.0, k=48)
+    sf, sn = [], []
+    for s in srcs:
+        ns_ = nrmlib.estimate_normals(jnp.asarray(s), vd, 20)
+        sf.append(reg.fpfh_features(jnp.asarray(s), ns_, vd, radius=12.0, k=48))
+    T, gfit, ifit, irmse = reg.register_pairs(
+        np.stack(srcs), np.ones((3, len(base)), bool), np.stack(sf),
+        np.stack(dsts), np.ones((3, len(base)), bool),
+        np.stack([fd] * 3), np.stack([np.asarray(nd)] * 3),
+        max_dist=5.0, icp_max_dist=5.0, trials=2048, icp_iters=25)
+    T = np.asarray(T)
+    for p in range(3):
+        assert float(ifit[p]) > 0.9, (p, float(ifit[p]))
+        moved = _transform(T[p, :3, :3], T[p, :3, 3], srcs[p])
+        err = np.linalg.norm(moved - dsts[p], axis=1)
+        assert np.median(err) < 0.5, (p, np.median(err))
+
+
+def test_mutual_correspondence_filter_improves_fitness(rng):
+    """The mutual filter must not degrade (and typically raises) global
+    RANSAC fitness vs one-directional matching on the same inputs."""
+    dst = _rand_cloud(rng, 2500)
+    R = np.asarray(syn.rotate_y(25.0), np.float32)
+    t = np.array([8.0, 1.0, -4.0], np.float32)
+    src = _transform(R.T, -R.T @ t, dst)
+    vd = jnp.ones(len(dst), bool)
+    nd = nrmlib.estimate_normals(jnp.asarray(dst), vd, 20)
+    ns_ = nrmlib.estimate_normals(jnp.asarray(src), vd, 20)
+    fd = reg.fpfh_features(jnp.asarray(dst), nd, vd, radius=12.0, k=48)
+    fs = reg.fpfh_features(jnp.asarray(src), ns_, vd, radius=12.0, k=48)
+    res_mut = reg.ransac_global_registration(src, fs, None, dst, fd, None,
+                                             max_dist=5.0, trials=2048,
+                                             mutual=True)
+    res_one = reg.ransac_global_registration(src, fs, None, dst, fd, None,
+                                             max_dist=5.0, trials=2048,
+                                             mutual=False)
+    assert float(res_mut.fitness) >= float(res_one.fitness) - 0.05
+    assert float(res_mut.fitness) > 0.5
