@@ -62,13 +62,37 @@ class StageRecorder:
                 json.dump(self._events, f)
             os.replace(tmp, self._progress_path)
 
-    def merge_step(self, i: int, points: np.ndarray, colors: np.ndarray) -> None:
+    def merge_step(self, i: int, points, colors) -> None:
+        """``points``/``colors`` may be one array or a LIST of per-view
+        arrays (merge_360 passes lists so strided previews never force a
+        full-cloud copy)."""
         from structured_light_for_3d_model_replication_tpu.io import ply
 
-        stride = max(1, len(points) // self.max_points)
+        if isinstance(points, (list, tuple)):
+            total = sum(len(p) for p in points)
+            stride = max(1, total // self.max_points)
+            pts = np.concatenate([np.asarray(p)[::stride] for p in points])
+            cols = np.concatenate([np.asarray(c)[::stride] for c in colors])
+        else:
+            total = len(points)
+            stride = max(1, total // self.max_points)
+            pts = np.asarray(points)[::stride]
+            cols = np.asarray(colors)[::stride]
         path = os.path.join(self.dir, f"merge_step_{i:02d}.ply")
-        ply.write_ply(path, points[::stride], colors[::stride])
-        self.log_stage("merge", step=i, points=int(len(points)), file=os.path.basename(path))
+        # atomic: the viewer may serve this file mid-merge
+        ply.write_ply(path + ".tmp", pts, cols)
+        os.replace(path + ".tmp", path)
+        self.log_stage("merge", step=i, points=int(total),
+                       file=os.path.basename(path))
+
+    def autoscan_progress(self, info: dict) -> None:
+        """acquire.autoscan progress hook: the live elapsed / estimated-
+        remaining readout of the reference's auto-scan popup
+        (gui.py:1740-1783), polled by the viewer page instead of modal."""
+        self.log_stage("autoscan", view=info.get("view"),
+                       turns=info.get("turns"), angle=info.get("angle"),
+                       elapsed_s=round(float(info.get("elapsed_s", 0.0)), 1),
+                       remaining_s=round(float(info.get("remaining_s", 0.0)), 1))
 
     def save_cloud(self, name: str, points: np.ndarray,
                    colors: np.ndarray | None = None) -> str:
@@ -77,7 +101,8 @@ class StageRecorder:
         if colors is None:
             colors = np.full((len(points), 3), 180, np.uint8)
         path = os.path.join(self.dir, name if name.endswith(".ply") else name + ".ply")
-        ply.write_ply(path, points, colors)
+        ply.write_ply(path + ".tmp", points, colors)
+        os.replace(path + ".tmp", path)
         self.log_stage("cloud", points=int(len(points)), file=os.path.basename(path))
         return path
 

@@ -181,7 +181,10 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
         merged_p.append(moved.astype(np.float32))
         merged_c.append(np.asarray(clouds[i][1], np.uint8))
         if step_callback is not None:
-            step_callback(i, np.concatenate(merged_p), np.concatenate(merged_c))
+            # per-view array LISTS, not a concatenated copy: a callback that
+            # previews/strides (acquire.viewer.StageRecorder) stays O(V) per
+            # step instead of re-copying the whole merged cloud every step
+            step_callback(i, merged_p, merged_c)
     tm["accumulate_s"] = round(_time.perf_counter() - t0, 3)
 
     t0 = _time.perf_counter()
@@ -287,7 +290,7 @@ def merge_360_posegraph(clouds, cfg: MergeConfig | None = None, log=print,
         merged_p.append(moved.astype(np.float32))
         merged_c.append(np.asarray(c_full, np.uint8))
         if step_callback is not None and i > 0:
-            step_callback(i, np.concatenate(merged_p), np.concatenate(merged_c))
+            step_callback(i, merged_p, merged_c)
     points = np.concatenate(merged_p)
     colors = np.concatenate(merged_c)
     points, colors = _postprocess_merged(points, colors, cfg)

@@ -100,12 +100,52 @@ class SLScanner:
                              self.poly_col, self.poly_row,
                              jnp.float32(self.epipolar_tol), cfg=self._static)
 
+    def _can_fuse(self, frames_v) -> bool:
+        """The single-pass Mosaic kernel handles the flagship configuration:
+        quadratic plane eval, row_mode 0/1, uint8 tile-aligned frames."""
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            pallas_kernels as pk,
+        )
+
+        n_cols, n_rows, _, _, downsample, _, use_poly = self._static
+        h, w = frames_v.shape[-2], frames_v.shape[-1]
+        nbc = max(1, int(np.ceil(np.log2(n_cols // downsample))))
+        nbr = max(1, int(np.ceil(np.log2(n_rows // downsample))))
+        need = 2 + 2 * (nbc + nbr)  # truncated stacks go through the jnp
+        return (pk.scan_fused_ok() and use_poly and self.row_mode in (0, 1)
+                and frames_v.dtype == jnp.uint8
+                and frames_v.shape[-3] >= need
+                and h % 8 == 0 and w % 128 == 0)
+
+    def _fused_views(self, frames_v, shadow_v, contrast_v) -> CloudResult:
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            pallas_kernels as pk,
+        )
+
+        n_cols, n_rows, n_sets_col, n_sets_row, downsample, row_mode, _ = \
+            self._static
+        h, w = frames_v.shape[-2], frames_v.shape[-1]
+        thr_v = jnp.stack([jnp.asarray(shadow_v, jnp.float32),
+                           jnp.asarray(contrast_v, jnp.float32)], axis=1)
+        pts, valid, tex = pk.scan_points_fused_views(
+            frames_v, thr_v, self.rays.reshape(h, w, 3), self.oc,
+            self.poly_col, self.poly_row, self.epipolar_tol,
+            n_cols=n_cols, n_rows=n_rows, n_use_col=n_sets_col,
+            n_use_row=n_sets_row, row_mode=row_mode, downsample=downsample)
+        colors = jnp.repeat(tex[..., None], 3, axis=-1)
+        return CloudResult(pts, colors, valid)
+
     def forward(self, frames, thresh_mode: str = "otsu",
                 shadow_val: float = 40.0, contrast_val: float = 10.0) -> CloudResult:
         """One view: frames uint8 [F, H, W] -> CloudResult (fixed shape [H*W])."""
         frames = jnp.asarray(frames)
         s, c = graycode.resolve_thresholds(frames, thresh_mode, shadow_val,
                                            contrast_val, jnp)
+        if self._can_fuse(frames):
+            out = self._fused_views(frames[None],
+                                    np.asarray([s], np.float32),
+                                    np.asarray([c], np.float32))
+            return CloudResult(out.points[0], out.colors[0], out.valid[0])
         return self._fwd(frames, jnp.float32(s), jnp.float32(c))
 
     def forward_views(self, frames_v, thresh_mode: str = "otsu",
@@ -123,6 +163,8 @@ class SLScanner:
         frames_v = jnp.asarray(frames_v)
         ss, cs = graycode.resolve_thresholds_views(frames_v, thresh_mode,
                                                    shadow_val, contrast_val)
+        if self._can_fuse(frames_v):
+            return self._fused_views(frames_v, ss, cs)
         return _scan_forward_views(frames_v, jnp.asarray(ss, jnp.float32),
                                    jnp.asarray(cs, jnp.float32), self.rays,
                                    self.oc, self.plane_col, self.plane_row,

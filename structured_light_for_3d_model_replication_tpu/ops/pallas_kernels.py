@@ -36,12 +36,19 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["use_pallas", "pallas_mode", "nn1", "radius_count_pallas",
-           "decode_maps_fused"]
+           "decode_maps_fused", "scan_points_fused_views"]
 
 _FAR = 1e9
 
 _PALLAS_MODE: str | None = None  # "compiled" | "interpret" (probe result, cached)
 _VIEWS_KERNEL_OK = True          # view-batched decode lowering probe result
+_SCAN_FUSED_OK = True            # fused decode+triangulate lowering probe result
+
+
+def scan_fused_ok() -> bool:
+    """True when the fused scan kernel compiled in the capability probe
+    (always True in interpret mode — tests exercise it explicitly)."""
+    return use_pallas() and _SCAN_FUSED_OK
 
 
 def _probe_compiled() -> bool:
@@ -90,6 +97,24 @@ def _probe_compiled() -> bool:
         _VIEWS_KERNEL_OK = colb.shape == (2, 8, 256)
     except Exception:
         _VIEWS_KERNEL_OK = False
+
+    global _SCAN_FUSED_OK
+    try:
+        rays = np.zeros((8, 256, 3), np.float32)
+        rays[..., 2] = 1.0
+        pts, valid, _ = scan_points_fused_views(
+            jnp.stack([frames, frames]),
+            jnp.asarray([[40.0, 10.0], [35.0, 8.0]], jnp.float32),
+            rays, np.zeros(3, np.float32),
+            np.asarray([[0, 0, 1, -400], [0, 0, 0, 0], [0, 0, 0, 0]],
+                       np.float32),
+            np.asarray([[0, 1, 0, -1], [0, 0, 0, 0], [0, 0, 0, 0]],
+                       np.float32),
+            2.0, n_cols=8, n_rows=2, n_use_col=3, n_use_row=1, row_mode=1,
+            interpret=False)
+        _SCAN_FUSED_OK = pts.shape == (2, 8 * 256, 3)
+    except Exception:
+        _SCAN_FUSED_OK = False
     return True
 
 
@@ -463,6 +488,147 @@ def _decode_caller(n_bits_col: int, n_bits_row: int, n_use_col: int,
         return out, (True, True, True)
 
     return call
+
+
+def _scan_fused_kernel(frames_ref, thr_ref, sc_ref, rx_ref, ry_ref, rz_ref,
+                       px_ref, py_ref, pz_ref, valid_ref, tex_ref, *,
+                       n_bits_col: int, n_bits_row: int, n_use_col: int,
+                       n_use_row: int, n_cols: int, n_rows: int,
+                       row_mode: int, downsample: int):
+    """Whole scan forward on one VMEM tile: Gray decode + quadratic light-
+    plane evaluation + ray-plane intersection + epipolar filter, ONE read of
+    the [F, th, tw] frame stack, no [H, W] intermediates in HBM.
+
+    Fuses the two hot stages of the reference pipeline
+    (server/processing.py:28-124 decode, :127-207 triangulate modes 0/1)
+    that even XLA keeps as separate HBM-materialized maps.
+
+    Scalar layout sc_ref (SMEM f32[32]): oc xyz @0..2, epipolar_tol @3,
+    poly_col A/B/C rows @4..15, poly_row A/B/C rows @16..27 (each 3x4
+    row-major: n4(i) = A + B i + C i^2, calib.geometry
+    plane_poly_coefficients).
+    """
+    v = pl.program_id(0)
+    col, row, mask = _decode_tile(
+        lambda i: frames_ref[0, i].astype(jnp.int32),
+        thr_ref[v, 0], thr_ref[v, 1],
+        n_bits_col=n_bits_col, n_bits_row=n_bits_row, n_use_col=n_use_col,
+        n_use_row=n_use_row)
+    ox = sc_ref[0]
+    oy = sc_ref[1]
+    oz = sc_ref[2]
+    eps = sc_ref[3]
+    rx = rx_ref[...]
+    ry = ry_ref[...]
+    rz = rz_ref[...]
+
+    def poly_plane(idx, n_planes, base):
+        i = jnp.clip(idx * downsample, 0, n_planes - 1).astype(jnp.float32)
+        comps = []
+        for c in range(4):
+            a = sc_ref[base + c]
+            b = sc_ref[base + 4 + c]
+            q = sc_ref[base + 8 + c]
+            comps.append(a + i * (b + i * q))
+        nx, ny, nz, d = comps
+        inv = jax.lax.rsqrt(jnp.maximum(nx * nx + ny * ny + nz * nz, 1e-30))
+        return nx * inv, ny * inv, nz * inv, d * inv
+
+    nx, ny, nz, d = poly_plane(col, n_cols, 4)
+    denom = nx * rx + ny * ry + nz * rz
+    numer = nx * ox + ny * oy + nz * oz + d
+    ok = jnp.abs(denom) > 1e-6
+    t = jnp.where(ok, -numer / jnp.where(ok, denom, 1.0), 0.0)
+    px = ox + rx * t
+    py = oy + ry * t
+    pz = oz + rz * t
+    valid = mask & ok
+    if row_mode == 1:
+        mx, my, mz, dr = poly_plane(row, n_rows, 16)
+        dist = jnp.abs(mx * px + my * py + mz * pz + dr)
+        valid = valid & (dist < eps)
+
+    px_ref[0] = px
+    py_ref[0] = py
+    pz_ref[0] = pz
+    valid_ref[0] = valid
+    tex_ref[0] = frames_ref[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_bits_col", "n_bits_row", "n_use_col", "n_use_row", "n_cols", "n_rows",
+    "row_mode", "downsample", "tile_h", "tile_w", "interpret"))
+def _scan_fused_call(frames_v, thr_v, scalars, rx, ry, rz, *,
+                     n_bits_col: int, n_bits_row: int, n_use_col: int,
+                     n_use_row: int, n_cols: int, n_rows: int, row_mode: int,
+                     downsample: int, tile_h: int, tile_w: int,
+                     interpret: bool):
+    v, f, h, w = frames_v.shape
+    grid = (v, h // tile_h, w // tile_w)
+    hw_spec = pl.BlockSpec((tile_h, tile_w), lambda v, i, j: (i, j),
+                           memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec((1, tile_h, tile_w), lambda v, i, j: (v, i, j),
+                            memory_space=pltpu.VMEM)
+    out2 = jax.ShapeDtypeStruct((v, h, w), jnp.float32)
+    px, py, pz, valid, tex = pl.pallas_call(
+        functools.partial(_scan_fused_kernel, n_bits_col=n_bits_col,
+                          n_bits_row=n_bits_row, n_use_col=n_use_col,
+                          n_use_row=n_use_row, n_cols=n_cols, n_rows=n_rows,
+                          row_mode=row_mode, downsample=downsample),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, f, tile_h, tile_w), lambda v, i, j: (v, 0, i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # thr [V,2]
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # scalars [32]
+            hw_spec, hw_spec, hw_spec,               # rays x/y/z [H,W]
+        ],
+        out_specs=(out_spec, out_spec, out_spec, out_spec, out_spec),
+        out_shape=(out2, out2, out2,
+                   jax.ShapeDtypeStruct((v, h, w), jnp.bool_),
+                   jax.ShapeDtypeStruct((v, h, w), jnp.uint8)),
+        interpret=interpret,
+    )(frames_v, thr_v, scalars, rx, ry, rz)
+    return px, py, pz, valid, tex
+
+
+def scan_points_fused_views(frames_v, thr_v, rays_hw3, oc, poly_col, poly_row,
+                            epipolar_tol, *, n_cols: int, n_rows: int,
+                            n_use_col: int, n_use_row: int, row_mode: int,
+                            downsample: int = 1, tile_h: int = 8,
+                            tile_w: int = 256, interpret: bool | None = None):
+    """Fused capture-stack -> 3D points for a [V, F, H, W] uint8 batch.
+
+    Returns (points [V, H*W, 3] f32, valid [V, H*W] bool, tex [V, H*W] u8).
+    Quadratic (gather-free) plane evaluation only; row_mode 0 or 1.
+    """
+    frames_v = jnp.asarray(frames_v)
+    vb, f, h, w = frames_v.shape
+    while h % tile_h:
+        tile_h //= 2
+    while w % tile_w:
+        tile_w //= 2
+    nbc = max(1, int(np.ceil(np.log2(n_cols // downsample))))
+    nbr = max(1, int(np.ceil(np.log2(n_rows // downsample))))
+    scalars = jnp.concatenate([
+        jnp.asarray(oc, jnp.float32).reshape(3),
+        jnp.asarray(epipolar_tol, jnp.float32).reshape(1),
+        jnp.asarray(poly_col, jnp.float32).reshape(12),
+        jnp.asarray(poly_row, jnp.float32).reshape(12),
+        jnp.zeros(4, jnp.float32),
+    ])
+    rays = jnp.asarray(rays_hw3, jnp.float32)
+    itp = _interpret() if interpret is None else interpret
+    px, py, pz, valid, tex = _scan_fused_call(
+        frames_v, jnp.asarray(thr_v, jnp.float32), scalars,
+        rays[..., 0], rays[..., 1], rays[..., 2],
+        n_bits_col=nbc, n_bits_row=nbr,
+        n_use_col=max(1, min(n_use_col, nbc)),
+        n_use_row=max(1, min(n_use_row, nbr)),
+        n_cols=n_cols, n_rows=n_rows, row_mode=row_mode,
+        downsample=downsample, tile_h=tile_h, tile_w=tile_w, interpret=itp)
+    pts = jnp.stack([px, py, pz], axis=-1).reshape(vb, h * w, 3)
+    return pts, valid.reshape(vb, h * w), tex.reshape(vb, h * w)
 
 
 def decode_maps_fused(frames, shadow, contrast, *, n_bits_col: int,

@@ -313,10 +313,16 @@ def _feature_correspondences(sf, df, sv, dv, mutual: bool):
     return corr_j, corr_ok
 
 
-def _ransac_core(src, dst, corr_j, corr_ok, max_dist, edge_sim, key, *,
-                 trials: int, refine_iters: int):
+def _ransac_core(src, src_valid, dst, dst_valid, corr_j, corr_ok, max_dist,
+                 edge_sim, key, *, trials: int, refine_iters: int):
     """Batched-hypothesis RANSAC + iterated weighted-Kabsch refine
-    (traceable; no host sync)."""
+    (traceable; no host sync).
+
+    Fitness/RMSE follow Open3D's GetRegistrationResultAndCorrespondences:
+    nearest-neighbor matches of ALL transformed source points within
+    max_dist — an alignment measure — not the feature-correspondence hit
+    rate (which on feature-ambiguous geometry, e.g. smooth spheres, caps
+    near its match precision no matter how good the transform is)."""
     ns = src.shape[0]
     probs = corr_ok.astype(jnp.float32)
     probs = probs / jnp.maximum(probs.sum(), 1.0)
@@ -361,15 +367,17 @@ def _ransac_core(src, dst, corr_j, corr_ok, max_dist, edge_sim, key, *,
         return w_next, (T_ref, inl_r, d2r)
 
     w0 = inl[best].astype(jnp.float32)
-    _, (T_refs, inl_rs, d2rs) = jax.lax.scan(
+    _, (T_refs, _, _) = jax.lax.scan(
         refine_step, w0, None, length=max(int(refine_iters), 1))
     T_ref = T_refs[-1]
-    inl_r = inl_rs[-1]
-    d2r = d2rs[-1]
-    nv = jnp.maximum(corr_ok.sum().astype(jnp.float32), 1.0)
-    fitness = inl_r.sum() / nv
-    rmse = jnp.sqrt((jnp.where(inl_r, d2r, 0)).sum()
-                    / jnp.maximum(inl_r.sum(), 1))
+    # Open3D-parity evaluation: NN over all valid source points
+    cur = transform_points(T_ref, src)
+    _, d2n = _nn1_brute_jnp(cur, dst, dst_valid)
+    inl_n = src_valid & (d2n <= max_dist * max_dist) & jnp.isfinite(d2n)
+    nv = jnp.maximum(src_valid.sum().astype(jnp.float32), 1.0)
+    fitness = inl_n.sum() / nv
+    rmse = jnp.sqrt((jnp.where(inl_n, d2n, 0)).sum()
+                    / jnp.maximum(inl_n.sum(), 1))
     return T_ref, fitness, rmse
 
 
@@ -378,8 +386,9 @@ def _ransac_core(src, dst, corr_j, corr_ok, max_dist, edge_sim, key, *,
 def _ransac_jit(src, dst, sf, df, sv, dv, max_dist, edge_sim, key, *,
                 trials: int, mutual: bool, refine_iters: int):
     corr_j, corr_ok = _feature_correspondences(sf, df, sv, dv, mutual)
-    return _ransac_core(src, dst, corr_j, corr_ok, max_dist, edge_sim, key,
-                        trials=trials, refine_iters=refine_iters)
+    return _ransac_core(src, sv, dst, dv, corr_j, corr_ok, max_dist,
+                        edge_sim, key, trials=trials,
+                        refine_iters=refine_iters)
 
 
 def ransac_global_registration(src_pts, src_feat, src_valid,
@@ -426,8 +435,8 @@ def _register_pairs_jit(src_pts, src_valid, src_feat,
         i, sp, sv, sf, dp, dv, df, dn = args
         corr_j, corr_ok = _feature_correspondences(sf, df, sv, dv, mutual)
         k = jax.random.fold_in(key, i)
-        T0, gfit, grmse = _ransac_core(sp, dp, corr_j, corr_ok, max_dist,
-                                       edge_sim, k, trials=trials,
+        T0, gfit, grmse = _ransac_core(sp, sv, dp, dv, corr_j, corr_ok,
+                                       max_dist, edge_sim, k, trials=trials,
                                        refine_iters=refine_iters)
         T, fit, rmse = _icp_core(sp, sv, dp, dv, dn, T0, icp_max_dist,
                                  icp_iters, nn_mode)

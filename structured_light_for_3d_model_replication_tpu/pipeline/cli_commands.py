@@ -134,6 +134,9 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p = sub.add_parser("auto-scan", help="full 360-degree turntable sweep")
     p.add_argument("output_root")
     p.add_argument("--base-name", default="scan")
+    p.add_argument("--artifacts", default=None,
+                   help="record live sweep progress (elapsed/remaining) into "
+                        "this directory for the web viewer")
     add_config_args(p)
 
     p = sub.add_parser("synth",
@@ -364,12 +367,20 @@ def _cmd_auto_scan(args) -> int:
     )
 
     cfg = _cfg(args)
+    progress = None
+    if args.artifacts:
+        from structured_light_for_3d_model_replication_tpu.acquire.viewer import (
+            StageRecorder,
+        )
+
+        progress = StageRecorder(args.artifacts).autoscan_progress
     server, projector, sequencer, turntable = _build_capture_rig(cfg)
     try:
         result = auto_scan_360(
             sequencer, turntable, args.output_root,
             turns=cfg.acquire.turns, step_deg=cfg.acquire.degrees_per_turn,
             base_name=args.base_name, rotate_timeout=cfg.acquire.rotate_timeout_s,
+            progress=progress,
         )
     finally:
         projector.close()

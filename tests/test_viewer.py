@@ -1,0 +1,68 @@
+"""Operator web viewer: endpoints, artifact safety, stage recording."""
+import json
+import os
+import urllib.request
+
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.acquire.viewer import (
+    StageRecorder,
+    ViewerServer,
+)
+
+
+def _get(base, path):
+    return urllib.request.urlopen(base + path, timeout=10)
+
+
+def test_viewer_serves_artifacts_and_progress(tmp_path, rng):
+    rec = StageRecorder(str(tmp_path))
+    pts = rng.normal(size=(500, 3)).astype(np.float32)
+    cols = np.full((500, 3), 120, np.uint8)
+    rec.merge_step(1, pts, cols)
+    rec.autoscan_progress({"view": 2, "turns": 12, "angle": 30.0,
+                           "elapsed_s": 10.0, "remaining_s": 50.0})
+
+    with ViewerServer(str(tmp_path), host="127.0.0.1", port=0) as v:
+        base = f"http://127.0.0.1:{v.port}"
+        page = _get(base, "/").read().decode()
+        assert "slscan" in page and "parsePLY" in page
+        lst = json.load(_get(base, "/api/list"))
+        names = [a["name"] for a in lst["artifacts"]]
+        assert "merge_step_01.ply" in names
+        raw = _get(base, "/api/file?name=merge_step_01.ply").read()
+        assert raw.startswith(b"ply")
+        prog = json.load(_get(base, "/api/progress"))
+        assert prog[0]["stage"] == "merge" and prog[0]["points"] == 500
+        assert prog[1]["stage"] == "autoscan" and prog[1]["remaining_s"] == 50.0
+
+
+def test_viewer_blocks_traversal_and_unknown(tmp_path):
+    (tmp_path / "ok.ply").write_bytes(b"ply\nend_header\n")
+    secret = tmp_path.parent / "secret.ply"
+    secret.write_bytes(b"ply\nsecret")
+    with ViewerServer(str(tmp_path), host="127.0.0.1", port=0) as v:
+        base = f"http://127.0.0.1:{v.port}"
+        for bad in ("/api/file?name=../secret.ply",
+                    "/api/file?name=%2e%2e%2fsecret.ply",
+                    "/api/file?name=ok.txt"):
+            code = None
+            try:
+                _get(base, bad)
+            except urllib.error.HTTPError as e:
+                code = e.code
+            assert code == 400, bad
+        try:
+            _get(base, "/api/file?name=missing.ply")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+
+def test_stage_recorder_downsamples_large_steps(tmp_path):
+    rec = StageRecorder(str(tmp_path), max_points_per_step=100)
+    pts = np.zeros((1000, 3), np.float32)
+    rec.merge_step(3, pts, np.zeros((1000, 3), np.uint8))
+    from structured_light_for_3d_model_replication_tpu.io import ply
+
+    d = ply.read_ply(str(tmp_path / "merge_step_03.ply"))
+    assert len(d["points"]) == 100
