@@ -154,3 +154,47 @@ def test_mutual_correspondence_filter_improves_fitness(rng):
                                              mutual=False)
     assert float(res_mut.fitness) >= float(res_one.fitness) - 0.05
     assert float(res_mut.fitness) > 0.5
+
+
+def test_register_pairs_sharded_matches_unsharded(rng):
+    """The mesh-sharded pair batch must agree with the single-device batch
+    (pairs are independent; only the RANSAC key schedule differs, so we
+    compare recovered poses, not bitwise transforms)."""
+    import jax
+
+    from structured_light_for_3d_model_replication_tpu.parallel import (
+        mesh as meshlib,
+    )
+
+    base = _rand_cloud(rng, 1500)
+    vd = jnp.ones(len(base), bool)
+    nd = nrmlib.estimate_normals(jnp.asarray(base), vd, 20)
+    fd = np.asarray(reg.fpfh_features(jnp.asarray(base), nd, vd,
+                                      radius=12.0, k=48))
+    srcs, sfs = [], []
+    for ang in [8.0, 14.0, 20.0, 26.0]:
+        R = np.asarray(syn.rotate_y(ang), np.float32)
+        t = np.array([3.0, -1.0, 2.0], np.float32)
+        s = _transform(R.T, -R.T @ t, base)
+        srcs.append(s)
+        ns_ = nrmlib.estimate_normals(jnp.asarray(s), vd, 20)
+        sfs.append(np.asarray(reg.fpfh_features(jnp.asarray(s), ns_, vd,
+                                                radius=12.0, k=48)))
+    P = len(srcs)
+    args = (np.stack(srcs), np.ones((P, len(base)), bool), np.stack(sfs),
+            np.stack([base] * P), np.ones((P, len(base)), bool),
+            np.stack([fd] * P), np.stack([np.asarray(nd)] * P))
+    mesh = meshlib.make_mesh(devices=jax.devices())  # 8 virtual CPU devices
+    T_s, _, f_s, _ = reg.register_pairs_sharded(
+        mesh, *args, max_dist=5.0, icp_max_dist=5.0, trials=1024,
+        icp_iters=20)
+    T_u, _, f_u, _ = reg.register_pairs(
+        *args, max_dist=5.0, icp_max_dist=5.0, trials=1024, icp_iters=20)
+    for p in range(P):
+        assert float(f_s[p]) > 0.9 and float(f_u[p]) > 0.9
+        m_s = _transform(np.asarray(T_s)[p, :3, :3], np.asarray(T_s)[p, :3, 3],
+                         srcs[p])
+        m_u = _transform(np.asarray(T_u)[p, :3, :3], np.asarray(T_u)[p, :3, 3],
+                         srcs[p])
+        assert np.median(np.linalg.norm(m_s - base, axis=1)) < 0.5
+        assert np.median(np.linalg.norm(m_u - base, axis=1)) < 0.5
