@@ -168,27 +168,11 @@ def _icp_core(src, src_valid, dst_pts, dst_valid, dst_normals, T0,
     """Traceable fixed-iteration point-to-plane ICP. ``nn_mode``:
     'pallas' = Mosaic brute-force 1-NN kernel (unbatched lowering — safe
     inside lax.map/scan), 'brute' = dense jnp distance matrix."""
-    n = src.shape[0]
     nv = jnp.maximum(src_valid.sum().astype(jnp.float32), 1.0)
-    if nn_mode == "pallas":
-        from structured_light_for_3d_model_replication_tpu.ops import (
-            pallas_kernels as pk,
-        )
-
-        nb_pad = -(-dst_pts.shape[0] // block) * block
-        dst8 = pk._pad8(dst_pts, dst_valid, nb_pad)
-        nq_pad = -(-n // block) * block
-
-    def corr(cur):
-        if nn_mode == "pallas":
-            q8 = jnp.zeros((nq_pad, 8), jnp.float32).at[:n, :3].set(cur)
-            d2c, idxc = pk._nn1_call(q8, dst8, block, block, False)
-            return idxc[:n, 0], d2c[:n, 0]
-        return _nn1_brute_jnp(cur, dst_pts, dst_valid)
 
     def step(T, _):
         cur = transform_points(T, src)
-        j, d2 = corr(cur)
+        j, d2 = _nn1_dispatch(cur, dst_pts, dst_valid, nn_mode, block)
         q = dst_pts[j]
         nrm = dst_normals[j]
         ok = src_valid & (d2 <= max_dist * max_dist) & jnp.isfinite(d2)
@@ -549,7 +533,7 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
     axes (data-major). P is padded to a multiple of the device count with
     duplicate rows, which are dropped from the returned arrays.
     """
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import PartitionSpec
     try:
         from jax import shard_map
     except ImportError:  # pragma: no cover - older jax layout
@@ -595,5 +579,13 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
         in_specs=(spec,) * 8,
         out_specs=(spec, spec, spec, spec),
     ))
-    T, gfit, ifit, irmse = fn(*[jnp.asarray(a) for a in arrays], keys)
+    inputs = [jnp.asarray(a) for a in arrays]
+    try:
+        T, gfit, ifit, irmse = fn(*inputs, keys)
+    except Exception:
+        if kw["nn_mode"] == "brute":
+            raise
+        # Mosaic compile failure at this shape: degrade like register_pairs
+        kw["nn_mode"] = "brute"
+        T, gfit, ifit, irmse = fn(*inputs, keys)
     return T[:p], gfit[:p], ifit[:p], irmse[:p]

@@ -297,3 +297,26 @@ def test_capture_page_served_at_root(server):
     for token in ("/poll_command", "/upload", "lastProcessedId",
                   "applyConstraints", "FormData"):
         assert token in body, token
+
+
+def test_auto_scan_progress_feeds_viewer_recorder(tmp_path):
+    """The auto-scan progress hook writes the live elapsed/remaining feed the
+    web viewer polls (gui.py:1740-1783 popup parity, VERDICT missing #3)."""
+    import json as _json
+
+    from structured_light_for_3d_model_replication_tpu.acquire.viewer import (
+        StageRecorder,
+    )
+
+    proj = VirtualProjector(32, 16)
+    seq = CaptureSequencer(proj, lambda p: open(p, "wb").write(b"x"),
+                           proj_size=(32, 16), log=lambda *_: None)
+    art = tmp_path / "arts"
+    rec = StageRecorder(str(art))
+    auto_scan_360(seq, LoopbackTurntable(), str(tmp_path / "scans"), turns=3,
+                  step_deg=120.0, progress=rec.autoscan_progress,
+                  log=lambda *_: None)
+    prog = _json.loads((art / "progress.json").read_text())
+    assert [e["view"] for e in prog] == [1, 2, 3]
+    assert all(e["stage"] == "autoscan" for e in prog)
+    assert prog[-1]["remaining_s"] == 0.0
