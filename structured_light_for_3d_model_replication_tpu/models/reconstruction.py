@@ -83,25 +83,67 @@ def _prep_features_jit(p, v, feat_radius):
     return nr, feat
 
 
+@jax.jit
+def _voxel_views_jit(pts_v, valid_v, vs):
+    def one(a):
+        # zero colors created in-graph: the color segment-sums are dead code
+        # and XLA eliminates them (registration only needs geometry)
+        p, _, v = pc.voxel_downsample(a[0], jnp.zeros(a[0].shape, jnp.uint8),
+                                      a[1], vs)
+        return p, v
+
+    return jax.lax.map(one, (pts_v, valid_v))
+
+
+@jax.jit
+def _features_views_jit(pts_v, valid_v, feat_radius):
+    return jax.lax.map(
+        lambda a: _prep_features_jit(a[0], a[1], feat_radius),
+        (pts_v, valid_v))
+
+
 def _preprocess_views(clouds, voxel: float, sample_before: int):
     """Preprocess every view to ONE fixed padded size: per-view voxel
     downsample (one reused executable) + host compaction, then stacked
     normals+FPFH. A single pad size means a single compile for every
     downstream per-pair stage — the round-2 chain re-jitted whenever
     consecutive views straddled a 2048 bucket boundary (verdict weak #7)."""
-    compacted = []
+    sampled = []
     for p_full, c_full in clouds:
-        p_s, c_s = _sample_every(np.asarray(p_full, np.float32),
-                                 np.asarray(c_full, np.uint8), sample_before)
-        compacted.append(_downsample_compact(
-            p_s, c_s, np.ones(len(p_s), bool), voxel))
+        sampled.append(_sample_every(np.asarray(p_full, np.float32),
+                                     np.asarray(c_full, np.uint8),
+                                     sample_before))
+    # pad RAW inputs to one bucket: per-view raw sizes differ, and an
+    # unpadded loop compiles voxel_downsample once per distinct size. Views
+    # are batched into fixed-size chunks (one compile, few launches) with
+    # the chunk sized to bound resident memory — full-res views would
+    # otherwise stack several GB at once.
+    n_views = len(sampled)
+    n_raw = -(-max(len(p) for p, _ in sampled) // 8192) * 8192
+    chunk = max(1, min(n_views, (8 << 20) // n_raw))  # <= ~100 MB f32 points
+    compacted = []
+    for s in range(0, n_views, chunk):
+        part = sampled[s:s + chunk]
+        pts = np.full((chunk, n_raw, 3), 1e9, np.float32)
+        valid = np.zeros((chunk, n_raw), bool)
+        for k, (p_s, _) in enumerate(part):
+            pts[k, :len(p_s)] = p_s
+            valid[k, :len(p_s)] = True
+        p_all, v_all = _voxel_views_jit(jnp.asarray(pts), jnp.asarray(valid),
+                                        jnp.float32(voxel))
+        p_all = np.asarray(p_all)
+        v_all = np.asarray(v_all)
+        compacted.extend(p_all[k][v_all[k]] for k in range(len(part)))
+
+    # re-pad the survivors to one size and batch normals+FPFH the same way
     n_pad = -(-max(max(len(p) for p in compacted), 1) // 2048) * 2048
-    preps = []
-    for p_c in compacted:
-        p, v = _pad_prep(p_c, n_pad)
-        nr, feat = _prep_features_jit(p, v, jnp.float32(5.0 * voxel))
-        preps.append(_Prep(p, v, nr, feat))
-    return preps
+    padded = [_pad_prep(p_c, n_pad) for p_c in compacted]
+    p_stack = jnp.stack([p for p, _ in padded])
+    v_stack = jnp.stack([v for _, v in padded])
+    nr_all, feat_all = _features_views_jit(p_stack, v_stack,
+                                           jnp.float32(5.0 * voxel))
+    return [_Prep(p_stack[i], v_stack[i], nr_all[i], feat_all[i])
+            for i in range(n_views)]
 
 
 def _register_chain_batched(preps, cfg: MergeConfig, voxel: float,
