@@ -32,7 +32,7 @@ import numpy as np
 
 __all__ = ["ViewerServer", "StageRecorder"]
 
-_EXTS = (".ply", ".stl")
+_EXTS = (".ply", ".stl", ".png")
 
 
 class StageRecorder:
@@ -161,8 +161,10 @@ class _ViewerHandler(BaseHTTPRequestHandler):
             if not os.path.exists(full):
                 self._json({"error": "not found"}, 404)
                 return
+            ctype = ("image/png" if safe.lower().endswith(".png")
+                     else "application/octet-stream")
             with open(full, "rb") as f:
-                self._bytes(f.read(), "application/octet-stream")
+                self._bytes(f.read(), ctype)
         else:
             self._json({"error": "unknown endpoint"}, 404)
 
@@ -316,6 +318,18 @@ async function load(){
   info.textContent='loading '+name+'…';
   const r=await fetch('api/file?name='+encodeURIComponent(name));
   const buf=await r.arrayBuffer();
+  if(name.toLowerCase().endsWith('.png')){
+    // calibration plots etc. render as plain images
+    const img=new Image();
+    img.onload=()=>{pts=null;
+      ctx.fillStyle='#14161a';ctx.fillRect(0,0,cv.width,cv.height);
+      const sc=Math.min(cv.width/img.width,cv.height/img.height,1);
+      ctx.drawImage(img,(cv.width-img.width*sc)/2,(cv.height-img.height*sc)/2,
+                    img.width*sc,img.height*sc);
+      info.textContent=`${name}: ${img.width}x${img.height} image`;};
+    img.src=URL.createObjectURL(new Blob([buf],{type:'image/png'}));
+    return;
+  }
   const parsed=name.toLowerCase().endsWith('.stl')?parseSTL(buf):parsePLY(buf);
   pts=parsed.P; cols=parsed.C; tris=parsed.T;
   const n=pts.length/3;

@@ -129,19 +129,34 @@ def _icp_jit(src, src_valid, grid: gridlib.HashGrid, dst_normals, T0,
     return T, fit[-1], rmse[-1]
 
 
-def _nn1_brute_jnp(cur, dst_pts, dst_valid):
-    """Exact 1-NN via a dense [N, M] distance matrix (argmin on-chip). The
-    jnp twin of pallas_kernels.nn1 for traced contexts without Mosaic."""
-    # full f32: the d2 expansion cancels catastrophically in bf16 (same
-    # reasoning as pallas_kernels._nn1_kernel's HIGHEST-precision dot)
-    cross = jnp.matmul(cur, dst_pts.T,
-                       precision=jax.lax.Precision.HIGHEST)
-    d2 = ((cur * cur).sum(-1, keepdims=True)
-          + (dst_pts * dst_pts).sum(-1)[None, :]
-          - 2.0 * cross)
-    d2 = jnp.where(dst_valid[None, :], d2, jnp.inf)
-    j = jnp.argmin(d2, axis=1).astype(jnp.int32)
-    return j, jnp.take_along_axis(d2, j[:, None], axis=1)[:, 0]
+def _nn1_brute_jnp(cur, dst_pts, dst_valid, block_q: int = 2048):
+    """Exact 1-NN via dense distance blocks (argmin on-chip). The jnp twin of
+    pallas_kernels.nn1 for traced contexts without Mosaic.
+
+    Queries are processed in ``block_q`` chunks (lax.map) so peak memory is
+    O(block_q * M) instead of O(N * M) — a 20k x 20k cloud pair would
+    otherwise materialize a 1.7 GB matrix per call."""
+    n = cur.shape[0]
+    m = dst_pts.shape[0]
+    d2_dst = (dst_pts * dst_pts).sum(-1)
+
+    def chunk_nn(q):
+        # full f32: the d2 expansion cancels catastrophically in bf16 (same
+        # reasoning as pallas_kernels._nn1_kernel's HIGHEST-precision dot)
+        cross = jnp.matmul(q, dst_pts.T,
+                           precision=jax.lax.Precision.HIGHEST)
+        d2 = ((q * q).sum(-1, keepdims=True) + d2_dst[None, :] - 2.0 * cross)
+        d2 = jnp.where(dst_valid[None, :], d2, jnp.inf)
+        j = jnp.argmin(d2, axis=1).astype(jnp.int32)
+        return j, jnp.take_along_axis(d2, j[:, None], axis=1)[:, 0]
+
+    if n * m <= (4 << 20):
+        return chunk_nn(cur)
+    n_pad = -(-n // block_q) * block_q
+    curp = jnp.concatenate(
+        [cur, jnp.full((n_pad - n, 3), 1e9, cur.dtype)]) if n_pad > n else cur
+    j, d2 = jax.lax.map(chunk_nn, curp.reshape(-1, block_q, 3))
+    return j.reshape(-1)[:n], d2.reshape(-1)[:n]
 
 
 def _nn1_dispatch(cur, dst_pts, dst_valid, nn_mode: str, block: int = 1024):
@@ -297,24 +312,50 @@ def fpfh_features(points, normals, valid, radius: float, k: int = 64):
 # Global registration: feature matching + batched RANSAC (A17)
 # ---------------------------------------------------------------------------
 
-def _feature_correspondences(sf, df, sv, dv, mutual: bool):
-    """Nearest-feature correspondences src->dst via a dense [Ns, Nd] distance
-    matmul (MXU). With ``mutual`` (Open3D's mutual_filter semantics,
-    processing.py:477-484's checker spirit) a correspondence survives only if
-    its dst point's nearest src feature points back — unless that leaves
-    fewer than 10 matches, in which case the one-directional set is kept
-    (round-2 verdict weak #3: one-directional argmin matches were the main
-    cause of near-threshold global fitness)."""
-    cross = sf @ df.T
-    d2f = (sf * sf).sum(-1, keepdims=True) + (df * df).sum(-1)[None, :] \
-        - 2.0 * cross
-    d2f = jnp.where(dv[None, :], d2f, jnp.inf)
-    corr_j = jnp.argmin(d2f, axis=1).astype(jnp.int32)
+def _feature_correspondences(sf, df, sv, dv, mutual: bool,
+                             block: int = 2048):
+    """Nearest-feature correspondences src->dst via dense feature-distance
+    matmuls on the MXU, chunked over src rows so peak memory is
+    O(block * Nd), not O(Ns * Nd). With ``mutual`` (Open3D's mutual_filter
+    semantics, processing.py:477-484's checker spirit) a correspondence
+    survives only if its dst point's nearest src feature points back —
+    unless that leaves fewer than 10 matches, in which case the
+    one-directional set is kept (round-2 verdict weak #3: one-directional
+    argmin matches were the main cause of near-threshold global fitness)."""
+    ns = sf.shape[0]
+    nf = sf.shape[1]
+    df2 = (df * df).sum(-1)
+
+    def chunk(args):
+        f, v = args
+        cross = jnp.matmul(f, df.T, precision=jax.lax.Precision.HIGHEST)
+        d2 = (f * f).sum(-1, keepdims=True) + df2[None, :] - 2.0 * cross
+        d2 = jnp.where(dv[None, :], d2, jnp.inf)
+        cj = jnp.argmin(d2, axis=1).astype(jnp.int32)
+        # dst-side running best over this chunk's valid src rows
+        d2s = jnp.where(v[:, None], d2, jnp.inf)
+        bmin = d2s.min(axis=0)
+        barg = jnp.argmin(d2s, axis=0).astype(jnp.int32)
+        return cj, bmin, barg
+
+    if ns <= block:
+        corr_j, bmin, barg = chunk((sf, sv))
+        back_i = barg
+    else:
+        n_pad = -(-ns // block) * block
+        sfp = jnp.concatenate([sf, jnp.zeros((n_pad - ns, nf), sf.dtype)]) \
+            if n_pad > ns else sf
+        svp = jnp.concatenate([sv, jnp.zeros(n_pad - ns, bool)]) \
+            if n_pad > ns else sv
+        cj, bmin, barg = jax.lax.map(
+            chunk, (sfp.reshape(-1, block, nf), svp.reshape(-1, block)))
+        corr_j = cj.reshape(-1)[:ns]
+        kbest = jnp.argmin(bmin, axis=0)                       # [Nd] chunk id
+        back_i = (jnp.take_along_axis(barg, kbest[None, :], axis=0)[0]
+                  + kbest.astype(jnp.int32) * block)
     corr_ok = sv
     if mutual:
-        d2b = jnp.where(sv[:, None], d2f, jnp.inf)
-        back_i = jnp.argmin(d2b, axis=0).astype(jnp.int32)  # per dst: best src
-        mut = back_i[corr_j] == jnp.arange(sf.shape[0], dtype=jnp.int32)
+        mut = back_i[corr_j] == jnp.arange(ns, dtype=jnp.int32)
         ok_mut = corr_ok & mut
         corr_ok = jnp.where(ok_mut.sum() >= 10, ok_mut, corr_ok)
     return corr_j, corr_ok
@@ -356,11 +397,27 @@ def _ransac_core(src, src_valid, dst, dst_valid, corr_j, corr_ok, max_dist,
     dist_pass = (((moved_s - q) ** 2).sum(-1)
                  <= max_dist * max_dist).all(-1)
 
-    moved = jnp.einsum("tij,nj->tni", T[:, :3, :3], src) + T[:, None, :3, 3]
-    d2 = ((moved - dst[corr_j][None, :, :]) ** 2).sum(-1)
-    inl = (d2 <= max_dist * max_dist) & corr_ok[None, :]
-    scores = jnp.where(edge_pass & dist_pass, inl.sum(-1), -1)
+    # hypothesis scoring in trial chunks: peak memory O(chunk * N), not
+    # O(trials * N) (4096 trials x 20k pts would be a ~1 GB intermediate)
+    dst_c = dst[corr_j]
+
+    def score_chunk(Tc):
+        moved = jnp.einsum("tij,nj->tni", Tc[:, :3, :3], src) \
+            + Tc[:, None, :3, 3]
+        d2 = ((moved - dst_c[None, :, :]) ** 2).sum(-1)
+        inl = (d2 <= max_dist * max_dist) & corr_ok[None, :]
+        return inl.sum(-1)
+
+    t_chunk = max(1, min(trials, (8 << 20) // max(ns, 1)))
+    if trials % t_chunk:
+        t_chunk = trials  # static shapes: fall back to one chunk
+    counts = jax.lax.map(score_chunk,
+                         T.reshape(-1, t_chunk, 4, 4)).reshape(-1)
+    scores = jnp.where(edge_pass & dist_pass, counts, -1)
     best = jnp.argmax(scores)
+    moved_b = transform_points(T[best], src)
+    d2_b = ((moved_b - dst_c) ** 2).sum(-1)
+    inl_best = (d2_b <= max_dist * max_dist) & corr_ok
 
     # iterated refine: weighted Kabsch on the inlier set, re-evaluate the
     # inliers, repeat — Open3D reaches the same fixpoint through its local
@@ -374,7 +431,7 @@ def _ransac_core(src, src_valid, dst, dst_valid, corr_j, corr_ok, max_dist,
         w_next = jnp.where(inl_r.any(), inl_r.astype(jnp.float32), w)
         return w_next, (T_ref, inl_r, d2r)
 
-    w0 = inl[best].astype(jnp.float32)
+    w0 = inl_best.astype(jnp.float32)
     _, (T_refs, _, _) = jax.lax.scan(
         refine_step, w0, None, length=max(int(refine_iters), 1))
     T_ref = T_refs[-1]
