@@ -232,7 +232,7 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
     t0 = _time.perf_counter()
     points = np.concatenate(merged_p)
     colors = np.concatenate(merged_c)
-    points, colors = _postprocess_merged(points, colors, cfg)
+    points, colors = _postprocess_merged(points, colors, cfg, tm)
     tm["postprocess_s"] = round(_time.perf_counter() - t0, 3)
     return points, colors, transforms
 
@@ -244,26 +244,33 @@ def _sample_every(p, c, every):
     return p, c
 
 
-def _postprocess_merged(points, colors, cfg: MergeConfig):
+def _postprocess_merged(points, colors, cfg: MergeConfig, tm: dict | None = None):
     """Final voxel/sample/outlier chain shared by both merge modes
     (processing.py:605-629)."""
+    import time as _time
+
+    tm = tm if tm is not None else {}
     valid = np.ones(len(points), bool)
     if cfg.final_voxel and cfg.final_voxel > 0:
+        t0 = _time.perf_counter()
         p, c, v = pc.voxel_downsample(jnp.asarray(points), jnp.asarray(colors),
                                       jnp.asarray(valid), float(cfg.final_voxel))
         keep = np.asarray(v)
         points = np.asarray(p)[keep]
         colors = np.asarray(c)[keep]
         valid = np.ones(len(points), bool)
+        tm["final_voxel_s"] = round(_time.perf_counter() - t0, 3)
     if cfg.sample_after and cfg.sample_after > 1:
         points = points[:: cfg.sample_after]
         colors = colors[:: cfg.sample_after]
         valid = valid[:: cfg.sample_after]
     if cfg.outlier_nb > 0:
+        t0 = _time.perf_counter()
         m = np.asarray(pc.statistical_outlier_mask(
             jnp.asarray(points), jnp.asarray(valid),
             cfg.outlier_nb, cfg.outlier_std))
         points, colors = points[m], colors[m]
+        tm["outlier_s"] = round(_time.perf_counter() - t0, 3)
     return points, colors
 
 
