@@ -145,3 +145,61 @@ def test_scan_fused_row_mode0_and_downsample(rng):
     both = v_ref & v_fused
     err = np.abs(np.asarray(pts[0])[both] - np.asarray(ref.points)[both])
     assert err.max() < 1e-2, err.max()
+
+
+def test_scanner_fuse_gate_rejects_truncated_and_misaligned(monkeypatch, rng):
+    """The fused-kernel gate must route truncated stacks and non-tile-aligned
+    widths to the jnp path even when the kernel is available (the jnp path
+    raises the clear 'Not enough frames' error / handles any W)."""
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.models.scanner import SLScanner
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        graycode as gc,
+        pallas_kernels as pk,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+
+    monkeypatch.setattr(pk, "scan_fused_ok", lambda: True)
+    cam = (256, 128)
+    rig = syn.default_rig(cam_size=cam, proj_size=(256, 128))
+    sc = SLScanner(rig.calibration(), cam, (256, 128), row_mode=1,
+                   plane_eval="quadratic")
+    frames = jnp.asarray(gc.generate_pattern_stack(256, 128))  # [32,128,256]
+    assert sc._can_fuse(frames)                      # full aligned stack: yes
+    assert not sc._can_fuse(frames[:18])             # truncated stack: no
+    assert not sc._can_fuse(frames[:, :, :192])      # W % 128 != 0: no
+    assert not sc._can_fuse(frames.astype(jnp.int16))  # non-uint8: no
+    sc0 = SLScanner(rig.calibration(), cam, (256, 128), row_mode=2,
+                    plane_eval="quadratic")
+    assert not sc0._can_fuse(frames)                 # row_mode 2: no
+    sc1 = SLScanner(rig.calibration(), cam, (256, 128), row_mode=1,
+                    plane_eval="table")
+    assert not sc1._can_fuse(frames)                 # table gather path: no
+
+
+def test_merge_timings_dict_populated(rng):
+    import numpy as np
+
+    from structured_light_for_3d_model_replication_tpu.config import MergeConfig
+    from structured_light_for_3d_model_replication_tpu.models import (
+        reconstruction as rec,
+    )
+
+    dirs = rng.normal(size=(1200, 3))
+    dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+    r = 40 * (1 + 0.3 * np.sin(3 * dirs[:, 0]))
+    base = (dirs * r[:, None]).astype(np.float32)
+    clouds = []
+    for ang in (0.0, 0.12):
+        c, s = np.cos(ang), np.sin(ang)
+        R = np.asarray([[c, 0, s], [0, 1, 0], [-s, 0, c]], np.float32)
+        clouds.append(((base @ R.T).astype(np.float32),
+                       np.full((len(base), 3), 90, np.uint8)))
+    tm = {}
+    cfg = MergeConfig(voxel_size=2.0, ransac_trials=512, icp_iters=10,
+                      final_voxel=1.0, outlier_nb=10)
+    rec.merge_360(clouds, cfg, log=lambda m: None, timings=tm)
+    for k in ("preprocess_s", "register_s", "accumulate_s", "postprocess_s",
+              "final_voxel_s", "outlier_s"):
+        assert k in tm and tm[k] >= 0, (k, tm)
