@@ -378,14 +378,29 @@ def main() -> None:
             f"{N_VIEWS} views")
         final["numpy_baseline_s"] = round(np_s, 2)
 
-        res = _run_child([f"--views={N_VIEWS}"], CHILD_TIMEOUT_TPU)
+        # preflight: a wedged accelerator tunnel hangs inside PJRT client
+        # init; detect it in 3 min instead of burning the full child budget
+        from structured_light_for_3d_model_replication_tpu.utils.preflight import (
+            accelerator_preflight,
+        )
+
+        status, detail = accelerator_preflight()
+        log(f"ambient backend preflight: {status} ({detail})")
+        if status == "ok":
+            res = _run_child([f"--views={N_VIEWS}"], CHILD_TIMEOUT_TPU)
+        else:
+            final["error"] = (f"ambient backend hung at init" if status == "hung"
+                              else f"ambient backend init failed: {detail}")
+            res = None
         complete = res is not None and res.get("merge_s") is not None
         if not complete:
             note = "ambient-backend child incomplete"
             if res is not None:
                 note += f" (got phases: {sorted(res.keys())})"
             log(note + "; retrying with forced CPU")
-            final["error"] = "ambient child failed; cpu fallback"
+            prior = final.get("error")
+            final["error"] = ((prior + "; ") if prior else "") + \
+                "ambient child failed; cpu fallback"
             # fit the fallback inside what's left of the parent deadline
             # (60 s reserve for result assembly); skip it when nothing
             # useful could finish

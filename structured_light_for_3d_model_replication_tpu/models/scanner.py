@@ -115,7 +115,7 @@ class SLScanner:
         return (pk.scan_fused_ok() and use_poly and self.row_mode in (0, 1)
                 and frames_v.dtype == jnp.uint8
                 and frames_v.shape[-3] >= need
-                and h * w == self.rays.shape[0]   # frames match the calibrated camera
+                and (w, h) == self.cam_size   # frames match the calibrated camera
                 and h % 8 == 0 and w % 128 == 0)
 
     def _fused_views(self, frames_v, shadow_v, contrast_v) -> CloudResult:

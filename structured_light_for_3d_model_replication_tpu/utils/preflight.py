@@ -1,0 +1,39 @@
+"""Accelerator preflight: detect a wedged device tunnel without hanging.
+
+A lost pool grant (e.g. a client SIGKILLed mid-claim) makes PJRT client
+creation block indefinitely — ``import jax; jax.devices()`` never returns.
+Probing in a SUBPROCESS with a timeout turns that unbounded hang into a
+3-minute, clearly-labeled verdict. Shared by bench.py and the accelerator
+smoke test so the probe expression/timeout can't drift between them.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+__all__ = ["accelerator_preflight"]
+
+_PROBE = "import jax; print(jax.default_backend())"
+
+
+def accelerator_preflight(timeout: float = 180.0, cwd: str | None = None
+                          ) -> tuple[str, str]:
+    """Probe the ambient jax backend in a subprocess.
+
+    Returns (status, detail): status is ``"ok"`` (detail = backend name),
+    ``"hung"`` (init exceeded ``timeout``), or ``"failed"`` (nonzero exit;
+    detail = stderr tail).
+    """
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    try:
+        probe = subprocess.run([sys.executable, "-c", _PROBE],
+                               capture_output=True, text=True,
+                               timeout=timeout, env=env, cwd=cwd)
+    except subprocess.TimeoutExpired:
+        return "hung", f"backend init exceeded {timeout:.0f}s (tunnel wedged?)"
+    if probe.returncode != 0:
+        return "failed", (probe.stderr or "")[-300:]
+    lines = (probe.stdout or "").strip().splitlines()
+    return "ok", (lines[-1] if lines else "?")
