@@ -8,6 +8,7 @@ server/arduino.py, server/gui.py auto-scan tab).
   turntable  serial stepper protocol + simulation/loopback backends
   android    client for the Android camera-host pull API
   autoscan   the 360-degree turntable sweep orchestrator
+  viewer     operator web viewer for per-stage artifacts + StageRecorder
 """
 from structured_light_for_3d_model_replication_tpu.acquire.autoscan import (  # noqa: F401
     auto_scan_360,
@@ -25,4 +26,8 @@ from structured_light_for_3d_model_replication_tpu.acquire.turntable import (  #
     SerialTurntable,
     SimulatedTurntable,
     open_turntable,
+)
+from structured_light_for_3d_model_replication_tpu.acquire.viewer import (  # noqa: F401
+    StageRecorder,
+    ViewerServer,
 )
