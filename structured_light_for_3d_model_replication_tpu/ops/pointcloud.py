@@ -316,7 +316,10 @@ def voxel_downsample_np(points, colors, valid, voxel_size):
     pts = points[valid]
     cols = colors[valid] if colors is not None else None
     origin = pts.min(axis=0)
-    ijk = np.floor((pts - origin) / voxel_size).astype(np.int64)
+    # divide in f32 like the jnp path: a python-float divisor would promote
+    # to f64 and voxel-boundary points could land in a different cell than
+    # the device path (order-dependent test flake, caught 2026-07-30)
+    ijk = np.floor((pts - origin) / np.float32(voxel_size)).astype(np.int64)
     _, inv, cnt = np.unique(ijk, axis=0, return_inverse=True, return_counts=True)
     m = cnt.shape[0]
     out_p = np.zeros((m, 3), np.float64)
