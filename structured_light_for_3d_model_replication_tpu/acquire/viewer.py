@@ -96,14 +96,22 @@ class StageRecorder:
 
     def save_cloud(self, name: str, points: np.ndarray,
                    colors: np.ndarray | None = None) -> str:
+        """Preview-capped (max_points_per_step stride) atomic PLY write +
+        progress entry; the recorder's generic per-stage artifact hook
+        (cleanup chain steps, ad-hoc inspection dumps)."""
         from structured_light_for_3d_model_replication_tpu.io import ply
 
+        total = len(points)
+        stride = max(1, total // self.max_points)
+        pts = np.asarray(points)[::stride]
         if colors is None:
-            colors = np.full((len(points), 3), 180, np.uint8)
+            cols = np.full((len(pts), 3), 180, np.uint8)
+        else:
+            cols = np.asarray(colors)[::stride]
         path = os.path.join(self.dir, name if name.endswith(".ply") else name + ".ply")
-        ply.write_ply(path + ".tmp", points, colors)
+        ply.write_ply(path + ".tmp", pts, cols)
         os.replace(path + ".tmp", path)
-        self.log_stage("cloud", points=int(len(points)), file=os.path.basename(path))
+        self.log_stage("cloud", points=int(total), file=os.path.basename(path))
         return path
 
 

@@ -61,6 +61,9 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("output")
     p.add_argument("--steps", default="background,cluster,radius,statistical",
                    help="comma list drawn from background,cluster,radius,statistical")
+    p.add_argument("--artifacts", default=None,
+                   help="record each intermediate cloud into this directory "
+                        "for the web viewer (tab-3 per-step inspection)")
     add_config_args(p)
 
     p = sub.add_parser("merge-360",
@@ -169,7 +172,16 @@ def _cmd_clean(args) -> int:
     from structured_light_for_3d_model_replication_tpu.pipeline import stages
 
     steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
-    stages.clean_cloud(args.input, args.output, cfg=_cfg(args), steps=steps)
+    step_cb = None
+    if args.artifacts:
+        from structured_light_for_3d_model_replication_tpu.acquire.viewer import (
+            StageRecorder,
+        )
+
+        rec = StageRecorder(args.artifacts)
+        step_cb = lambda name, p, c: rec.save_cloud(f"clean_{name}", p, c)  # noqa: E731
+    stages.clean_cloud(args.input, args.output, cfg=_cfg(args), steps=steps,
+                       step_callback=step_cb)
     return 0
 
 

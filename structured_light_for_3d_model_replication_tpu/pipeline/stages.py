@@ -192,10 +192,12 @@ _CLEAN_STEPS = ("background", "cluster", "radius", "statistical")
 
 def clean_cloud(input_ply: str, output_ply: str, cfg: Config | None = None,
                 steps: tuple[str, ...] | list[str] = _CLEAN_STEPS,
-                log=print) -> dict:
+                log=print, step_callback=None) -> dict:
     """Cleanup chain on one cloud: background plane removal -> largest cluster
     -> radius outlier -> statistical outlier (the tab-3 chain, gui.py:1391-1522;
-    ops per processing.py:337-448). Steps are individually selectable."""
+    ops per processing.py:337-448). Steps are individually selectable.
+    ``step_callback(name, points, colors)`` receives each intermediate cloud
+    (the tab's in-memory per-step inspection flow, made non-blocking)."""
     import jax.numpy as jnp
 
     from structured_light_for_3d_model_replication_tpu.ops import pointcloud as pc
@@ -250,6 +252,8 @@ def clean_cloud(input_ply: str, output_ply: str, cfg: Config | None = None,
         pts, cols = pts[keep], cols[keep]
         counts[step] = len(pts)
         log(f"[clean] {step}: {len(pts):,} points remain")
+        if step_callback is not None:
+            step_callback(step, pts, cols)
         if len(pts) == 0:
             log("[clean] WARNING: all points removed; aborting chain")
             break
