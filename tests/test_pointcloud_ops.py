@@ -123,10 +123,15 @@ def test_voxel_downsample(rng):
     p_n, c_n, _ = pc.voxel_downsample_np(pts_p[:n], cols_p[:n], None, 1.0)
     v_j = np.asarray(v_j)
     assert v_j.sum() == p_n.shape[0]  # same number of occupied voxels
-    # same voxel centroids as sets (order differs)
-    sj = sorted(map(tuple, np.round(np.asarray(p_j)[v_j], 3)))
-    sn = sorted(map(tuple, np.round(p_n, 3)))
-    np.testing.assert_allclose(np.array(sj), np.array(sn), atol=2e-3)
+    # same voxel centroids as sets (order differs): symmetric nearest-neighbor
+    # distance between the two sets. Any alignment-by-sorting scheme
+    # (round-then-sort, cell-key-then-sort) flakes when one f32-vs-f64
+    # centroid straddles the chosen boundary (order-dependent under the
+    # session rng, caught 2026-07-30); set distance has no boundaries.
+    cj = np.asarray(p_j)[v_j]
+    d2 = ((cj[:, None, :] - p_n[None, :, :]) ** 2).sum(-1)
+    assert np.sqrt(d2.min(axis=1).max()) < 1e-4  # every jax voxel in np set
+    assert np.sqrt(d2.min(axis=0).max()) < 1e-4  # every np voxel in jax set
 
 
 def test_normals_on_analytic_surfaces(rng):
