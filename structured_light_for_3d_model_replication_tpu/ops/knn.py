@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["knn", "knn_np", "radius_count", "radius_count_np", "pad_points"]
+__all__ = ["knn", "knn_np", "knn_dense_approx", "radius_count",
+           "radius_count_np", "pad_points"]
 
 _FAR = 1e9  # coordinate assigned to invalid/padded points: far from everything
 
@@ -78,15 +79,26 @@ def knn(points: jax.Array, valid: jax.Array, k: int,
     d2 [N,k] f32). Rows of invalid points contain arbitrary (masked) results.
 
     Dispatch: tiled brute-force (dense matmul-shaped, exact) for small N;
-    grid-hash candidate search (ops/grid.py) for large N with the cell sized
-    from mean density and a 2-ring search. The grid path is exact wherever the
-    k-th neighbor lies within 2 cell rings; for sparse outliers beyond that it
-    *overestimates* distances (never underestimates) — the safe direction for
-    every consumer (outlier filters flag such points harder).
+    for large N, dense rows + approx_min_k on accelerators
+    (knn_dense_approx) or grid-hash candidate search (ops/grid.py) on
+    hosts, with the cell sized from mean density and a 2-ring search.
+    The grid path is exact wherever the k-th neighbor lies within 2 cell
+    rings; for sparse outliers beyond that it *overestimates* distances
+    (never underestimates) — the safe direction for every consumer
+    (outlier filters flag such points harder).
     """
     n = points.shape[0]
     if n <= _BRUTE_MAX:
         return knn_brute(points, valid, k, block_q, block_b, exclude_self)
+    if jax.default_backend() != "cpu":
+        # accelerators: dense distance rows + the hardware-partial-reduce
+        # top-k (lax.approx_min_k). The grid-hash path below is built for
+        # hosts — on TPU its wide bucket gathers have faulted the runtime
+        # outright at merge-cloud shapes (H=512k, M=100, rings=2; observed
+        # 2026-07-30), and XLA lowers lax.top_k over the concatenated
+        # candidate sets to full sorts that run ~20x slower than this
+        # dense pass (27 s vs 1.4 s at 259k points).
+        return knn_dense_approx(points, valid, k, exclude_self)
     from structured_light_for_3d_model_replication_tpu.ops import grid as gridlib
 
     pts = jnp.asarray(points, jnp.float32)
@@ -100,6 +112,57 @@ def knn(points: jax.Array, valid: jax.Array, k: int,
     cell = 1.2 * (vol * max(k, 8) / nv) ** (1.0 / 3.0)
     grid = gridlib.build_grid(pts, valid, cell)
     return gridlib.grid_knn(grid, k, exclude_self, rings=2)
+
+
+def knn_dense_approx(points: jax.Array, valid: jax.Array, k: int,
+                     exclude_self: bool = True,
+                     recall_target: float = 0.99):
+    """Large-N kNN for accelerators: full distance rows in query chunks,
+    selected with ``lax.approx_min_k`` (TPU PartialReduce).
+
+    Distances are exact; only the top-k *selection* is approximate
+    (recall_target per row, misses can only overestimate the k-th
+    neighbor). Every consumer at this scale (statistical outlier mean
+    distance, normals' covariance neighborhoods) degrades gracefully
+    under that one-sided error.
+    """
+    n = points.shape[0]
+    # pad to 8192s so executables cache across nearby cloud sizes, and pick
+    # the largest power-of-two chunk (always divides the pad) keeping the
+    # [chunk, n] f32 distance block within ~0.5 GB; the chunk floor is 64,
+    # so the block stays < 1 GB up to ~4M points (beyond any merge size)
+    n_pad = -(-n // 8192) * 8192
+    bq = 2048
+    while bq > 64 and bq * n_pad * 4 > (1 << 29):
+        bq //= 2
+    pts, vld = _pad_jax(jnp.asarray(points, jnp.float32), valid, n_pad)
+    idx, d2 = _knn_dense_jit(pts, vld, k, bq, exclude_self,
+                             float(recall_target))
+    return idx[:n], d2[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "bq", "exclude_self",
+                                             "recall_target"))
+def _knn_dense_jit(points, valid, k: int, bq: int, exclude_self: bool,
+                   recall_target: float):
+    pts = _masked_coords(points.astype(jnp.float32), valid, jnp)
+    b2 = (pts * pts).sum(-1)
+
+    def fn(args):
+        qi, q = args
+        q2 = (q * q).sum(-1)[:, None]
+        cross = jax.lax.dot_general(q, pts, (((1,), (1,)), ((), ())),
+                                    precision=jax.lax.Precision.HIGHEST)
+        d2 = q2 + b2[None, :] - 2.0 * cross
+        if exclude_self:
+            qidx = qi * bq + jnp.arange(bq, dtype=jnp.int32)
+            d2 = d2.at[jnp.arange(bq), qidx].set(jnp.inf)
+        return jax.lax.approx_min_k(d2, k, recall_target=recall_target)
+
+    qb = pts.reshape(-1, bq, 3)
+    d2o, io = jax.lax.map(fn, (jnp.arange(qb.shape[0], dtype=jnp.int32), qb))
+    return (io.reshape(-1, k).astype(jnp.int32),
+            jnp.maximum(d2o.reshape(-1, k), 0.0))
 
 
 def knn_brute(points: jax.Array, valid: jax.Array, k: int,

@@ -72,3 +72,22 @@ def test_grid_dense_cell_shrink():
     rel = np.abs(c_j - c_n) / np.maximum(c_n, 1)
     assert np.median(rel) < 1e-3
     assert (rel < 0.01).mean() > 0.999
+
+
+def test_knn_dense_approx_matches_exact(big_cloud):
+    # the accelerator large-N dispatch (dense rows + approx_min_k); on the
+    # CPU test backend approx_min_k is exact, and semantics (masking,
+    # self-exclusion, clamping, chunk padding) must match knn_np regardless
+    pts = big_cloud[:12_000]  # small enough for the 1-core CPU suite; spans
+    n = pts.shape[0]          # multiple query chunks, hitting padding seams
+    valid = np.ones(n, bool)
+    valid[::11] = False
+    idx_j, d2_j = knnlib.knn_dense_approx(jnp.asarray(pts), jnp.asarray(valid),
+                                          8, recall_target=1.0)
+    idx_n, d2_n = knnlib.knn_np(pts, valid, 8)
+    dj = np.sqrt(np.maximum(np.asarray(d2_j), 0))[valid]
+    dn = np.sqrt(d2_n)[valid]
+    assert np.isfinite(dj).all()
+    np.testing.assert_allclose(dj, dn, atol=1e-2)
+    assert valid[np.asarray(idx_j)[valid]].all()  # invalid never a neighbor
+    assert (np.asarray(idx_j)[valid] != np.arange(n)[valid][:, None]).all()

@@ -77,6 +77,16 @@ out["nn1_finite"] = bool(np.isfinite(np.asarray(d2)).all())
 cnt = pk.radius_count_pallas(pts, None, 5.0)
 jax.block_until_ready(cnt)
 out["radius_nonneg"] = int(np.asarray(cnt).min()) >= 0
+
+# statistical outlier at merged-cloud scale (> knn's 65536 brute gate): the
+# round-3 bench TPU child died here — the grid-hash knn path faulted the TPU
+# runtime at H=512k/M=100/rings=2, killing the whole merge phase
+from structured_light_for_3d_model_replication_tpu.ops import pointcloud as pc
+big = jnp.asarray(np.random.default_rng(1).normal(
+    scale=60.0, size=(170_000, 3)).astype(np.float32))
+mask = np.asarray(pc.statistical_outlier_mask(
+    big, jnp.ones(big.shape[0], bool), 20, 2.0))
+out["outlier_merge_scale_ok"] = bool(0.5 < mask.mean() <= 1.0)
 print(json.dumps(out))
 '''
 
@@ -104,5 +114,5 @@ def test_flagship_paths_on_accelerator():
         pytest.skip("no accelerator backend attached")
     for key in ("forward_table_finite", "forward_quadratic_finite",
                 "views_quadratic_shape_ok",
-                "nn1_finite", "radius_nonneg"):
+                "nn1_finite", "radius_nonneg", "outlier_merge_scale_ok"):
         assert out.get(key) is True, (key, out)
