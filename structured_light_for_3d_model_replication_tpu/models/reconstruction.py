@@ -22,6 +22,7 @@ import numpy as np
 
 from structured_light_for_3d_model_replication_tpu.config import MergeConfig
 from structured_light_for_3d_model_replication_tpu.ops import (
+    knn as knnlib,
     normals as nrmlib,
     pointcloud as pc,
     registration as reg,
@@ -78,8 +79,13 @@ def _pad_prep(p_c: np.ndarray, pad_to: int | None):
 
 @functools.partial(jax.jit, static_argnames=())
 def _prep_features_jit(p, v, feat_radius):
-    nr = nrmlib.estimate_normals(p, v, k=30)
-    feat = reg.fpfh_features(p, nr, v, radius=feat_radius, k=48)
+    # one kNN (k=48, ascending) feeds both stages: the neighbor search is
+    # the dominant cost of feature prep, and normals only need the nearest
+    # 30 of the 48 FPFH neighbors
+    idx, d2 = knnlib.knn(p, v, 48)
+    nr = nrmlib.estimate_normals(p, v, k=30, idx_d2=(idx, d2))
+    feat = reg.fpfh_features(p, nr, v, radius=feat_radius, k=48,
+                             idx_d2=(idx, d2))
     return nr, feat
 
 

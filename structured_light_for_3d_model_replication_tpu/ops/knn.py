@@ -246,6 +246,16 @@ def radius_count(points: jax.Array, valid: jax.Array, radius,
         points, valid = _pad_jax(points, valid, n_pad)
         return _radius_blocks(points, valid, jnp.float32(radius), block_q,
                               block_b, exclude_self)[:n]
+    if jax.default_backend() != "cpu":
+        # accelerators: stream the exact dense counter at any size — the
+        # grid path's wide bucket gathers fault the TPU runtime at large
+        # shapes (same class as knn()'s dispatch note), and counting needs
+        # no top-k, so the dense pass stays sort-free: matmul + compare +
+        # running sum
+        block_q, block_b, n_pad = _choose_blocks(n, block_q, block_b)
+        points, valid = _pad_jax(points, valid, n_pad)
+        return _radius_blocks(points, valid, jnp.float32(radius), block_q,
+                              block_b, exclude_self)[:n]
     from structured_light_for_3d_model_replication_tpu.ops import grid as gridlib
 
     # keep the exactness invariant rings*cell >= radius: if density forces a

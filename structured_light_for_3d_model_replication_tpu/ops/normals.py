@@ -66,14 +66,22 @@ def smallest_eigvec_sym3(cov):
                      fallback)
 
 
-def estimate_normals(points, valid, k: int = 30, radius: float | None = None):
+def estimate_normals(points, valid, k: int = 30, radius: float | None = None,
+                     idx_d2=None):
     """Unit normals [N,3] from PCA of each point's k-neighborhood.
 
     ``radius``: hybrid query semantics (Open3D KDTreeSearchParamHybrid,
     processing.py:455-466 and :653-655 — radius=2*voxel, max_nn cap): of the
     k nearest neighbors, only those within ``radius`` enter the plane fit.
-    None keeps the pure-kNN neighborhood."""
-    idx, d2 = knnlib.knn(points, valid, k)
+    None keeps the pure-kNN neighborhood.
+
+    ``idx_d2``: optional precomputed ascending (idx [N,>=k], d2 [N,>=k])
+    neighbor arrays — callers that also run FPFH share one kNN this way
+    instead of paying the dominant neighbor search twice."""
+    if idx_d2 is not None:
+        idx, d2 = (a[:, :k] for a in idx_d2)
+    else:
+        idx, d2 = knnlib.knn(points, valid, k)
     neigh = points[idx]  # [N, k, 3]
     ok = valid[idx]      # [N, k] — padded/invalid neighbors excluded
     if radius is not None:

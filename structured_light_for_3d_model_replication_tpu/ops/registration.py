@@ -67,6 +67,11 @@ def _exp_so3(w):
 def kabsch(p, q, w=None):
     """Least-squares rigid transform aligning p -> q. p, q: [.., M, 3];
     optional weights [.., M]. Returns [.., 4, 4]."""
+    # every contraction at HIGHEST: TPU's default matmul precision is
+    # bf16-class (eps ~4e-3), which left hypothesis rotations off-orthogonal
+    # by up to 2e-2 — a ~4 mm error at this rig's working distance (measured
+    # on RANSAC hypothesis batches before this was pinned)
+    mm = jax.lax.Precision.HIGHEST
     if w is None:
         w = jnp.ones(p.shape[:-1], p.dtype)
     ws = jnp.maximum(w.sum(-1, keepdims=True), 1e-12)
@@ -74,14 +79,22 @@ def kabsch(p, q, w=None):
     cq = (q * w[..., None]).sum(-2) / ws
     pc = (p - cp[..., None, :]) * w[..., None]
     qc = q - cq[..., None, :]
-    h = jnp.einsum("...mi,...mj->...ij", pc, qc)
+    h = jnp.einsum("...mi,...mj->...ij", pc, qc, precision=mm)
     u, s, vt = jnp.linalg.svd(h)
     det = jnp.linalg.det(jnp.einsum("...ij,...jk->...ik",
                                     jnp.swapaxes(vt, -1, -2),
-                                    jnp.swapaxes(u, -1, -2)))
+                                    jnp.swapaxes(u, -1, -2), precision=mm))
     d = jnp.stack([jnp.ones_like(det), jnp.ones_like(det), det], -1)
-    r = jnp.einsum("...ji,...j,...jk->...ik", vt, d, jnp.swapaxes(u, -1, -2))
-    t = cq - jnp.einsum("...ij,...j->...i", r, cp)
+    r = jnp.einsum("...ji,...j,...jk->...ik", vt, d,
+                   jnp.swapaxes(u, -1, -2), precision=mm)
+    # two Newton-Schulz sweeps (R <- R(3I - R^T R)/2) polish the f32 SVD's
+    # residual non-orthogonality down to roundoff
+    eye3 = jnp.eye(3, dtype=r.dtype)
+    for _ in range(2):
+        rtr = jnp.einsum("...ji,...jk->...ik", r, r, precision=mm)
+        r = 0.5 * jnp.einsum("...ij,...jk->...ik", r, 3.0 * eye3 - rtr,
+                             precision=mm)
+    t = cq - jnp.einsum("...ij,...j->...i", r, cp, precision=mm)
     bot = jnp.broadcast_to(jnp.asarray([0, 0, 0, 1], p.dtype),
                            r.shape[:-2] + (1, 4))
     top = jnp.concatenate([r, t[..., :, None]], -1)
@@ -298,11 +311,18 @@ def _fpfh_jit(points, normals, valid, idx, d2, radius, k: int):
     return jnp.where(valid[:, None], fpfh, 0.0)
 
 
-def fpfh_features(points, normals, valid, radius: float, k: int = 64):
-    """FPFH [N, 33] over a radius-bounded k-neighborhood."""
+def fpfh_features(points, normals, valid, radius: float, k: int = 64,
+                  idx_d2=None):
+    """FPFH [N, 33] over a radius-bounded k-neighborhood.
+
+    ``idx_d2``: optional precomputed (idx [N,>=k], d2 [N,>=k]) neighbors,
+    shared with estimate_normals by feature-prep callers."""
     from structured_light_for_3d_model_replication_tpu.ops import knn as knnlib
 
-    idx, d2 = knnlib.knn(points, valid, k)
+    if idx_d2 is not None:
+        idx, d2 = (a[:, :k] for a in idx_d2)
+    else:
+        idx, d2 = knnlib.knn(points, valid, k)
     return _fpfh_jit(jnp.asarray(points, jnp.float32),
                      jnp.asarray(normals, jnp.float32),
                      jnp.asarray(valid), idx, d2, jnp.float32(radius), k)
@@ -397,22 +417,52 @@ def _ransac_core(src, src_valid, dst, dst_valid, corr_j, corr_ok, max_dist,
     dist_pass = (((moved_s - q) ** 2).sum(-1)
                  <= max_dist * max_dist).all(-1)
 
-    # hypothesis scoring in trial chunks: peak memory O(chunk * N), not
-    # O(trials * N) (4096 trials x 20k pts would be a ~1 GB intermediate)
+    # hypothesis scoring as [T, K] x [K, N] matmuls: expanding
+    # ||R s + t - c||^2 = ||s||^2 + ||c||^2 + ||t||^2
+    #                     + 2 (R^T t) . s - 2 R:(c x s) - 2 t . c
+    # keeps every intermediate at [T, N] (the naive einsum materializes
+    # [T, N, 3] moved-point tensors, 3x the traffic and off the MXU).
+    # f32 cancellation error here is ~|coord|^2 * eps ~ 0.05 mm^2 against a
+    # max_dist^2 threshold of ~20 mm^2 — irrelevant for inlier COUNTING;
+    # the refine below uses exact differences.
+    # center both clouds first: the expansion's cancellation error scales
+    # with |coord|^2, and the rig's working distance (~400 mm) would put
+    # ~0.1 mm^2 of noise against the ~20 mm^2 threshold; centered coords
+    # (~±100 mm) keep it at ~0.01 mm^2. Shift: ||R s + t - c||
+    # = ||R s_c + (t + R mu_s - mu_c) - c_c|| with s_c = s - mu_s etc.
     dst_c = dst[corr_j]
+    mu_s = jnp.where(corr_ok, 1.0, 0.0) @ src / jnp.maximum(corr_ok.sum(), 1)
+    mu_c = jnp.where(corr_ok, 1.0, 0.0) @ dst_c / jnp.maximum(corr_ok.sum(), 1)
+    src_c = src - mu_s
+    dst_cc = dst_c - mu_c
+    s2 = (src_c * src_c).sum(-1)                  # [N]
+    c2 = (dst_cc * dst_cc).sum(-1)                # [N]
+    cs9 = (dst_cc[:, :, None] * src_c[:, None, :]).reshape(ns, 9)  # c_i s_j
+    R9 = T[:, :3, :3].reshape(-1, 9)              # R_ij, i-major
+    tt = (T[:, :3, 3] - mu_c[None, :]
+          + jnp.einsum("tij,j->ti", T[:, :3, :3], mu_s,
+                       precision=jax.lax.Precision.HIGHEST))  # [T, 3]
+    t2 = (tt * tt).sum(-1)                        # [T]
+    Rt = jnp.einsum("tij,ti->tj", T[:, :3, :3], tt,
+                    precision=jax.lax.Precision.HIGHEST)  # R^T t [T, 3]
 
-    def score_chunk(Tc):
-        moved = jnp.einsum("tij,nj->tni", Tc[:, :3, :3], src) \
-            + Tc[:, None, :3, 3]
-        d2 = ((moved - dst_c[None, :, :]) ** 2).sum(-1)
+    def score_chunk(args):
+        R9c, ttc, t2c, Rtc = args
+        mm = jax.lax.Precision.HIGHEST
+        cross = (jnp.matmul(Rtc, src_c.T, precision=mm)
+                 - jnp.matmul(R9c, cs9.T, precision=mm)
+                 - jnp.matmul(ttc, dst_cc.T, precision=mm))
+        d2 = s2[None, :] + c2[None, :] + t2c[:, None] + 2.0 * cross
         inl = (d2 <= max_dist * max_dist) & corr_ok[None, :]
         return inl.sum(-1)
 
     t_chunk = max(1, min(trials, (8 << 20) // max(ns, 1)))
     if trials % t_chunk:
         t_chunk = trials  # static shapes: fall back to one chunk
-    counts = jax.lax.map(score_chunk,
-                         T.reshape(-1, t_chunk, 4, 4)).reshape(-1)
+    counts = jax.lax.map(
+        score_chunk,
+        (R9.reshape(-1, t_chunk, 9), tt.reshape(-1, t_chunk, 3),
+         t2.reshape(-1, t_chunk), Rt.reshape(-1, t_chunk, 3))).reshape(-1)
     scores = jnp.where(edge_pass & dist_pass, counts, -1)
     best = jnp.argmax(scores)
     moved_b = transform_points(T[best], src)

@@ -172,15 +172,35 @@ def test_smallest_eigvec_matches_eigh(rng):
 def test_voxel_downsample_collision_free_at_scale(rng):
     # regression: the old XOR-prime int32 voxel key silently merged distinct
     # voxels at 24-view-merge scale (observed: 173k vs 259k voxels on 302k
-    # points); the lexicographic 3-key grouping must match the exact numpy
-    # twin's voxel count on a large fine grid
+    # points); both grouping paths must match the exact numpy twin's voxel
+    # count on a large fine grid (340 cells/axis: packed path eligible)
     pts = rng.uniform(0, 170, (120_000, 3)).astype(np.float32)
     cols = np.zeros((120_000, 3), np.uint8)
-    p_j, c_j, v_j = pc.voxel_downsample(
-        jnp.asarray(pts), jnp.asarray(cols),
-        jnp.asarray(np.ones(len(pts), bool)), 0.5)
+    valid = jnp.asarray(np.ones(len(pts), bool))
     p_n, _, _ = pc.voxel_downsample_np(pts, cols, None, 0.5)
-    assert int(np.asarray(v_j).sum()) == p_n.shape[0]
+    for fn in (pc.voxel_downsample,  # dispatches to the packed single-sort
+               pc._voxel_downsample_lex):
+        p_j, c_j, v_j = fn(jnp.asarray(pts), jnp.asarray(cols), valid,
+                           jnp.float32(0.5))
+        assert int(np.asarray(v_j).sum()) == p_n.shape[0], fn
+
+
+def test_voxel_downsample_packed_matches_lex(rng):
+    # the packed 30-bit single-sort path must agree with the general
+    # lexsort path on centroids, colors and survivor count
+    pts = rng.uniform(-40, 40, (20_000, 3)).astype(np.float32)
+    cols = rng.integers(0, 255, (20_000, 3)).astype(np.uint8)
+    valid = np.ones(20_000, bool)
+    valid[::13] = False
+    args = (jnp.asarray(pts), jnp.asarray(cols), jnp.asarray(valid),
+            jnp.float32(2.0))
+    p_a, c_a, v_a = (np.asarray(x) for x in pc._voxel_downsample_packed(*args))
+    p_b, c_b, v_b = (np.asarray(x) for x in pc._voxel_downsample_lex(*args))
+    assert v_a.sum() == v_b.sum()
+    sa = np.lexsort(p_a[v_a].T)
+    sb = np.lexsort(p_b[v_b].T)
+    np.testing.assert_allclose(p_a[v_a][sa], p_b[v_b][sb], atol=1e-5)
+    np.testing.assert_array_equal(c_a[v_a][sa], c_b[v_b][sb])
 
 
 def test_statistical_outlier_inf_mean_distance(rng):

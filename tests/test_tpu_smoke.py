@@ -82,11 +82,14 @@ out["radius_nonneg"] = int(np.asarray(cnt).min()) >= 0
 # round-3 bench TPU child died here — the grid-hash knn path faulted the TPU
 # runtime at H=512k/M=100/rings=2, killing the whole merge phase
 from structured_light_for_3d_model_replication_tpu.ops import pointcloud as pc
+from structured_light_for_3d_model_replication_tpu.ops import knn as knnlib
 big = jnp.asarray(np.random.default_rng(1).normal(
     scale=60.0, size=(170_000, 3)).astype(np.float32))
-mask = np.asarray(pc.statistical_outlier_mask(
-    big, jnp.ones(big.shape[0], bool), 20, 2.0))
+big_valid = jnp.ones(big.shape[0], bool)
+mask = np.asarray(pc.statistical_outlier_mask(big, big_valid, 20, 2.0))
 out["outlier_merge_scale_ok"] = bool(0.5 < mask.mean() <= 1.0)
+cnt = np.asarray(knnlib.radius_count(big, big_valid, 5.0))
+out["radius_merge_scale_ok"] = bool((cnt >= 0).all() and cnt.max() > 0)
 print(json.dumps(out))
 '''
 
@@ -114,5 +117,6 @@ def test_flagship_paths_on_accelerator():
         pytest.skip("no accelerator backend attached")
     for key in ("forward_table_finite", "forward_quadratic_finite",
                 "views_quadratic_shape_ok",
-                "nn1_finite", "radius_nonneg", "outlier_merge_scale_ok"):
+                "nn1_finite", "radius_nonneg", "outlier_merge_scale_ok",
+                "radius_merge_scale_ok"):
         assert out.get(key) is True, (key, out)

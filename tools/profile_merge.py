@@ -1,0 +1,91 @@
+"""Profile the 360-degree merge stages on the ambient backend.
+
+Uses the bench scene cache (.bench_cache.npz at the repo root — run
+`python bench.py` once to build it). Prints per-stage wall seconds for a
+compile run and two steady runs, plus per-pair registration timings across
+trial/iteration knobs with --register.
+
+The script self-terminates; do NOT wrap it in a kill timer near its
+expected runtime — SIGTERM mid-TPU-claim wedges the device tunnel for
+hours (see BENCH_NOTES.md).
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--register", action="store_true",
+                    help="also sweep register_pairs trial/ICP knobs")
+    ap.add_argument("--runs", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from structured_light_for_3d_model_replication_tpu.config import MergeConfig
+    from structured_light_for_3d_model_replication_tpu.models import (
+        reconstruction as rec,
+    )
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        registration as reg,
+    )
+
+    cache = os.path.join(ROOT, ".bench_cache.npz")
+    if not os.path.exists(cache):
+        sys.exit("no .bench_cache.npz — run `python bench.py` once first")
+    z = np.load(cache)
+    off = z["merge_off"]
+    clouds = [(z["merge_pts"][off[i]:off[i + 1]],
+               z["merge_cols"][off[i]:off[i + 1]])
+              for i in range(len(off) - 1)]
+    print(f"backend={jax.default_backend()} views={len(clouds)}")
+
+    for it in range(args.runs):
+        tm: dict = {}
+        t0 = time.perf_counter()
+        p, c, T = rec.merge_360(clouds, log=lambda m: None, timings=tm)
+        print(f"run{it}: {time.perf_counter() - t0:.3f}s stages={tm} "
+              f"pts={len(p)}")
+
+    if not args.register:
+        return
+    cfg = MergeConfig()
+    voxel = float(cfg.voxel_size)
+    preps = rec._preprocess_views(clouds, voxel, cfg.sample_before)
+    srcs, dsts = preps[1:], preps[:-1]
+    stacked = (jnp.stack([x.points for x in srcs]),
+               jnp.stack([x.valid for x in srcs]),
+               jnp.stack([x.features for x in srcs]),
+               jnp.stack([x.points for x in dsts]),
+               jnp.stack([x.valid for x in dsts]),
+               jnp.stack([x.features for x in dsts]),
+               jnp.stack([x.normals for x in dsts]))
+    for trials, icp_iters in ((4096, 30), (2048, 30), (1024, 30), (2048, 10)):
+        t = np.inf
+        for _ in range(2):
+            t0 = time.perf_counter()
+            T, gfit, ifit, _ = reg.register_pairs(
+                *stacked, max_dist=voxel * 1.5,
+                icp_max_dist=voxel * float(cfg.icp_dist_ratio),
+                trials=trials, icp_iters=icp_iters)
+            jax.block_until_ready(T)
+            t = min(t, time.perf_counter() - t0)
+        print(f"register trials={trials} icp_iters={icp_iters} "
+              f"steady={t:.3f}s gfit={float(np.mean(np.asarray(gfit))):.3f} "
+              f"ifit={float(np.mean(np.asarray(ifit))):.3f}")
+
+
+if __name__ == "__main__":
+    main()
