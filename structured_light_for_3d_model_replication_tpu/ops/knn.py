@@ -228,8 +228,11 @@ def radius_count(points: jax.Array, valid: jax.Array, radius,
                  exclude_self: bool = True) -> jax.Array:
     """Number of valid points within ``radius`` of each point. [N] int32.
 
-    Exact at every size: brute-force for small N, grid-hash with
-    cell = radius (sphere fits the 27-cell neighborhood) for large N.
+    Exact at every size: dense streaming blocks for small N (and at ANY
+    size on accelerators, where the grid path's wide bucket gathers fault
+    the TPU runtime and counting needs no top-k anyway); grid-hash with
+    cell = radius (sphere fits the 27-cell neighborhood) for large N on
+    hosts.
     """
     n = points.shape[0]
     if n <= _BRUTE_MAX:
@@ -242,16 +245,7 @@ def radius_count(points: jax.Array, valid: jax.Array, radius,
                 return pk.radius_count_pallas(points, valid, radius)
             except Exception:  # Mosaic compile failure at this shape: jnp twin
                 pass
-        block_q, block_b, n_pad = _choose_blocks(n, block_q, block_b)
-        points, valid = _pad_jax(points, valid, n_pad)
-        return _radius_blocks(points, valid, jnp.float32(radius), block_q,
-                              block_b, exclude_self)[:n]
-    if jax.default_backend() != "cpu":
-        # accelerators: stream the exact dense counter at any size — the
-        # grid path's wide bucket gathers fault the TPU runtime at large
-        # shapes (same class as knn()'s dispatch note), and counting needs
-        # no top-k, so the dense pass stays sort-free: matmul + compare +
-        # running sum
+    if n <= _BRUTE_MAX or jax.default_backend() != "cpu":
         block_q, block_b, n_pad = _choose_blocks(n, block_q, block_b)
         points, valid = _pad_jax(points, valid, n_pad)
         return _radius_blocks(points, valid, jnp.float32(radius), block_q,

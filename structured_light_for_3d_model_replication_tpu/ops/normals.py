@@ -95,7 +95,10 @@ def estimate_normals(points, valid, k: int = 30, radius: float | None = None,
     cnt = jnp.maximum(w.sum(1), 1.0)
     mean = (neigh * w).sum(1) / cnt
     d = (neigh - mean[:, None, :]) * w
-    cov = jnp.einsum("nki,nkj->nij", d, d) / cnt[..., None]
+    # HIGHEST: the TPU default matmul precision is bf16-class, which is too
+    # coarse for covariance accumulation (normals feed point-to-plane ICP)
+    cov = jnp.einsum("nki,nkj->nij", d, d,
+                     precision=jax.lax.Precision.HIGHEST) / cnt[..., None]
     return smallest_eigvec_sym3(cov)
 
 

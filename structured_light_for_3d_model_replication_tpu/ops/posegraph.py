@@ -26,6 +26,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# all SE(3) contractions pinned: TPU default matmul precision is bf16-class
+# (eps ~4e-3), far too coarse for pose algebra at millimeter targets (the
+# same slop measurably broke kabsch orthogonality in ops/registration.py)
+_MM = jax.lax.Precision.HIGHEST
+
+
+def _mm(a, b):
+    return jnp.matmul(a, b, precision=_MM)
+
 __all__ = ["exp_se3", "log_se3", "adjoint_se3", "optimize_pose_graph",
            "PoseGraphResult"]
 
@@ -45,7 +54,7 @@ def exp_se3(xi):
     theta2 = (w * w).sum(-1)[..., None, None]
     theta = jnp.sqrt(theta2 + 1e-24)
     k = _skew(w)
-    k2 = k @ k
+    k2 = _mm(k, k)
     eye = jnp.eye(3, dtype=xi.dtype)
     # closed-form with small-angle-safe coefficients
     a = jnp.sin(theta) / theta
@@ -57,7 +66,7 @@ def exp_se3(xi):
     c = jnp.where(small[..., None, None], 1.0 / 6.0, c)
     R = eye + a * k + b * k2
     V = eye + b * k + c * k2
-    t = jnp.einsum("...ij,...j->...i", V, v)
+    t = jnp.einsum("...ij,...j->...i", V, v, precision=_MM)
     bot = jnp.broadcast_to(jnp.asarray([0, 0, 0, 1], xi.dtype),
                            R.shape[:-2] + (1, 4))
     return jnp.concatenate(
@@ -98,7 +107,7 @@ def log_se3(T):
     theta2 = (w * w).sum(-1)[..., None, None]
     theta = jnp.sqrt(theta2 + 1e-24)
     k = _skew(w)
-    k2 = k @ k
+    k2 = _mm(k, k)
     eye = jnp.eye(3, dtype=T.dtype)
     b = (1 - jnp.cos(theta)) / theta2.clip(1e-24)
     c = (theta - jnp.sin(theta)) / (theta2.clip(1e-24) * theta)
@@ -116,7 +125,7 @@ def adjoint_se3(T):
     t = T[..., :3, 3]
     z = jnp.zeros_like(R)
     top = jnp.concatenate([R, z], -1)
-    bot = jnp.concatenate([_skew(t) @ R, R], -1)
+    bot = jnp.concatenate([_mm(_skew(t), R), R], -1)
     return jnp.concatenate([top, bot], -2)
 
 
@@ -133,14 +142,14 @@ def _optimize_jit(poses0, ei, ej, Z, w_edge, iters: int, damping):
 
     def residuals(poses):
         Ti_inv = jnp.linalg.inv(poses[ei])
-        E = Zinv @ Ti_inv @ poses[ej]
+        E = _mm(_mm(Zinv, Ti_inv), poses[ej])
         return log_se3(E), E
 
     def gn_step(poses, _):
         r, E = residuals(poses)                     # [E,6], [E,4,4]
         # right-perturbation T_i <- T_i exp(xi_i) gives E <- E exp(-Ad(A^-1) xi_i)
         # with A = T_i^-1 T_j, so dr/dxi_i = -Ad(A^-1); dr/dxi_j = +I
-        A_inv = jnp.linalg.inv(poses[ej]) @ poses[ei]
+        A_inv = _mm(jnp.linalg.inv(poses[ej]), poses[ei])
         Ji = -adjoint_se3(A_inv)                    # [E,6,6]
         wgt = w_edge[:, None]
         # normal equations over stacked 6-dof blocks; node 0 held fixed by
@@ -149,10 +158,11 @@ def _optimize_jit(poses0, ei, ej, Z, w_edge, iters: int, damping):
         g = jnp.zeros((n * 6,), poses.dtype)
 
         eye6 = jnp.eye(6, dtype=poses.dtype)
-        JiT_Ji = jnp.einsum("eki,e,ekj->eij", Ji, w_edge, Ji)
+        JiT_Ji = jnp.einsum("eki,e,ekj->eij", Ji, w_edge, Ji, precision=_MM)
         JiT_Jj = jnp.einsum("eki,e->eik", Ji, w_edge)      # Ji^T W I
         JjT_Jj = w_edge[:, None, None] * eye6
-        JiT_r = jnp.einsum("eki,ek->ei", Ji, w_edge[:, None] * r * 1.0)
+        JiT_r = jnp.einsum("eki,ek->ei", Ji, w_edge[:, None] * r * 1.0,
+                           precision=_MM)
         JjT_r = wgt * r
 
         def scatter_block(H, rows, cols, blocks):
@@ -172,7 +182,7 @@ def _optimize_jit(poses0, ei, ej, Z, w_edge, iters: int, damping):
         anchor = jnp.zeros(n * 6, poses.dtype).at[:6].set(1e12)
         H = H + jnp.diag(anchor) + damping * jnp.eye(n * 6, dtype=poses.dtype)
         xi = jnp.linalg.solve(H, g).reshape(n, 6)
-        poses_new = poses @ exp_se3(xi)
+        poses_new = _mm(poses, exp_se3(xi))
         r_new, _ = residuals(poses_new)   # residual AFTER this update
         rmse = jnp.sqrt((w_edge * (r_new * r_new).sum(-1)).sum()
                         / jnp.maximum(w_edge.sum(), 1e-9))
@@ -204,6 +214,6 @@ def optimize_pose_graph(init_poses, edges_i, edges_j, edge_transforms,
                                        jnp.float32(damping))
     # re-orthonormalize rotations after accumulated float updates
     u, _, vt = jnp.linalg.svd(poses[:, :3, :3])
-    Rn = u @ vt
+    Rn = _mm(u, vt)
     poses = poses.at[:, :3, :3].set(Rn)
     return PoseGraphResult(poses, hist, rmse0)

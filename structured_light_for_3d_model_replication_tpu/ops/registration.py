@@ -38,13 +38,20 @@ class RegistrationResult(NamedTuple):
     rmse: jax.Array       # inlier RMSE
 
 
+# geometry contractions run at HIGHEST everywhere in this module: the TPU
+# default matmul precision is bf16-class (eps ~4e-3 — millimeters at this
+# rig's working distance), measured to leave kabsch rotations off-orthogonal
+# by 2e-2 before the pins landed
+_MM = jax.lax.Precision.HIGHEST
+
+
 def transform_points(T, pts):
-    return pts @ T[:3, :3].T + T[:3, 3]
+    return jnp.matmul(pts, T[:3, :3].T, precision=_MM) + T[:3, 3]
 
 
 def compose(a, b):
     """Transform equivalent to applying b, then a."""
-    return a @ b
+    return jnp.matmul(a, b, precision=_MM)
 
 
 def _skew(v):
@@ -61,17 +68,13 @@ def _exp_so3(w):
     theta = jnp.sqrt((w * w).sum(-1, keepdims=True) + 1e-24)[..., None]
     k = _skew(w / theta[..., 0])
     eye = jnp.eye(3, dtype=w.dtype)
-    return eye + jnp.sin(theta) * k + (1 - jnp.cos(theta)) * (k @ k)
+    return eye + jnp.sin(theta) * k \
+        + (1 - jnp.cos(theta)) * jnp.matmul(k, k, precision=_MM)
 
 
 def kabsch(p, q, w=None):
     """Least-squares rigid transform aligning p -> q. p, q: [.., M, 3];
     optional weights [.., M]. Returns [.., 4, 4]."""
-    # every contraction at HIGHEST: TPU's default matmul precision is
-    # bf16-class (eps ~4e-3), which left hypothesis rotations off-orthogonal
-    # by up to 2e-2 — a ~4 mm error at this rig's working distance (measured
-    # on RANSAC hypothesis batches before this was pinned)
-    mm = jax.lax.Precision.HIGHEST
     if w is None:
         w = jnp.ones(p.shape[:-1], p.dtype)
     ws = jnp.maximum(w.sum(-1, keepdims=True), 1e-12)
@@ -79,22 +82,22 @@ def kabsch(p, q, w=None):
     cq = (q * w[..., None]).sum(-2) / ws
     pc = (p - cp[..., None, :]) * w[..., None]
     qc = q - cq[..., None, :]
-    h = jnp.einsum("...mi,...mj->...ij", pc, qc, precision=mm)
+    h = jnp.einsum("...mi,...mj->...ij", pc, qc, precision=_MM)
     u, s, vt = jnp.linalg.svd(h)
     det = jnp.linalg.det(jnp.einsum("...ij,...jk->...ik",
                                     jnp.swapaxes(vt, -1, -2),
-                                    jnp.swapaxes(u, -1, -2), precision=mm))
+                                    jnp.swapaxes(u, -1, -2), precision=_MM))
     d = jnp.stack([jnp.ones_like(det), jnp.ones_like(det), det], -1)
     r = jnp.einsum("...ji,...j,...jk->...ik", vt, d,
-                   jnp.swapaxes(u, -1, -2), precision=mm)
+                   jnp.swapaxes(u, -1, -2), precision=_MM)
     # two Newton-Schulz sweeps (R <- R(3I - R^T R)/2) polish the f32 SVD's
     # residual non-orthogonality down to roundoff
     eye3 = jnp.eye(3, dtype=r.dtype)
     for _ in range(2):
-        rtr = jnp.einsum("...ji,...jk->...ik", r, r, precision=mm)
+        rtr = jnp.einsum("...ji,...jk->...ik", r, r, precision=_MM)
         r = 0.5 * jnp.einsum("...ij,...jk->...ik", r, 3.0 * eye3 - rtr,
-                             precision=mm)
-    t = cq - jnp.einsum("...ij,...j->...i", r, cp, precision=mm)
+                             precision=_MM)
+    t = cq - jnp.einsum("...ij,...j->...i", r, cp, precision=_MM)
     bot = jnp.broadcast_to(jnp.asarray([0, 0, 0, 1], p.dtype),
                            r.shape[:-2] + (1, 4))
     top = jnp.concatenate([r, t[..., :, None]], -1)
@@ -110,7 +113,7 @@ def _icp_step_update(T, cur, q, nrm, ok, nv):
     w = ok.astype(jnp.float32)
     r = ((cur - q) * nrm).sum(-1)                     # signed p2plane residual
     jac = jnp.concatenate([jnp.cross(cur, nrm), nrm], -1)  # [N, 6]
-    a = jnp.einsum("ni,nj->ij", jac * w[:, None], jac)
+    a = jnp.einsum("ni,nj->ij", jac * w[:, None], jac, precision=_MM)
     b = -(jac * (w * r)[:, None]).sum(0)
     x = jnp.linalg.solve(a + 1e-6 * jnp.eye(6), b)
     dT = jnp.eye(4, dtype=T.dtype)
@@ -118,7 +121,7 @@ def _icp_step_update(T, cur, q, nrm, ok, nv):
     dT = dT.at[:3, 3].set(x[3:])
     rmse = jnp.sqrt((w * r * r).sum() / jnp.maximum(w.sum(), 1.0))
     fitness = w.sum() / nv
-    return dT @ T, fitness, rmse
+    return compose(dT, T), fitness, rmse
 
 
 @functools.partial(jax.jit, static_argnames=("iters", "rings"))
@@ -157,7 +160,7 @@ def _nn1_brute_jnp(cur, dst_pts, dst_valid, block_q: int = 2048):
         # full f32: the d2 expansion cancels catastrophically in bf16 (same
         # reasoning as pallas_kernels._nn1_kernel's HIGHEST-precision dot)
         cross = jnp.matmul(q, dst_pts.T,
-                           precision=jax.lax.Precision.HIGHEST)
+                           precision=_MM)
         d2 = ((q * q).sum(-1, keepdims=True) + d2_dst[None, :] - 2.0 * cross)
         d2 = jnp.where(dst_valid[None, :], d2, jnp.inf)
         j = jnp.argmin(d2, axis=1).astype(jnp.int32)
@@ -348,7 +351,7 @@ def _feature_correspondences(sf, df, sv, dv, mutual: bool,
 
     def chunk(args):
         f, v = args
-        cross = jnp.matmul(f, df.T, precision=jax.lax.Precision.HIGHEST)
+        cross = jnp.matmul(f, df.T, precision=_MM)
         d2 = (f * f).sum(-1, keepdims=True) + df2[None, :] - 2.0 * cross
         d2 = jnp.where(dv[None, :], d2, jnp.inf)
         cj = jnp.argmin(d2, axis=1).astype(jnp.int32)
@@ -413,7 +416,8 @@ def _ransac_core(src, src_valid, dst, dst_valid, corr_j, corr_ok, max_dist,
     T = kabsch(p, q)                 # [T,4,4]
     # distance checker (CorrespondenceCheckerBasedOnDistance): the sampled
     # correspondences themselves must land within max_dist under T
-    moved_s = jnp.einsum("tij,tnj->tni", T[:, :3, :3], p) + T[:, None, :3, 3]
+    moved_s = jnp.einsum("tij,tnj->tni", T[:, :3, :3], p,
+                         precision=_MM) + T[:, None, :3, 3]
     dist_pass = (((moved_s - q) ** 2).sum(-1)
                  <= max_dist * max_dist).all(-1)
 
@@ -431,8 +435,9 @@ def _ransac_core(src, src_valid, dst, dst_valid, corr_j, corr_ok, max_dist,
     # (~±100 mm) keep it at ~0.01 mm^2. Shift: ||R s + t - c||
     # = ||R s_c + (t + R mu_s - mu_c) - c_c|| with s_c = s - mu_s etc.
     dst_c = dst[corr_j]
-    mu_s = jnp.where(corr_ok, 1.0, 0.0) @ src / jnp.maximum(corr_ok.sum(), 1)
-    mu_c = jnp.where(corr_ok, 1.0, 0.0) @ dst_c / jnp.maximum(corr_ok.sum(), 1)
+    wv = jnp.where(corr_ok, 1.0, 0.0)
+    mu_s = jnp.matmul(wv, src, precision=_MM) / jnp.maximum(corr_ok.sum(), 1)
+    mu_c = jnp.matmul(wv, dst_c, precision=_MM) / jnp.maximum(corr_ok.sum(), 1)
     src_c = src - mu_s
     dst_cc = dst_c - mu_c
     s2 = (src_c * src_c).sum(-1)                  # [N]
@@ -441,17 +446,17 @@ def _ransac_core(src, src_valid, dst, dst_valid, corr_j, corr_ok, max_dist,
     R9 = T[:, :3, :3].reshape(-1, 9)              # R_ij, i-major
     tt = (T[:, :3, 3] - mu_c[None, :]
           + jnp.einsum("tij,j->ti", T[:, :3, :3], mu_s,
-                       precision=jax.lax.Precision.HIGHEST))  # [T, 3]
+                       precision=_MM))  # [T, 3]
     t2 = (tt * tt).sum(-1)                        # [T]
     Rt = jnp.einsum("tij,ti->tj", T[:, :3, :3], tt,
-                    precision=jax.lax.Precision.HIGHEST)  # R^T t [T, 3]
+                    precision=_MM)  # R^T t [T, 3]
 
     def score_chunk(args):
         R9c, ttc, t2c, Rtc = args
         mm = jax.lax.Precision.HIGHEST
-        cross = (jnp.matmul(Rtc, src_c.T, precision=mm)
-                 - jnp.matmul(R9c, cs9.T, precision=mm)
-                 - jnp.matmul(ttc, dst_cc.T, precision=mm))
+        cross = (jnp.matmul(Rtc, src_c.T, precision=_MM)
+                 - jnp.matmul(R9c, cs9.T, precision=_MM)
+                 - jnp.matmul(ttc, dst_cc.T, precision=_MM))
         d2 = s2[None, :] + c2[None, :] + t2c[:, None] + 2.0 * cross
         inl = (d2 <= max_dist * max_dist) & corr_ok[None, :]
         return inl.sum(-1)

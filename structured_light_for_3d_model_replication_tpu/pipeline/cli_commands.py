@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 from typing import Callable
 
 _RUNNERS: dict[str, Callable[[argparse.Namespace], int]] = {}
@@ -33,8 +34,24 @@ def _cfg(args: argparse.Namespace):
     from structured_light_for_3d_model_replication_tpu import load_config
     from structured_light_for_3d_model_replication_tpu.cli import parse_overrides
 
-    return load_config(getattr(args, "config", None),
-                       parse_overrides(getattr(args, "set", [])))
+    cfg = load_config(getattr(args, "config", None),
+                      parse_overrides(getattr(args, "set", [])))
+    if cfg.parallel.backend in ("numpy", "cpu"):
+        # honor the backend choice for EVERY stage: jnp-path stages (merge,
+        # clean, mesh) would otherwise initialize the ambient accelerator —
+        # a JAX_PLATFORMS env var does not work here because this box's
+        # sitecustomize force-registers the accelerator plugin over it, so
+        # the config update (authoritative, same trick as tests/conftest.py)
+        # must land before first jax use
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        if jax.default_backend() != "cpu":  # repin after init is ignored
+            print("[config] WARNING: parallel.backend="
+                  f"{cfg.parallel.backend} requested but the "
+                  f"{jax.default_backend()} backend was already initialized; "
+                  "stages will run on it", file=sys.stderr)
+    return cfg
 
 
 def _runner(name: str):
