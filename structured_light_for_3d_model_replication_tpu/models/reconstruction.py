@@ -272,9 +272,14 @@ def _postprocess_merged(points, colors, cfg: MergeConfig, tm: dict | None = None
         valid = valid[:: cfg.sample_after]
     if cfg.outlier_nb > 0:
         t0 = _time.perf_counter()
+        # after the final voxel pass cells hold (near-)single occupants
+        # (uniform sampling keeps that property) — the voxelized fast path
+        # probes a bounded cell neighborhood instead of dense distance rows
+        cell = (float(cfg.final_voxel)
+                if cfg.final_voxel and cfg.final_voxel > 0 else None)
         m = np.asarray(pc.statistical_outlier_mask(
             jnp.asarray(points), jnp.asarray(valid),
-            cfg.outlier_nb, cfg.outlier_std))
+            cfg.outlier_nb, cfg.outlier_std, voxelized_cell=cell))
         points, colors = points[m], colors[m]
         tm["outlier_s"] = round(_time.perf_counter() - t0, 3)
     return points, colors

@@ -60,12 +60,125 @@ def _stat_outlier_from_knn(mean_d, valid, std_ratio, xp):
 
 
 def statistical_outlier_mask(points, valid, nb_neighbors: int = 20,
-                             std_ratio: float = 2.0):
+                             std_ratio: float = 2.0,
+                             voxelized_cell: float | None = None):
     """Keep-mask for statistical outlier removal (Open3D semantics,
-    processing.py:376-379). points [N,3] padded, valid [N]."""
+    processing.py:376-379). points [N,3] padded, valid [N].
+
+    ``voxelized_cell``: pass the voxel size when ``points`` just came out of
+    voxel_downsample(cell) — cells then hold one point (at most two after
+    f32 re-gridding shifts) and the kNN collapses to a 9^3-cell
+    neighborhood probe over sorted packed keys (no N^2 distance rows; much
+    faster at merged-cloud scale), plus an exact dense pass over the few
+    rows the probe cannot certify. Results match the generic path exactly
+    (same Open3D statistics). Ignored when the grid would not fit 1024
+    cells/axis."""
+    if voxelized_cell is not None and not isinstance(points, jax.core.Tracer):
+        lo, hi = _masked_extent_jit(points, valid)
+        ext = np.maximum(np.asarray(hi) - np.asarray(lo), 0.0)
+        if np.all(np.floor(ext / np.float32(voxelized_cell)) < 1023):
+            mean_d = np.array(_voxelized_knn_mean_dist(
+                points, valid, jnp.float32(voxelized_cell), nb_neighbors))
+            # rows the ring probe could not certify (k-th neighbor beyond
+            # 4 cells: cloud-boundary points and true outliers) get an
+            # exact dense pass — Open3D's statistics include the huge mean
+            # distances of far outliers, which inflate sigma, so censoring
+            # them as inf would systematically tighten the threshold
+            bad = np.asarray(valid) & ~np.isfinite(mean_d)
+            if bad.any():
+                sub = np.asarray(points)[bad]
+                m_pad = -(-len(sub) // 256) * 256
+                subp = np.full((m_pad, 3), 1e9, np.float32)
+                subp[:len(sub)] = sub
+                d2s = _dense_knn_d2_subset(jnp.asarray(subp),
+                                           jnp.asarray(points), valid,
+                                           nb_neighbors)
+                md_sub = np.sqrt(np.maximum(np.asarray(d2s), 0.0)).mean(1)
+                mean_d[bad] = md_sub[:len(sub)]
+            return np.asarray(_stat_outlier_from_knn(
+                jnp.asarray(mean_d), valid, jnp.float32(std_ratio), jnp))
     _, d2 = knnlib.knn(points, valid, nb_neighbors)
     mean_d = jnp.sqrt(jnp.maximum(d2, 0.0)).mean(axis=1)
     return _stat_outlier_from_knn(mean_d, valid, jnp.float32(std_ratio), jnp)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _dense_knn_d2_subset(queries, points, valid, k: int):
+    """Exact k smallest squared distances from each query row to the valid
+    points (self-matches excluded by the d2 > 0 guard: queries ARE cloud
+    points, and distinct voxel centroids cannot coincide)."""
+    pts = jnp.where(valid[:, None], points, 1e9)
+    b2 = (pts * pts).sum(-1)
+    q2 = (queries * queries).sum(-1)[:, None]
+    cross = jnp.matmul(queries, pts.T, precision=jax.lax.Precision.HIGHEST)
+    d2 = q2 + b2[None, :] - 2.0 * cross
+    d2 = jnp.where(d2 <= 1e-9, jnp.inf, d2)  # self
+    negk, _ = jax.lax.top_k(-d2, k)
+    return -negk
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _voxelized_knn_mean_dist(points, valid, cell, k: int):
+    """Mean distance to the k nearest neighbors for a near-one-point-per-cell
+    cloud: probe the 9^3 cells within 4 rings via binary search on the
+    sorted 30-bit packed keys, taking up to TWO occupants per cell (f32
+    re-gridding can push a centroid across a face into its neighbor's
+    cell). Soundness gate: a row is certified (finite) only when its k-th
+    candidate distance is <= 4*cell — every point within Euclidean 4*cell
+    lies inside the probed Chebyshev block — AND no probed cell held a
+    third, unseen occupant. Uncertified rows return inf for the caller's
+    exact dense fallback."""
+    n = points.shape[0]
+    origin = jnp.where(valid[:, None], points, jnp.inf).min(axis=0)
+    origin = jnp.where(jnp.isfinite(origin), origin, 0.0)
+    ijk = jnp.clip(jnp.floor((points - origin) / cell).astype(jnp.int32),
+                   0, 1023)
+    key = (ijk[:, 0] << 20) | (ijk[:, 1] << 10) | ijk[:, 2]
+    key = jnp.where(valid, key, jnp.int32(1 << 30))
+    order = jnp.argsort(key)
+    key_s = key[order]
+    pts_s = points[order]
+
+    r = tuple(range(-4, 5))
+    # arithmetic, NOT bitwise-OR packing: negative components sign-extend
+    # under | and corrupt the table (480/728 entries collapsed before this
+    # was caught). Addition composes with the query key exactly.
+    offs = jnp.asarray([dx * (1 << 20) + dy * (1 << 10) + dz
+                        for dx in r for dy in r for dz in r],
+                       jnp.int32)                        # [729], incl. 0
+
+    def chunk(args):
+        qk, qp = args
+        cand = qk[:, None] + offs[None, :]               # [C, 729]
+        pos = jnp.searchsorted(key_s, cand)              # [C, 729]
+
+        def occupant(p):
+            p = jnp.minimum(p, n - 1)
+            hit = key_s[p] == cand
+            d = pts_s[p] - qp[:, None, :]
+            d2 = (d * d).sum(-1)
+            # self-match: the query is one of the occupants (d2 ~ 0)
+            d2 = jnp.where(hit & (d2 > 1e-12), d2, jnp.inf)
+            return d2
+
+        d2 = jnp.concatenate([occupant(pos), occupant(pos + 1)], axis=1)
+        third = (key_s[jnp.minimum(pos + 2, n - 1)] == cand).any(axis=1)
+        negk, _ = jax.lax.top_k(-d2, k)
+        kd2 = jnp.maximum(-negk, 0.0)                    # descending -> asc
+        md = jnp.sqrt(kd2).mean(axis=1)
+        certified = (kd2[:, -1] <= (4.0 * cell) ** 2) & ~third
+        return jnp.where(certified, md, jnp.inf)
+
+    chunk_q = 4096
+    n_pad = -(-n // chunk_q) * chunk_q
+    kq = jnp.concatenate([key, jnp.full(n_pad - n, 1 << 30, jnp.int32)]) \
+        if n_pad > n else key
+    pq = jnp.concatenate([points, jnp.full((n_pad - n, 3), 1e9,
+                                           points.dtype)]) if n_pad > n \
+        else points
+    md = jax.lax.map(chunk, (kq.reshape(-1, chunk_q),
+                             pq.reshape(-1, chunk_q, 3)))
+    return md.reshape(-1)[:n]
 
 
 def statistical_outlier_mask_np(points, valid, nb_neighbors: int = 20,

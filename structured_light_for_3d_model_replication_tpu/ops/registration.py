@@ -453,7 +453,6 @@ def _ransac_core(src, src_valid, dst, dst_valid, corr_j, corr_ok, max_dist,
 
     def score_chunk(args):
         R9c, ttc, t2c, Rtc = args
-        mm = jax.lax.Precision.HIGHEST
         cross = (jnp.matmul(Rtc, src_c.T, precision=_MM)
                  - jnp.matmul(R9c, cs9.T, precision=_MM)
                  - jnp.matmul(ttc, dst_cc.T, precision=_MM))
