@@ -103,9 +103,18 @@ def _voxel_views_jit(pts_v, valid_v, vs):
 
 @jax.jit
 def _features_views_jit(pts_v, valid_v, feat_radius):
-    return jax.lax.map(
-        lambda a: _prep_features_jit(a[0], a[1], feat_radius),
-        (pts_v, valid_v))
+    # vmap in view chunks, not lax.map: per-view feature prep is many small
+    # ops (tiled kNN blocks, 3x3 eigensolves, 11-bin histograms) that batch
+    # into far fewer, fatter launches — but a whole-stack vmap would let
+    # peak memory scale with the view count (~50-100 MB of kNN transients
+    # per view), so the batching is bounded at 8 views at a time
+    n_views = pts_v.shape[0]
+    chunk = min(8, n_views)
+    outs = [jax.vmap(lambda p, v: _prep_features_jit(p, v, feat_radius))(
+                pts_v[s:s + chunk], valid_v[s:s + chunk])
+            for s in range(0, n_views, chunk)]
+    return (jnp.concatenate([o[0] for o in outs]),
+            jnp.concatenate([o[1] for o in outs]))
 
 
 def _preprocess_views(clouds, voxel: float, sample_before: int):
