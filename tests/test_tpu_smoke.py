@@ -90,6 +90,21 @@ mask = np.asarray(pc.statistical_outlier_mask(big, big_valid, 20, 2.0))
 out["outlier_merge_scale_ok"] = bool(0.5 < mask.mean() <= 1.0)
 cnt = np.asarray(knnlib.radius_count(big, big_valid, 5.0))
 out["radius_merge_scale_ok"] = bool((cnt >= 0).all() and cnt.max() > 0)
+
+# meshing path (Poisson grid solve + surface nets) at a modest depth: the
+# grid-path lesson is that accelerator-only faults hide from the CPU suite
+from structured_light_for_3d_model_replication_tpu.config import MeshConfig
+from structured_light_for_3d_model_replication_tpu.models.meshing import (
+    reconstruct_mesh,
+)
+rng_m = np.random.default_rng(2)
+dirs = rng_m.normal(size=(20_000, 3)).astype(np.float32)
+dirs /= np.linalg.norm(dirs, axis=1, keepdims=True)
+sphere = 40.0 * dirs + np.float32([0, 0, 400])
+verts, faces = reconstruct_mesh(
+    sphere, cfg=MeshConfig(mode="watertight", depth=7))
+out["mesh_tpu_ok"] = bool(len(verts) > 100 and len(faces) > 100
+                          and np.isfinite(np.asarray(verts)).all())
 print(json.dumps(out))
 '''
 
@@ -118,5 +133,5 @@ def test_flagship_paths_on_accelerator():
     for key in ("forward_table_finite", "forward_quadratic_finite",
                 "views_quadratic_shape_ok",
                 "nn1_finite", "radius_nonneg", "outlier_merge_scale_ok",
-                "radius_merge_scale_ok"):
+                "radius_merge_scale_ok", "mesh_tpu_ok"):
         assert out.get(key) is True, (key, out)
