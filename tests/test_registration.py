@@ -198,3 +198,17 @@ def test_register_pairs_sharded_matches_unsharded(rng):
                          srcs[p])
         assert np.median(np.linalg.norm(m_s - base, axis=1)) < 0.5
         assert np.median(np.linalg.norm(m_u - base, axis=1)) < 0.5
+
+
+def test_kabsch_rotations_orthogonal(rng):
+    # regression: TPU's bf16-class default matmul precision left hypothesis
+    # rotations off-orthogonal by 2e-2 until the precision pins + the
+    # Newton-Schulz polish landed; the invariant is cheap to assert and
+    # load-bearing (RANSAC scoring expands ||Rs+t-c||^2 assuming R^T R = I)
+    p = jnp.asarray(rng.normal(size=(256, 3, 3)).astype(np.float32) * 50)
+    q = jnp.asarray(rng.normal(size=(256, 3, 3)).astype(np.float32) * 50)
+    T = np.asarray(reg.kabsch(p, q))
+    R = T[:, :3, :3]
+    orth = np.abs(np.einsum("tij,tkj->tik", R, R) - np.eye(3)).max()
+    assert orth < 1e-5, orth
+    assert (np.linalg.det(R) > 0.99).all()

@@ -91,6 +91,18 @@ out["outlier_merge_scale_ok"] = bool(0.5 < mask.mean() <= 1.0)
 cnt = np.asarray(knnlib.radius_count(big, big_valid, 5.0))
 out["radius_merge_scale_ok"] = bool((cnt >= 0).all() and cnt.max() > 0)
 
+# kabsch orthogonality ON DEVICE: the TPU's bf16-class default matmul
+# precision bent rotations by 2e-2 before the precision pins; the CPU
+# suite cannot see that class of error
+from structured_light_for_3d_model_replication_tpu.ops import registration as reg
+rng_k = np.random.default_rng(3)
+pk_ = jnp.asarray(rng_k.normal(size=(512, 3, 3)).astype(np.float32) * 50)
+qk_ = jnp.asarray(rng_k.normal(size=(512, 3, 3)).astype(np.float32) * 50)
+Rk = np.asarray(reg.kabsch(pk_, qk_))[:, :3, :3]
+orth_err = float(np.abs(np.einsum("tij,tkj->tik", Rk, Rk)
+                        - np.eye(3)).max())
+out["kabsch_orthogonal_on_device"] = bool(orth_err < 1e-4)
+
 # meshing path (Poisson grid solve + surface nets) at a modest depth: the
 # grid-path lesson is that accelerator-only faults hide from the CPU suite
 from structured_light_for_3d_model_replication_tpu.config import MeshConfig
@@ -133,5 +145,6 @@ def test_flagship_paths_on_accelerator():
     for key in ("forward_table_finite", "forward_quadratic_finite",
                 "views_quadratic_shape_ok",
                 "nn1_finite", "radius_nonneg", "outlier_merge_scale_ok",
-                "radius_merge_scale_ok", "mesh_tpu_ok"):
+                "radius_merge_scale_ok", "mesh_tpu_ok",
+                "kabsch_orthogonal_on_device"):
         assert out.get(key) is True, (key, out)
