@@ -219,6 +219,11 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
     res["decode_triangulate_s"] = round(best * scale, 4)
     res["decode_compile_s"] = round(max(decode_first - best, 0.0), 2)
     res["decode_backend"] = backend
+    try:  # which decode lowering actually ran (fused Mosaic vs jnp path)
+        res["decode_path"] = ("fused-pallas" if scanner._can_fuse(views_dev)
+                              else "jnp")
+    except Exception:
+        res["decode_path"] = "unknown"
     res["views_measured"] = views
     res["mpix_per_s"] = round(N_VIEWS * CAM[0] * CAM[1] / (best * scale) / 1e6, 1)
     n_valid0 = int(np.asarray(out.valid[0]).sum())
@@ -314,8 +319,8 @@ def _run_child(args: list[str], timeout: int) -> dict | None:
 
 _PHASE_KEYS = {
     "decode_triangulate_s": ("decode_triangulate_s", "decode_compile_s",
-                             "decode_backend", "mpix_per_s", "views_measured",
-                             "pallas"),
+                             "decode_backend", "decode_path", "mpix_per_s",
+                             "views_measured", "pallas"),
     "chamfer_mm": ("chamfer_mm", "chamfer_backend"),
     "merge_s": ("merge_s", "merge_steady_s", "merge_compile_s",
                 "merge_backend", "merge_points", "merge_icp_fit_mean",
@@ -433,10 +438,11 @@ def main() -> None:
             return
 
         for k in ("decode_triangulate_s", "decode_compile_s", "decode_backend",
-                  "mpix_per_s", "merge_s", "merge_steady_s", "merge_compile_s",
-                  "merge_backend", "chamfer_mm", "chamfer_backend", "pallas",
-                  "views_measured", "merge_points", "merge_icp_fit_mean",
-                  "merge_stage_s", "merge_stage_first_s", "backend_error"):
+                  "decode_path", "mpix_per_s", "merge_s", "merge_steady_s",
+                  "merge_compile_s", "merge_backend", "chamfer_mm",
+                  "chamfer_backend", "pallas", "views_measured",
+                  "merge_points", "merge_icp_fit_mean", "merge_stage_s",
+                  "merge_stage_first_s", "backend_error"):
             if k in res and res[k] is not None:
                 final[k] = res[k]
         # top-level backend is derived from the per-phase provenance tags —
