@@ -1,0 +1,90 @@
+"""Profile the flagship decode+triangulate on the ambient backend.
+
+Compares the jnp lax.map path (plane table and quadratic plane eval)
+against the fused single-pass Mosaic kernel at the bench's 24-view
+1080p shape. Uses the bench scene cache (.bench_cache.npz).
+
+Self-terminating; never wrap in a kill timer near expected runtime
+(SIGTERM mid-TPU-claim wedges the tunnel — BENCH_NOTES.md).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> None:
+    views = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from structured_light_for_3d_model_replication_tpu.models.scanner import (
+        SLScanner,
+    )
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pallas_kernels as pk,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        synthetic as syn,
+    )
+
+    cache = os.path.join(ROOT, ".bench_cache.npz")
+    if not os.path.exists(cache):
+        sys.exit("no .bench_cache.npz — run `python bench.py` once first")
+    with np.load(cache) as z:
+        frames = z["frames"]
+    cam = (frames.shape[2], frames.shape[1])
+    print(f"backend={jax.default_backend()} pallas={pk.pallas_mode()} "
+          f"views={views} cam={cam}")
+    rig = syn.default_rig(cam_size=cam, proj_size=cam)
+    base = jax.block_until_ready(jnp.asarray(frames))
+    stack = jax.block_until_ready(
+        jnp.stack([jnp.roll(base, i * 7, axis=2) for i in range(views)]))
+
+    ref_pts = None
+    for label, plane_eval, force_jnp in (("table-jnp", "table", True),
+                                         ("quad-jnp", "quadratic", True),
+                                         ("quad-auto", "quadratic", False)):
+        sc = SLScanner(rig.calibration(), cam, cam, row_mode=1,
+                       plane_eval=plane_eval)
+        if force_jnp:
+            sc._can_fuse = lambda f: False  # pin the jnp lowering
+        path = "fused" if (not force_jnp and sc._can_fuse(stack)) else "jnp"
+
+        def run():
+            out = sc.forward_views(stack, thresh_mode="manual",
+                                   shadow_val=40.0, contrast_val=10.0)
+            jax.block_until_ready(out.points)
+            return out
+
+        t0 = time.perf_counter()
+        out = run()
+        first = time.perf_counter() - t0
+        best = np.inf
+        for _ in range(3):
+            t0 = time.perf_counter()
+            run()
+            best = min(best, time.perf_counter() - t0)
+        mpix = views * cam[0] * cam[1] / best / 1e6
+        pts = np.asarray(out.points[0])[np.asarray(out.valid[0])]
+        drift = ""
+        if ref_pts is None:
+            ref_pts = pts
+        elif len(pts) == len(ref_pts):
+            drift = f" max|dp|={np.abs(pts - ref_pts).max():.2e}mm"
+        print(f"{label:10s} path={path:5s} first={first:6.2f}s "
+              f"steady={best:6.3f}s {mpix:7.1f} Mpix/s "
+              f"valid0={len(pts)}{drift}")
+
+
+if __name__ == "__main__":
+    main()
