@@ -138,3 +138,26 @@ def test_reconstruct_numpy_backend_matches_jax(dataset, tmp_path):
     pb = plyio.read_ply(b)["points"]
     assert pa.shape == pb.shape
     np.testing.assert_allclose(pa, pb, atol=2e-2)
+
+
+def test_clean_chain_aborts_when_all_points_removed(tmp_path):
+    # a sparse cloud under the reference's density-tuned DBSCAN defaults
+    # (eps=5, min_points=200) legitimately clusters to nothing; the chain
+    # must warn and write an empty-but-valid PLY instead of crashing
+    from structured_light_for_3d_model_replication_tpu.io import ply as plyio
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+    rng = np.random.default_rng(5)
+    pts = rng.uniform(0, 500, (400, 3)).astype(np.float32)
+    cols = np.zeros((400, 3), np.uint8)
+    src = tmp_path / "sparse.ply"
+    out = tmp_path / "cleaned.ply"
+    plyio.write_ply(str(src), pts, cols)
+    logs = []
+    counts = stages.clean_cloud(str(src), str(out),
+                                steps=["cluster", "statistical"],
+                                log=logs.append)
+    assert counts["cluster"] == 0
+    assert any("aborting chain" in m for m in logs)
+    d = plyio.read_ply(str(out))
+    assert len(d["points"]) == 0
