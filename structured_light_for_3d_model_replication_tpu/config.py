@@ -53,10 +53,16 @@ class TriangulateConfig:
 
     row_mode: int = 1          # 0=columns only, 1=epipolar filter, 2=merge col+row clouds
     epipolar_tol: float = 2.0  # mm
-    # 'table' = gather stored plane equations (bit-exact across backends);
+    # 'table' = gather stored plane equations (1-2 ULP of the numpy backend);
     # 'quadratic' = closed-form per-pixel plane evaluation (no gather, ~20x
     # faster triangulation on TPU, within ~1e-5 relative of the table)
     plane_eval: str = "table"
+    # run triangulation eagerly (one XLA kernel per primitive: no FMA
+    # contraction) so exported coordinates match the NumPy backend bit for
+    # bit; needs plane_eval='table'. ~30 dispatches instead of one fused
+    # program — for export paths where the BASELINE bit-exactness contract
+    # matters more than the last milliseconds
+    bitexact: bool = False
 
 
 @dataclass

@@ -21,10 +21,19 @@ makes it trivially shard_map-able over pixel rows and batchable over views.
 
 Numerics: all arithmetic is float32 with identical operation order in the
 NumPy and JAX paths, using explicit elementwise dot products (x*x+y*y+z*z).
-XLA contracts multiply-add chains into FMAs (on both CPU and TPU backends), so
-compiled coordinates can differ from the NumPy backend by 1-2 ULP (~1e-5 mm at
-scene scale); validity masks and decoded integer maps are bit-exact. Tests pin
-this contract: masks exactly equal, points to <=1e-3 mm.
+Under jit, XLA contracts multiply-add chains into FMAs (the contraction
+happens at instruction selection inside fused kernels — below HLO, so even
+lax.optimization_barrier cannot stop it, and no xla_cpu_* debug flag disables
+it), so compiled coordinates can differ from the NumPy backend by 1-2 ULP
+(~1e-5 mm at scene scale); validity masks and decoded integer maps are always
+bit-exact. Tests pin this contract: masks exactly equal, points to <=1e-3 mm.
+
+``bitexact=True`` removes even that ULP gap: the SAME ``_triangulate_impl``
+runs op-by-op in eager mode, where every jnp primitive is its own XLA
+executable — nothing fuses, so nothing contracts, and each f32 op rounds
+individually exactly like its NumPy twin (verified bit-for-bit over every
+pixel slot at 1080p, tests/test_synthetic_e2e.py). Calibration prep (rays,
+plane tables) is host-NumPy in this mode so the constants are bit-identical.
 """
 from __future__ import annotations
 
@@ -219,16 +228,36 @@ def _triangulate_jit(col_map, row_map, mask, texture, rays, oc, p_col, p_row,
 def triangulate(
     col_map, row_map, mask, texture, calib,
     row_mode: int = 1, epipolar_tol: float = 2.0,
-    plane_eval: str = "table",
+    plane_eval: str = "table", bitexact: bool = False,
 ) -> CloudResult:
     """JAX/TPU triangulation — one fused XLA program over all H*W pixels.
 
     ``plane_eval``: ``"table"`` gathers the stored per-index plane equations
-    (bit-exact with the numpy backend); ``"quadratic"`` evaluates the
+    (1-2 ULP of the numpy backend under jit); ``"quadratic"`` evaluates the
     closed-form plane polynomial per pixel instead — no gather, ~20x faster
     on TPU for scattered decode maps, within ~1e-5 relative of the table.
+
+    ``bitexact``: run the identical implementation EAGERLY (one XLA
+    executable per primitive, so no FMA contraction anywhere) with host-
+    NumPy calibration prep — coordinates then match triangulate_np bit for
+    bit (the BASELINE "bit-exact point cloud vs CPU path" contract), at the
+    cost of ~30 eager kernel dispatches instead of one fused program.
+    Requires plane_eval='table' (the NumPy reference path).
     """
     _check_plane_eval(plane_eval)
+    if bitexact:
+        if plane_eval != "table":
+            raise ValueError(
+                "bitexact=True requires plane_eval='table' (the NumPy "
+                "reference evaluates stored plane tables)")
+        h, w = col_map.shape
+        rays, oc, p_col, p_row = _prep_calib(calib, h, w, np)
+        return _triangulate_impl(
+            jnp.asarray(col_map), jnp.asarray(row_map), jnp.asarray(mask),
+            jnp.asarray(texture), jnp.asarray(rays), jnp.asarray(oc),
+            jnp.asarray(p_col), jnp.asarray(p_row),
+            row_mode=row_mode, epipolar_tol=float(epipolar_tol), xp=jnp,
+        )
     h, w = col_map.shape
     rays, oc, p_col, p_row = _prep_calib(calib, h, w, jnp)
     if plane_eval == "quadratic":

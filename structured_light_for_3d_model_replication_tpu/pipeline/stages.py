@@ -105,7 +105,9 @@ def reconstruct_source(source, calib: dict, cfg: Config, scanner=None):
             row_mode=tcfg.row_mode, epipolar_tol=tcfg.epipolar_tol,
             plane_eval=tcfg.plane_eval,
         )
-    elif scanner is not None:
+    elif scanner is not None and not tcfg.bitexact:
+        # the scanner's fused program contracts FMAs — a bitexact config must
+        # take the eager branch below no matter what the caller passed
         cloud = scanner.forward(frames, thresh_mode=dcfg.thresh_mode,
                                 shadow_val=dcfg.shadow_val,
                                 contrast_val=dcfg.contrast_val)
@@ -122,7 +124,7 @@ def reconstruct_source(source, calib: dict, cfg: Config, scanner=None):
         cloud = tri.triangulate(
             dec.col_map, dec.row_map, dec.mask, dec.texture, calib,
             row_mode=tcfg.row_mode, epipolar_tol=tcfg.epipolar_tol,
-            plane_eval=tcfg.plane_eval,
+            plane_eval=tcfg.plane_eval, bitexact=tcfg.bitexact,
         )
     return tri.compact_cloud(cloud)
 
@@ -144,7 +146,9 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
         raise ValueError(f"no scan sources found under {target!r} (mode={mode})")
 
     scanner = None
-    if cfg.parallel.backend != "numpy":
+    # bitexact export runs the eager per-primitive path in reconstruct_source,
+    # never the scanner's fused program (fusion is what contracts FMAs)
+    if cfg.parallel.backend != "numpy" and not cfg.triangulate.bitexact:
         from structured_light_for_3d_model_replication_tpu.models.scanner import (
             SLScanner,
         )
