@@ -241,18 +241,25 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
         triangulate as tri_mod,
     )
 
-    t0 = time.perf_counter()
-    dec0 = gc_mod.decode_stack(base, thresh_mode="manual")
-    bx_cloud = tri_mod.triangulate(dec0.col_map, dec0.row_map, dec0.mask,
-                                   dec0.texture, rig.calibration(),
-                                   row_mode=1, bitexact=True)
-    bx_pts, _ = tri_mod.compact_cloud(bx_cloud)
-    res["bitexact_cost_s"] = round(time.perf_counter() - t0, 3)
-    res["bitexact"] = bool(bx_pts.shape == cache["np_pts"].shape
-                           and (bx_pts == cache["np_pts"]).all())
-    res["bitexact_backend"] = backend
-    log(f"child: bitexact export path: match={res['bitexact']} "
-        f"({res['bitexact_cost_s']}s for 1 view incl. decode)")
+    try:
+        t0 = time.perf_counter()
+        dec0 = gc_mod.decode_stack(base, thresh_mode="manual")
+        bx_cloud = tri_mod.triangulate(dec0.col_map, dec0.row_map, dec0.mask,
+                                       dec0.texture, rig.calibration(),
+                                       row_mode=1, bitexact=True)
+        bx_pts, _ = tri_mod.compact_cloud(bx_cloud)
+        res["bitexact_cost_s"] = round(time.perf_counter() - t0, 3)
+        res["bitexact"] = bool(bx_pts.shape == cache["np_pts"].shape
+                               and (bx_pts == cache["np_pts"]).all())
+        res["bitexact_backend"] = backend
+        log(f"child: bitexact export path: match={res['bitexact']} "
+            f"({res['bitexact_cost_s']}s for 1 view incl. decode)")
+    except Exception as e:
+        # a verification-phase failure must never cost the headline merge
+        # measurement (this child IS the phase-B record): note it and go on
+        res["bitexact"] = None
+        res["bitexact_error"] = f"{type(e).__name__}: {e}"[:200]
+        log(f"child: bitexact verification FAILED ({res['bitexact_error']})")
     save()
 
     # ---- phase C before B (cheap): Chamfer vs the NumPy reference cloud ----
@@ -283,9 +290,15 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
                 pass
         log(f"child: {msg}")
 
+    # 2048 trials: measured equal fitness to the 4096 library default on
+    # this scene (well-overlapped 15-degree pairs) at half the scoring cost
+    from structured_light_for_3d_model_replication_tpu.config import MergeConfig
+
+    mcfg = MergeConfig(ransac_trials=2048)
+    res["merge_ransac_trials"] = mcfg.ransac_trials
     tm: dict = {}
     t0 = time.perf_counter()
-    merged_p, _, _ = merge_360(clouds, log=merge_log, timings=tm)
+    merged_p, _, _ = merge_360(clouds, cfg=mcfg, log=merge_log, timings=tm)
     merge_first = time.perf_counter() - t0
     res["merge_s"] = round(merge_first, 3)
     res["merge_backend"] = backend
@@ -301,7 +314,7 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
     if merge_first < 120 and backend != "cpu":
         tm2: dict = {}
         t0 = time.perf_counter()
-        merge_360(clouds, log=lambda m: None, timings=tm2)
+        merge_360(clouds, cfg=mcfg, log=lambda m: None, timings=tm2)
         merge_steady = time.perf_counter() - t0
         res["merge_steady_s"] = round(merge_steady, 3)
         res["merge_compile_s"] = round(max(merge_first - merge_steady, 0.0), 3)
@@ -348,7 +361,8 @@ _PHASE_KEYS = {
     "bitexact": ("bitexact", "bitexact_cost_s", "bitexact_backend"),
     "merge_s": ("merge_s", "merge_steady_s", "merge_compile_s",
                 "merge_backend", "merge_points", "merge_icp_fit_mean",
-                "merge_stage_s", "merge_stage_first_s"),
+                "merge_stage_s", "merge_stage_first_s",
+                "merge_ransac_trials"),
 }
 
 
@@ -467,7 +481,8 @@ def main() -> None:
                   "chamfer_backend", "bitexact", "bitexact_cost_s",
                   "bitexact_backend", "pallas", "views_measured",
                   "merge_points", "merge_icp_fit_mean", "merge_stage_s",
-                  "merge_stage_first_s", "backend_error"):
+                  "merge_stage_first_s", "merge_ransac_trials",
+                  "backend_error"):
             if k in res and res[k] is not None:
                 final[k] = res[k]
         # top-level backend is derived from the per-phase provenance tags —

@@ -72,7 +72,8 @@ _BRUTE_MAX = 65536  # above this, dispatch to the grid-hash engine
 
 def knn(points: jax.Array, valid: jax.Array, k: int,
         block_q: int = 512, block_b: int = 8192,
-        exclude_self: bool = True):
+        exclude_self: bool = True, exact: bool = False,
+        recall_target: float = 0.99):
     """k nearest neighbors among valid points, for every point.
 
     points [N,3] float32 (any N), valid [N] bool. Returns (idx [N,k] int32,
@@ -86,9 +87,15 @@ def knn(points: jax.Array, valid: jax.Array, k: int,
     rings; for sparse outliers beyond that it *overestimates* distances
     (never underestimates) — the safe direction for every consumer
     (outlier filters flag such points harder).
+
+    ``exact=True`` forces the tiled brute path at ANY size (the reference's
+    KDTree is exact; precision-sensitive callers opt out of both large-N
+    approximations — O(N^2) FLOPs, so expect seconds at merge-cloud scale).
+    ``recall_target`` tunes the accelerator approx_min_k selection (per-row
+    recall; misses only ever overestimate the k-th neighbor distance).
     """
     n = points.shape[0]
-    if n <= _BRUTE_MAX:
+    if n <= _BRUTE_MAX or exact:
         return knn_brute(points, valid, k, block_q, block_b, exclude_self)
     if jax.default_backend() != "cpu":
         # accelerators: dense distance rows + the hardware-partial-reduce
@@ -98,7 +105,7 @@ def knn(points: jax.Array, valid: jax.Array, k: int,
         # 2026-07-30), and XLA lowers lax.top_k over the concatenated
         # candidate sets to full sorts that run ~20x slower than this
         # dense pass (27 s vs 1.4 s at 259k points).
-        return knn_dense_approx(points, valid, k, exclude_self)
+        return knn_dense_approx(points, valid, k, exclude_self, recall_target)
     from structured_light_for_3d_model_replication_tpu.ops import grid as gridlib
 
     pts = jnp.asarray(points, jnp.float32)

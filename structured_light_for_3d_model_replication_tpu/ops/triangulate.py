@@ -250,6 +250,11 @@ def triangulate(
             raise ValueError(
                 "bitexact=True requires plane_eval='table' (the NumPy "
                 "reference evaluates stored plane tables)")
+        if isinstance(col_map, jax.core.Tracer):
+            raise ValueError(
+                "bitexact=True cannot run under an enclosing jit/vmap "
+                "trace: the ops would fuse and FMA-contract again, silently"
+                " voiding the bit-exactness contract. Call it eagerly.")
         h, w = col_map.shape
         rays, oc, p_col, p_row = _prep_calib(calib, h, w, np)
         return _triangulate_impl(

@@ -51,6 +51,21 @@ def _cfg(args: argparse.Namespace):
                   f"{cfg.parallel.backend} requested but the "
                   f"{jax.default_backend()} backend was already initialized; "
                   "stages will run on it", file=sys.stderr)
+        else:
+            # only a pin that actually LANDED makes later accelerator
+            # requests impossible — flagging an ineffective one would warn
+            # the opposite of reality
+            _cfg._cpu_pinned = True
+    elif getattr(_cfg, "_cpu_pinned", False):
+        # the pin is process-global and permanent once jax initializes: a
+        # LATER config requesting an accelerator in the same interpreter
+        # (library embedding, multi-command runner) would silently run on
+        # CPU without this warning (ADVICE r3)
+        print("[config] WARNING: parallel.backend="
+              f"{cfg.parallel.backend} requested, but an earlier config in "
+              "this process pinned jax to CPU (numpy/cpu backend); "
+              "accelerator stages will run on CPU. Use a fresh process for "
+              "accelerator work.", file=sys.stderr)
     return cfg
 
 
