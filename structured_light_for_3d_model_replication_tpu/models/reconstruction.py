@@ -366,6 +366,15 @@ def merge_360_posegraph(clouds, cfg: MergeConfig | None = None, log=print,
     return points, colors, transforms
 
 
+@jax.jit
+def _chamfer_nn1_dense_jit(x, y):
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        registration as reglib,
+    )
+
+    return reglib._nn1_brute_jnp(x, y, jnp.ones(y.shape[0], bool))
+
+
 def chamfer_distance(a, b) -> float:
     """Symmetric mean nearest-neighbor distance between clouds [Na,3], [Nb,3].
     The accuracy metric BASELINE.json tracks (Chamfer vs CPU path)."""
@@ -384,8 +393,18 @@ def chamfer_distance(a, b) -> float:
 
         try:
             return 0.5 * (one_way_nn(a, b) + one_way_nn(b, a))
-        except Exception:  # Mosaic compile failure at this shape: grid path
+        except Exception:  # Mosaic compile failure at this shape
             pass
+
+    if jax.default_backend() != "cpu":
+        # accelerator fallback (big clouds or no Mosaic): exact chunked dense
+        # 1-NN on the MXU — the grid engine below is host-only (its bucket
+        # gathers crash the TPU runtime, ops/grid.py module notes)
+        def one_way_dense(x, y):
+            _, d2 = _chamfer_nn1_dense_jit(x, y)
+            return float(jnp.sqrt(jnp.maximum(d2, 0.0)).mean())
+
+        return 0.5 * (one_way_dense(a, b) + one_way_dense(b, a))
 
     def one_way(x, y):
         ext = np.asarray(jnp.max(y, 0) - jnp.min(y, 0), np.float64)

@@ -155,15 +155,21 @@ def _icp_jit(src, src_valid, grid: gridlib.HashGrid, dst_normals, T0,
     return T, fit, rmse
 
 
-def _nn1_brute_jnp(cur, dst_pts, dst_valid, block_q: int = 2048):
+def _nn1_brute_jnp(cur, dst_pts, dst_valid, block_q: int | None = None):
     """Exact 1-NN via dense distance blocks (argmin on-chip). The jnp twin of
     pallas_kernels.nn1 for traced contexts without Mosaic.
 
     Queries are processed in ``block_q`` chunks (lax.map) so peak memory is
     O(block_q * M) instead of O(N * M) — a 20k x 20k cloud pair would
-    otherwise materialize a 1.7 GB matrix per call."""
+    otherwise materialize a 1.7 GB matrix per call. The default chunk
+    shrinks with M (same ~0.5 GB block bound as knn_dense_approx) so a
+    512k-point destination costs 256-row blocks, not a 4 GiB allocation."""
     n = cur.shape[0]
     m = dst_pts.shape[0]
+    if block_q is None:
+        block_q = 2048
+        while block_q > 64 and block_q * m * 4 > (1 << 29):
+            block_q //= 2
     d2_dst = (dst_pts * dst_pts).sum(-1)
 
     def chunk_nn(q):
@@ -250,13 +256,25 @@ def _icp_jit_pallas(src, src_valid, dst_pts, dst_valid, dst_normals, T0,
                      max_dist, iters, "pallas", block)
 
 
+@functools.partial(jax.jit, static_argnames=("iters",))
+def _icp_jit_brute(src, src_valid, dst_pts, dst_valid, dst_normals, T0,
+                   max_dist, iters: int):
+    """ICP with chunked dense-jnp 1-NN: the accelerator fallback when Mosaic
+    is unavailable or fails at this shape — the grid engine is host-only
+    (its bucket gathers crash the TPU runtime, ops/grid.py module notes)."""
+    return _icp_core(src, src_valid, dst_pts, dst_valid, dst_normals, T0,
+                     max_dist, iters, "brute")
+
+
 def icp_point_to_plane(src_pts, src_valid, dst_pts, dst_valid, dst_normals,
                        init_transform=None, max_dist: float = 4.5,
                        iters: int = 30) -> RegistrationResult:
     """Point-to-plane ICP of src onto dst (Open3D TransformationEstimation-
     PointToPlane semantics, processing.py:572-582). Up to ``iters`` Gauss-
-    Newton steps, stopped at Open3D's convergence criteria; nearest
-    neighbors via the Mosaic kernel or the hash grid."""
+    Newton steps, stopped at Open3D's convergence criteria. Correspondence
+    dispatch: the Mosaic nn1 kernel (accelerators, dst <= 131072), chunked
+    dense-jnp 1-NN (accelerators past the gate or on Mosaic failure — the
+    hash grid is host-only), or the hash grid (CPU hosts)."""
     from structured_light_for_3d_model_replication_tpu.ops import (
         pallas_kernels as pk,
     )
@@ -277,7 +295,15 @@ def icp_point_to_plane(src_pts, src_valid, dst_pts, dst_valid, dst_normals,
                 T0, jnp.float32(max_dist), iters, 1024)
             return RegistrationResult(T, fit, rmse)
         except Exception:  # Mosaic compile/VMEM failure at this shape:
-            pass           # fall through to the grid-accelerated path
+            pass           # fall through to the dense / grid path below
+
+    if jax.default_backend() != "cpu":
+        # accelerators never take the grid arm (host-only engine): chunked
+        # dense 1-NN blocks stay exact at bounded memory on the MXU
+        T, fit, rmse = _icp_jit_brute(
+            src, svalid, dst, dvalid, jnp.asarray(dst_normals, jnp.float32),
+            T0, jnp.float32(max_dist), iters)
+        return RegistrationResult(T, fit, rmse)
 
     # cell >= max_dist would guarantee exactness but can explode occupancy;
     # 2 rings at cell=max_dist/2 gives the same guarantee at bounded memory

@@ -74,6 +74,25 @@ def test_grid_dense_cell_shrink():
     assert (rel < 0.01).mean() > 0.999
 
 
+def test_grid_queries_raise_on_accelerator_backends(big_cloud, monkeypatch):
+    # round-3 verdict weak #6: the bucket gathers crash the TPU runtime
+    # (worker fault, not an exception) at merge-cloud shapes — the query
+    # entry points must refuse accelerator backends LOUDLY instead of
+    # letting any input shape take the runtime down
+    import jax
+
+    pts = big_cloud[:4096]  # tiny grid: only the gate is under test
+    valid = np.ones(len(pts), bool)
+    g = gridlib.build_grid(jnp.asarray(pts), jnp.asarray(valid), 4.0)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    with pytest.raises(RuntimeError, match="host-only"):
+        gridlib.grid_knn(g, 8)
+    with pytest.raises(RuntimeError, match="host-only"):
+        gridlib.grid_radius_count(g, 4.0)
+    with pytest.raises(RuntimeError, match="host-only"):
+        gridlib.grid_query_knn(g, jnp.asarray(pts[:64]), 1)
+
+
 def test_knn_dense_approx_matches_exact(big_cloud):
     # the accelerator large-N dispatch (dense rows + approx_min_k); on the
     # CPU test backend approx_min_k is exact, and semantics (masking,
