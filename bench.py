@@ -46,9 +46,18 @@ MERGE_PROJ = (512, 256)
 CPU_FALLBACK_VIEWS = 4      # forced-CPU child measures 4 views, extrapolates
 ROOT = os.path.dirname(os.path.abspath(__file__))
 CACHE = os.path.join(ROOT, ".bench_cache.npz")
-CHILD_TIMEOUT_TPU = 900     # one host core: first-run XLA compiles dominate
+CHILD_TIMEOUT_TPU = 1200    # >= 600 s beyond worst-case (cold compiles ~500 s
+                            # on this one-core host): killing a TPU client
+                            # near its expected runtime is what wedges the
+                            # pool tunnel (observed twice in round 3)
 CHILD_TIMEOUT_CPU = 480
-PARENT_DEADLINE = 1500      # absolute last resort: emit an error line and exit
+# a wedged tunnel recovers on a server-side lease timescale: probe it for a
+# bounded window before degrading (round-3 verdict #2 — the record artifact
+# fell back to CPU twice because one probe at one instant decided the run)
+TPU_RETRY_WINDOW = 1200     # keep probing up to 20 min
+TPU_PROBE_GAP = 60          # pause between probes that fail FAST (a hung
+                            # probe already burns its 180 s timeout)
+PARENT_DEADLINE = 3600      # absolute last resort: emit an error line and exit
 
 
 def log(msg: str) -> None:
@@ -378,6 +387,42 @@ def _fill_missing_phases(dst: dict, src: dict) -> None:
                     dst[k] = src[k]
 
 
+def _wait_for_accelerator(preflight, window: float, gap: float):
+    """Probe the ambient backend until it answers or ``window`` expires.
+
+    A wedged pool tunnel recovers on a server-side lease timescale, so ONE
+    probe at one instant must not decide the record (round-3: two rounds of
+    CPU-fallback artifacts for exactly that reason). A hung probe already
+    burns its own 180 s timeout — only fast failures sleep ``gap`` between
+    tries, and three consecutive IDENTICAL failures end the wait early (a
+    missing/broken plugin fails deterministically every time; only the
+    wedge signature, 'hung', earns the full window).
+    Returns (status, detail, attempts, waited_s).
+    """
+    t0 = time.monotonic()
+    attempts = 0
+    same_fails = 0
+    last_fail = None
+    while True:
+        attempts += 1
+        status, detail = preflight()
+        waited = time.monotonic() - t0
+        log(f"ambient backend preflight #{attempts}: {status} ({detail}) "
+            f"[{waited:.0f}s into the {window:.0f}s retry window]")
+        if status == "ok" or waited >= window:
+            return status, detail, attempts, waited
+        if status == "failed":
+            same_fails = same_fails + 1 if detail == last_fail else 1
+            last_fail = detail
+            if same_fails >= 3:
+                log("deterministic init failure (3 identical) — degrading now")
+                return status, detail, attempts, waited
+            time.sleep(gap)
+        else:
+            same_fails = 0
+            last_fail = None
+
+
 def emit(final: dict) -> None:
     print(json.dumps(final), flush=True)
 
@@ -427,19 +472,26 @@ def main() -> None:
             accelerator_preflight,
         )
 
-        status, detail = accelerator_preflight()
-        log(f"ambient backend preflight: {status} ({detail})")
+        status, detail, attempts, waited = _wait_for_accelerator(
+            accelerator_preflight, TPU_RETRY_WINDOW, TPU_PROBE_GAP)
+        final["tpu_probe_attempts"] = attempts
+        final["tpu_probe_wait_s"] = round(waited, 1)
         if status == "ok":
             res = _run_child([f"--views={N_VIEWS}"], CHILD_TIMEOUT_TPU)
         else:
-            final["error"] = (f"ambient backend hung at init" if status == "hung"
+            final["error"] = (f"ambient backend hung at init "
+                              f"({attempts} probes over "
+                              f"{final['tpu_probe_wait_s']:.0f}s)"
+                              if status == "hung"
                               else f"ambient backend init failed: {detail}")
-            self_rec = os.path.join(ROOT, "BENCH_SELF_r03.json")
-            if os.path.exists(self_rec):
+            import glob as _glob
+
+            recs = sorted(_glob.glob(os.path.join(ROOT, "BENCH_SELF_r*.json")))
+            if recs:
                 # a wedged tunnel is an infrastructure failure, not a code
-                # one — point the record at the last clean first-party TPU
+                # one — point the record at the newest clean first-party TPU
                 # line so the degraded run can't be read as the build's perf
-                final["self_recorded_tpu_run"] = os.path.basename(self_rec)
+                final["self_recorded_tpu_run"] = os.path.basename(recs[-1])
             res = None
         complete = res is not None and res.get("merge_s") is not None
         if not complete:

@@ -1,0 +1,292 @@
+"""Multi-device merged-cloud postprocess: voxel downsample + statistical
+outlier removal sharded over a point-axis device mesh.
+
+The reference's merge tail (server/processing.py:605-629: final voxel
+downsample, then remove_statistical_outlier) is a single-machine Open3D
+call; at multi-chip scale the cloud is sharded and the same semantics are
+built SPMD:
+
+  1. HOST PRE-BUCKETING (``shard_points_by_slab``): points partition into
+     contiguous z-slabs whose boundaries sit on voxel-cell multiples of the
+     GLOBAL grid origin — a voxel cell then never spans two devices, so a
+     purely local packed-key downsample per shard is exactly the global
+     ``ops.pointcloud.voxel_downsample`` (same origin, same keys, same
+     per-cell means).
+  2. LOCAL voxel downsample per shard (single sort over absolute 30-bit
+     packed keys, origin passed in — the same kernel as the single-device
+     packed path).
+  3. HALO EXCHANGE: each shard ppermutes its full (points, valid) buffer to
+     both z-neighbors; a point's k nearest neighbors after voxelization lie
+     within ``halo`` (a few cells), and ``halo <= min slab thickness`` is
+     asserted on the host, so own + prev + next slabs contain every true
+     neighbor of every CERTIFIED row.
+  4. LOCAL mean-kNN distance over the 3*Np candidate set (chunked dense
+     blocks on the MXU), certification = k-th candidate within ``halo``.
+  5. GLOBAL Open3D statistics via psum (sum, sumsq, count of certified
+     rows) -> one mu/sigma threshold applied everywhere.
+
+Certified rows match the single-device ``statistical_outlier_mask`` exactly
+(tests assert set-equality of the kept cloud on the 8-virtual-device CPU
+mesh); a row whose k-th neighbor lies beyond ``halo`` is dropped as an
+outlier (one-sided, same direction as the grid engine's out-of-range rule)
+— on voxelized clouds that only happens to points ``halo``-isolated from
+everything, which the threshold would drop anyway.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax layout
+    from jax.experimental.shard_map import shard_map
+
+from structured_light_for_3d_model_replication_tpu.ops import pointcloud as pc
+
+__all__ = ["shard_points_by_slab", "postprocess_merged_sharded"]
+
+_AXIS = "points"
+# per-shard cap on uncertified rows given the exact global fallback; rows
+# past the cap stay inf (excluded from stats + dropped) — on voxelized
+# clouds uncertified rows are far outliers, far fewer than this
+_BAD_CAP = 512
+
+
+def shard_points_by_slab(points, colors, valid, n_dev: int, cell: float):
+    """Partition a merged cloud into z-slabs aligned to the global voxel grid.
+
+    Returns (pts [D,Np,3] f32, cols [D,Np,3] u8, valid [D,Np] bool,
+    origin [3] f32, min_slab_z f32 — the thinnest slab's z extent, the upper
+    bound for a sound ``halo``). Np is the max bucket size padded to 256.
+    """
+    pts = np.asarray(points, np.float32)
+    cols = (np.asarray(colors, np.uint8) if colors is not None
+            else np.zeros_like(pts, dtype=np.uint8))
+    v = (np.asarray(valid, bool) if valid is not None
+         else np.ones(len(pts), bool))
+    if not v.any():
+        raise ValueError("shard_points_by_slab: empty cloud")
+    cell = np.float32(cell)
+    origin = pts[v].min(axis=0)  # identical to voxel_downsample's origin
+    ext = pts[v].max(axis=0) - origin
+    if np.any(np.floor(ext / cell) >= 1023):
+        # the absolute 30-bit packed key caps the grid at 1023 cells/axis;
+        # clipping would silently merge distinct voxels (and break the
+        # slab-alignment premise along z) — the single-device path
+        # dispatches to a lexsort kernel here instead
+        raise ValueError(
+            f"cloud spans {np.floor(ext / cell).astype(int)} voxel cells — "
+            f"the sharded postprocess's packed keys cap at 1023 per axis; "
+            f"raise final_voxel (or crop far outliers first)")
+    zc = np.floor((pts[:, 2] - origin[2]) / cell).astype(np.int64)
+    zc = np.where(v, zc, 0)
+    z_hi = int(zc[v].max()) + 1
+    # contiguous cell-index ranges, one per device (aligned: boundaries are
+    # whole cells, so no voxel spans two shards)
+    bounds = [round(i * z_hi / n_dev) for i in range(n_dev + 1)]
+    if any(bounds[i + 1] == bounds[i] for i in range(n_dev)):
+        raise ValueError(
+            f"cloud spans only {z_hi} voxel cells in z — too thin to slab "
+            f"over {n_dev} devices (an empty slab would break the +-1-slab "
+            f"halo soundness); use fewer devices or a smaller cell")
+    shard_of = np.searchsorted(np.asarray(bounds[1:]), zc, side="right")
+    shard_of = np.minimum(shard_of, n_dev - 1)
+    counts = np.bincount(shard_of[v], minlength=n_dev)
+    n_p = int(-(-max(int(counts.max()), 1) // 256) * 256)
+    pts_sh = np.full((n_dev, n_p, 3), 1e9, np.float32)
+    cols_sh = np.zeros((n_dev, n_p, 3), np.uint8)
+    valid_sh = np.zeros((n_dev, n_p), bool)
+    for d in range(n_dev):
+        sel = v & (shard_of == d)
+        k = int(sel.sum())
+        pts_sh[d, :k] = pts[sel]
+        cols_sh[d, :k] = cols[sel]
+        valid_sh[d, :k] = True
+    min_slab_z = float(cell) * min(
+        (bounds[i + 1] - bounds[i]) for i in range(n_dev))
+    return pts_sh, cols_sh, valid_sh, origin.astype(np.float32), min_slab_z
+
+
+@jax.jit
+def _voxel_packed_origin(points, colors, valid, vs, origin):
+    """The packed single-sort voxel downsample with an EXTERNAL grid origin
+    (absolute keys shared across shards — pc._voxel_downsample_packed
+    computes the origin from its own input, which per-shard would shift
+    every shard onto a different grid)."""
+    ijk = jnp.clip(jnp.floor((points - origin) / vs).astype(jnp.int32),
+                   0, 1023)
+    key = (ijk[:, 0] << 20) | (ijk[:, 1] << 10) | ijk[:, 2]
+    key = jnp.where(valid, key, jnp.int32(1 << 30))
+    order = jnp.argsort(key)
+    k_s = key[order]
+    newgrp = jnp.concatenate([jnp.ones(1, bool), k_s[1:] != k_s[:-1]])
+    seg = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
+    return pc._voxel_group_reduce(seg, valid[order], points[order],
+                                  colors[order].astype(jnp.float32),
+                                  points.shape[0])
+
+
+def postprocess_merged_sharded(mesh_or_devices, points, colors, valid,
+                               final_voxel: float, outlier_nb: int = 20,
+                               outlier_std: float = 2.0,
+                               halo: float | None = None):
+    """Sharded final voxel + statistical outlier pass over a merged cloud.
+
+    ``mesh_or_devices``: a 1D Mesh, a device list, or an int (first N
+    jax.devices()). Input arrays are HOST arrays (the merged cloud);
+    returns (points [M,3] f32, colors [M,3] u8) gathered and compacted.
+    """
+    if isinstance(mesh_or_devices, Mesh):
+        devices = list(mesh_or_devices.devices.reshape(-1))
+    elif isinstance(mesh_or_devices, int):
+        devices = jax.devices()[:mesh_or_devices]
+    else:
+        devices = list(mesh_or_devices)
+    n_dev = len(devices)
+    mesh = Mesh(np.asarray(devices), (_AXIS,))
+
+    cell = float(final_voxel)
+    pts_sh, cols_sh, valid_sh, origin, min_slab_z = shard_points_by_slab(
+        points, colors, valid, n_dev, cell)
+    if halo is None:
+        # post-voxel spacing ~ cell: the k-th neighbor of any interior point
+        # sits within a few cells; 8 covers nb=20 with headroom
+        halo = 8.0 * cell
+    if n_dev > 1 and halo > min_slab_z:
+        halo = min_slab_z  # soundness bound: neighbors beyond +-1 slab
+                           # would be invisible to the halo exchange
+    out = _postprocess_sharded_jit(mesh, pts_sh, cols_sh, valid_sh,
+                                   jnp.float32(cell),
+                                   jnp.asarray(origin),
+                                   jnp.float32(halo),
+                                   jnp.float32(outlier_std),
+                                   outlier_nb, n_dev)
+    p, c, keep = (np.asarray(x) for x in out)
+    keep = keep.reshape(-1)
+    return p.reshape(-1, 3)[keep], c.reshape(-1, 3)[keep]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "n_dev"))
+def _postprocess_sharded_jit(mesh, pts, cols, vld, cell, origin, halo,
+                             std_ratio, k: int, n_dev: int):
+    spec = P(_AXIS)
+
+    def local(p_s, c_s, v_s):
+        p = p_s[0]
+        c = c_s[0]
+        v = v_s[0]
+        # stage 1: local voxel downsample on the GLOBAL grid
+        pv, cv, vv = _voxel_packed_origin(p, c, v, cell, origin)
+
+        # stage 2: full-buffer halo exchange with both z-neighbors
+        # (ppermute fills missing links with zeros -> valid=False)
+        def from_prev(x):
+            return jax.lax.ppermute(
+                x, _AXIS, [(i, i + 1) for i in range(n_dev - 1)])
+
+        def from_next(x):
+            return jax.lax.ppermute(
+                x, _AXIS, [(i + 1, i) for i in range(n_dev - 1)])
+
+        if n_dev > 1:
+            cand_p = jnp.concatenate([pv, from_prev(pv), from_next(pv)])
+            cand_v = jnp.concatenate([vv, from_prev(vv), from_next(vv)])
+        else:
+            cand_p, cand_v = pv, vv
+        cand_p = jnp.where(cand_v[:, None], cand_p, 1e9)
+
+        # stage 3: chunked dense mean-kNN distance with certification; the
+        # chunk shrinks with the candidate count so each [chunk, 3*Np] d2
+        # block stays under ~0.5 GB (the same bound as knn_dense_approx)
+        b2 = (cand_p * cand_p).sum(-1)
+        n_own = pv.shape[0]
+        chunk = min(2048, n_own)
+        while chunk > 64 and chunk * cand_p.shape[0] * 4 > (1 << 29):
+            chunk //= 2
+        n_pad = -(-n_own // chunk) * chunk
+        qp = jnp.concatenate(
+            [pv, jnp.full((n_pad - n_own, 3), 1e9, jnp.float32)]
+        ) if n_pad > n_own else pv
+
+        def one_chunk(q):
+            d2 = ((q * q).sum(-1)[:, None] + b2[None, :]
+                  - 2.0 * jnp.matmul(q, cand_p.T,
+                                     precision=jax.lax.Precision.HIGHEST))
+            d2 = jnp.where(cand_v[None, :], d2, jnp.inf)
+            d2 = jnp.where(d2 <= 1e-9, jnp.inf, d2)  # self (centroids differ)
+            negk, _ = jax.lax.top_k(-d2, k)
+            kd2 = jnp.maximum(-negk, 0.0)
+            md = jnp.sqrt(kd2).mean(axis=1)
+            certified = kd2[:, -1] <= halo * halo
+            return jnp.where(certified, md, jnp.inf)
+
+        md = jax.lax.map(one_chunk, qp.reshape(-1, chunk, 3)
+                         ).reshape(-1)[:n_own]
+
+        # stage 3b: exact GLOBAL fallback for uncertified rows. Open3D's
+        # statistics include the huge mean distances of far outliers —
+        # censoring them as inf would inflate-proof sigma and systematically
+        # tighten the threshold (the same trap the single-device voxelized
+        # probe documents). The few uncertified rows (far outliers, halo-
+        # isolated points) are all_gathered, scored against every shard's
+        # candidates, and their true k-th distances merged per row.
+        bad = vv & ~jnp.isfinite(md)
+        bad_rank = jnp.cumsum(bad.astype(jnp.int32)) - 1
+        in_buf = bad & (bad_rank < _BAD_CAP)
+        slot = jnp.where(in_buf, bad_rank, _BAD_CAP)
+        qbuf = jnp.full((_BAD_CAP + 1, 3), 1e9, jnp.float32
+                        ).at[slot].set(pv, mode="drop")[:_BAD_CAP]
+        qall = jax.lax.all_gather(qbuf, _AXIS).reshape(-1, 3)  # [D*CAP, 3]
+        own_p = jnp.where(vv[:, None], pv, 1e9)
+        own_b2 = (own_p * own_p).sum(-1)
+
+        def bad_chunk(qc):
+            d2g = ((qc * qc).sum(-1)[:, None] + own_b2[None]
+                   - 2.0 * jnp.matmul(qc, own_p.T,
+                                      precision=jax.lax.Precision.HIGHEST))
+            d2g = jnp.where(vv[None, :], d2g, jnp.inf)
+            d2g = jnp.where(d2g <= 1e-9, jnp.inf, d2g)  # self / padding
+            return jax.lax.top_k(-d2g, k)[0]
+
+        # same ~0.5 GB block bound for the [rows, Np] fallback matrix
+        bchunk = qall.shape[0]
+        while bchunk > 64 and bchunk * n_own * 4 > (1 << 29):
+            bchunk //= 2
+        bpad = -(-qall.shape[0] // bchunk) * bchunk
+        qall_p = jnp.concatenate(
+            [qall, jnp.full((bpad - qall.shape[0], 3), 1e9, jnp.float32)]
+        ) if bpad > qall.shape[0] else qall
+        negk_l = jax.lax.map(bad_chunk, qall_p.reshape(-1, bchunk, 3)
+                             ).reshape(bpad, k)[:qall.shape[0]]
+        kd_all = jax.lax.all_gather(-negk_l, _AXIS)    # [D, D*CAP, k]
+        comb = jnp.moveaxis(kd_all, 0, 1).reshape(qall.shape[0],
+                                                  n_dev * k)
+        negk_g, _ = jax.lax.top_k(-comb, k)
+        md_g = jnp.sqrt(jnp.maximum(-negk_g, 0.0)).mean(axis=1)  # [D*CAP]
+        mine = jax.lax.dynamic_slice(
+            md_g, (jax.lax.axis_index(_AXIS) * _BAD_CAP,), (_BAD_CAP,))
+        md = jnp.where(in_buf, mine[jnp.clip(bad_rank, 0, _BAD_CAP - 1)], md)
+
+        # stage 4: GLOBAL Open3D statistics (psum over the mesh)
+        ok = vv & jnp.isfinite(md)
+        m = jnp.where(ok, md, 0.0)
+        cnt = jnp.maximum(
+            jax.lax.psum(ok.sum().astype(jnp.float32), _AXIS), 1.0)
+        mu = jax.lax.psum(m.sum(), _AXIS) / cnt
+        # two-pass variance, the same formulation as _stat_outlier_from_knn
+        # (sum-of-squares minus mu^2 cancels catastrophically in f32 and
+        # would shift threshold ties vs the single-device path)
+        var = jax.lax.psum(
+            jnp.where(ok, (md - mu) ** 2, 0.0).sum(), _AXIS) / cnt
+        thresh = mu + std_ratio * jnp.sqrt(var)
+        keep = ok & (md <= thresh)
+        return pv[None], cv[None], keep[None]
+
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=(spec, spec, spec))
+    return fn(pts, cols, vld)

@@ -130,7 +130,8 @@ def _psum(x):
 def poisson_solve_sharded(points, normals, valid=None, depth: int = 10,
                           devices=None, cg_iters: int = 350,
                           screen: float = 4.0,
-                          margin: float = 0.08) -> PoissonResult:
+                          margin: float = 0.08,
+                          compile_only: bool = False) -> PoissonResult | None:
     """Screened grid Poisson across a device mesh. Same contract as
     ops/poisson.poisson_solve; chi/density come back sharded on axis 0
     (np.asarray gathers them for extraction).
@@ -139,6 +140,13 @@ def poisson_solve_sharded(points, normals, valid=None, depth: int = 10,
     is bounded by aggregate HBM: D devices fit depth d when each [2^d / D,
     2^d, 2^d] fp32 slab times ~6 CG arrays fits one chip (depth 10 on 8 x
     v5e comfortably).
+
+    ``compile_only``: lower + compile the sharded program from
+    ShapeDtypeStructs and return None without allocating grid buffers or
+    running — how the multichip dryrun proves the beyond-single-chip depth
+    (a 1024^3 CG sweep is minutes of wall on virtual CPU devices, but its
+    COMPILATION — shardings, halo collectives, layouts — is checkable
+    anywhere).
     """
     if depth > 16:
         raise ValueError(f"depth {depth} > 16 (the reference's own guard: "
@@ -206,6 +214,13 @@ def poisson_solve_sharded(points, normals, valid=None, depth: int = 10,
         (chi, _, _, _), _ = jax.lax.scan(cg_step, state0, None,
                                          length=cg_iters)
         return chi, density
+
+    if compile_only:
+        n = points.shape[0]
+        s = jax.ShapeDtypeStruct
+        jax.jit(solve).lower(s((n, 3), jnp.float32), s((n, 3), jnp.float32),
+                             s((n,), jnp.float32)).compile()
+        return None
 
     w = valid.astype(jnp.float32)
     chi, density = solve(points, normals, w)

@@ -174,6 +174,25 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
                         "this directory for the web viewer")
     add_config_args(p)
 
+    p = sub.add_parser(
+        "warmup",
+        help="pre-compile the flagship decode + merge programs into the "
+             "persistent XLA cache (a fresh machine otherwise pays ~35 s "
+             "of compiles on its first reconstruction/merge)")
+    p.add_argument("--cam", default="1920x1080", help="camera WxH to warm")
+    p.add_argument("--proj", default="1920x1080", help="projector WxH to warm")
+    p.add_argument("--views", type=int, default=24,
+                   help="batched view count for the forward_views program")
+    p.add_argument("--merge-views", type=int, default=24,
+                   help="turntable views for the merge-chain programs "
+                        "(0 skips the merge warm)")
+    p.add_argument("--merge-cam", default="480x360")
+    p.add_argument("--merge-proj", default="512x256")
+    p.add_argument("--cache-dir", default=".jax_cache",
+                   help="persistent compilation cache directory (shared "
+                        "with bench.py when run from the repo root)")
+    add_config_args(p)
+
     p = sub.add_parser("synth",
                        help="render a synthetic turntable scan dataset")
     p.add_argument("output_root")
@@ -432,6 +451,108 @@ def _cmd_auto_scan(args) -> int:
         if hasattr(turntable, "close"):
             turntable.close()
     return 0 if result.view_dirs else 1
+
+
+@_runner("warmup")
+def _cmd_warmup(args) -> int:
+    """Compile the flagship programs once into the persistent XLA cache.
+
+    A fresh checkout on a fresh machine pays the full compile bill on its
+    first real scan (measured +31.8 s on the merge chain alone, round-3
+    bench) — this runs the same shapes on synthetic content so every later
+    process (bench.py, reconstruct, merge-360) hits warm executables.
+    """
+    import time
+
+    import numpy as np
+
+    cfg = _cfg(args)  # honor backend pin/overrides before jax initializes
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(args.cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # older jax without the knob
+        print(f"[warmup] persistent cache unavailable ({e})", file=sys.stderr)
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.models.scanner import (
+        SLScanner,
+    )
+    from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        synthetic as syn,
+    )
+
+    def wh(s):
+        w, h = s.lower().split("x")
+        return int(w), int(h)
+
+    cam, proj = wh(args.cam), wh(args.proj)
+    print(f"[warmup] backend={jax.default_backend()} "
+          f"cache={os.path.abspath(args.cache_dir)}")
+
+    # decode+triangulate: the pattern stack itself is a decodable capture
+    # (content is irrelevant to compilation; shapes + config are the key)
+    base = gc.generate_pattern_stack(proj[0], proj[1])
+    yi = (np.arange(cam[1]) * proj[1]) // cam[1]
+    xi = (np.arange(cam[0]) * proj[0]) // cam[0]
+    frames = base[:, yi[:, None], xi[None, :]]
+    rig = syn.default_rig(cam_size=cam, proj_size=proj)
+    for plane_eval in ("quadratic", "table"):
+        sc = SLScanner(rig.calibration(), cam, proj, row_mode=1,
+                       plane_eval=plane_eval)
+        t0 = time.perf_counter()
+        jax.block_until_ready(sc.forward(jnp.asarray(frames),
+                                         thresh_mode="manual").points)
+        print(f"[warmup] forward[{plane_eval}] {cam[0]}x{cam[1]}: "
+              f"{time.perf_counter() - t0:.1f}s")
+    if args.views > 1:
+        stack = jnp.stack([jnp.roll(jnp.asarray(frames), 7 * i, axis=2)
+                           for i in range(args.views)])
+        t0 = time.perf_counter()
+        jax.block_until_ready(
+            sc.forward_views(stack, thresh_mode="manual").points)
+        print(f"[warmup] forward_views[{args.views}]: "
+              f"{time.perf_counter() - t0:.1f}s")
+
+    if args.merge_views > 0:
+        from structured_light_for_3d_model_replication_tpu.models.reconstruction import (
+            merge_360,
+        )
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            triangulate as tri,
+        )
+
+        mcam, mproj = wh(args.merge_cam), wh(args.merge_proj)
+        mrig = syn.default_rig(cam_size=mcam, proj_size=mproj)
+        scene = syn.Scene([
+            syn.Sphere(np.array([0.0, 0.0, 420.0]), 70.0),
+            syn.Sphere(np.array([55.0, -40.0, 360.0]), 28.0),
+            syn.Sphere(np.array([-48.0, 35.0, 370.0]), 22.0),
+        ])
+        t0 = time.perf_counter()
+        clouds = []
+        for R, t in syn.turntable_poses(args.merge_views,
+                                        360.0 / args.merge_views,
+                                        pivot=np.array([0.0, 0.0, 400.0])):
+            vf, _ = syn.render_scene(mrig, scene.transformed(R, t))
+            dec = gc.decode_stack_np(vf, n_cols=mproj[0], n_rows=mproj[1],
+                                     thresh_mode="manual")
+            cloud = tri.triangulate_np(dec.col_map, dec.row_map, dec.mask,
+                                       dec.texture, mrig.calibration(),
+                                       row_mode=1)
+            p, c = tri.compact_cloud(cloud)
+            clouds.append((p.astype(np.float32), c.astype(np.uint8)))
+        print(f"[warmup] rendered {args.merge_views} merge views "
+              f"({time.perf_counter() - t0:.1f}s, host)")
+        t0 = time.perf_counter()
+        merge_360(clouds, cfg=cfg.merge, log=lambda m: None)
+        print(f"[warmup] merge chain: {time.perf_counter() - t0:.1f}s")
+    print("[warmup] done — subsequent processes reuse these executables "
+          "via the persistent cache")
+    return 0
 
 
 @_runner("synth")

@@ -140,6 +140,19 @@ def test_reconstruct_numpy_backend_matches_jax(dataset, tmp_path):
     np.testing.assert_allclose(pa, pb, atol=2e-2)
 
 
+def test_warmup_populates_persistent_cache(tmp_path, capsys):
+    cache = str(tmp_path / "warm_cache")
+    rc = cli_main(["warmup", "--cam", "96x64", "--proj", "64x32",
+                   "--views", "2", "--merge-views", "3",
+                   "--merge-cam", "96x64", "--merge-proj", "64x32",
+                   "--cache-dir", cache])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "merge chain" in out and "done" in out
+    # the persistent cache actually received executables
+    assert os.path.isdir(cache) and len(os.listdir(cache)) > 0
+
+
 def test_clean_chain_aborts_when_all_points_removed(tmp_path):
     # a sparse cloud under the reference's density-tuned DBSCAN defaults
     # (eps=5, min_points=200) legitimately clusters to nothing; the chain

@@ -1,0 +1,85 @@
+"""Sharded merged-cloud postprocess vs the single-device path (8-virtual-
+device CPU mesh from conftest)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.ops import (
+    pointcloud as pc,
+    pointcloud_sharded as pcs,
+)
+
+
+def _reference_postprocess(cloud, cols, voxel, nb, std):
+    valid = np.ones(len(cloud), bool)
+    p, c, v = pc.voxel_downsample(jnp.asarray(cloud), jnp.asarray(cols),
+                                  jnp.asarray(valid), voxel)
+    keep = np.asarray(v)
+    p = np.asarray(p)[keep]
+    c = np.asarray(c)[keep]
+    m = np.asarray(pc.statistical_outlier_mask(
+        jnp.asarray(p), jnp.ones(len(p), bool), nb, std))
+    return p[m], c[m]
+
+
+def _as_set(p):
+    return {tuple(np.round(row, 4)) for row in p}
+
+
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_sharded_postprocess_matches_single_device(rng, n_dev):
+    n = 40_000
+    cloud = rng.uniform(0, 80, (n, 3)).astype(np.float32)
+    far = rng.uniform(200, 260, (60, 3)).astype(np.float32)
+    cloud = np.concatenate([cloud, far])
+    cols = rng.integers(0, 256, (len(cloud), 3)).astype(np.uint8)
+
+    p_ref, c_ref = _reference_postprocess(cloud, cols, 2.0, 20, 2.0)
+    p_sh, c_sh = pcs.postprocess_merged_sharded(
+        n_dev, cloud, cols, None, final_voxel=2.0,
+        outlier_nb=20, outlier_std=2.0)
+
+    # same SET of kept points (shard order differs); tolerate a couple of
+    # f32 reduction-order threshold ties
+    sa, sb = _as_set(p_ref), _as_set(p_sh)
+    assert len(sa ^ sb) <= 4, (len(sa), len(sb), len(sa ^ sb))
+    # colors travel with their points
+    assert len(p_sh) == len(c_sh)
+
+
+def test_sharded_postprocess_drops_far_outliers(rng):
+    base = rng.uniform(0, 60, (20_000, 3)).astype(np.float32)
+    far = rng.uniform(400, 500, (25, 3)).astype(np.float32)
+    cloud = np.concatenate([base, far])
+    p_sh, _ = pcs.postprocess_merged_sharded(
+        4, cloud, None, None, final_voxel=2.0)
+    assert p_sh[:, 0].max() < 300.0  # every far outlier removed
+
+
+def test_slab_partition_rejects_too_thin_clouds(rng):
+    flat = rng.uniform(0, 10, (1000, 3)).astype(np.float32)
+    flat[:, 2] = 0.0  # one z-cell
+    with pytest.raises(ValueError, match="too thin"):
+        pcs.shard_points_by_slab(flat, None, None, 8, 5.0)
+
+
+def test_slab_partition_rejects_oversize_grids(rng):
+    # >1023 cells/axis would overflow the packed 30-bit keys and silently
+    # merge distinct voxels (review repro: 4685-point divergence) — raise
+    wide = rng.uniform(0, 50, (2000, 3)).astype(np.float32)
+    wide[0, 0] = 2000.0  # stretch x to 2000 cells at cell=1
+    with pytest.raises(ValueError, match="1023"):
+        pcs.shard_points_by_slab(wide, None, None, 4, 1.0)
+
+
+def test_slab_partition_alignment(rng):
+    # every voxel cell's occupants land on ONE shard (the exactness premise)
+    cloud = rng.uniform(0, 50, (5000, 3)).astype(np.float32)
+    pts_sh, _, valid_sh, origin, _ = pcs.shard_points_by_slab(
+        cloud, None, None, 4, 2.0)
+    cell_shard = {}
+    for d in range(4):
+        pts = pts_sh[d][valid_sh[d]]
+        for zc in np.unique(np.floor((pts[:, 2] - origin[2]) / 2.0)):
+            assert cell_shard.setdefault(zc, d) == d

@@ -43,6 +43,16 @@ def test_sharded_extracts_sphere(rng):
     assert abs(np.median(r) - 50.0) < 2.5
 
 
+def test_compile_only_depth10_builds_without_buffers(rng):
+    # the multichip dryrun's beyond-single-chip proof: the 1024^3 sharded
+    # program (shardings, halo ppermutes, layouts) compiles from
+    # ShapeDtypeStructs without allocating any grid buffer or running CG
+    pts, nrm = _sphere(rng, n=200)
+    out = poisson_sharded.poisson_solve_sharded(pts, nrm, depth=10,
+                                                compile_only=True)
+    assert out is None
+
+
 def test_sharded_rejects_bad_device_split(rng):
     pts, nrm = _sphere(rng, n=500)
     # 2^5 = 32 divides 8 devices fine; a 3-device slice does not
