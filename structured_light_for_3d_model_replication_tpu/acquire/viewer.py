@@ -18,6 +18,13 @@ Endpoints
   GET /api/list      JSON: artifacts ({name, bytes, mtime, kind}) + progress
   GET /api/file?name=X  raw bytes of one artifact (PLY/STL only, no traversal)
   GET /api/progress  JSON: the live stage-progress feed (auto-scan parity)
+  GET /api/poses     JSON: pending calibration pose review (per-pose
+                     reprojection errors), when one is active
+  POST /api/poses    {"keep": [names]} — the operator's pose selection;
+                     the reference's click-to-prune dialog
+                     (server/gui.py:1211-1250) as a non-modal web flow:
+                     ``sl3d calibrate --review`` publishes the errors here
+                     and waits for this POST before the final solve
 """
 from __future__ import annotations
 
@@ -33,6 +40,51 @@ import numpy as np
 __all__ = ["ViewerServer", "StageRecorder"]
 
 _EXTS = (".ply", ".stl", ".png")
+POSE_REVIEW_FILE = "pose_review.json"       # published by calibrate --review
+POSE_SELECTION_FILE = "pose_selection.json"  # written back by the operator
+
+
+def publish_pose_review(artifact_dir: str, errors: dict) -> str:
+    """Publish per-pose (cam_px, proj_px) reprojection errors for the
+    viewer's review panel; clears any stale selection. Returns the path."""
+    os.makedirs(artifact_dir, exist_ok=True)
+    sel = os.path.join(artifact_dir, POSE_SELECTION_FILE)
+    if os.path.exists(sel):
+        os.remove(sel)
+    path = os.path.join(artifact_dir, POSE_REVIEW_FILE)
+    payload = {"status": "pending",
+               "poses": {name: {"cam_px": round(float(ec), 3),
+                                "proj_px": round(float(ep), 3)}
+                         for name, (ec, ep) in errors.items()}}
+    with open(path + ".tmp", "w") as f:
+        json.dump(payload, f)
+    os.replace(path + ".tmp", path)
+    return path
+
+
+def await_pose_selection(artifact_dir: str, timeout: float = 600.0,
+                         poll: float = 0.5) -> list[str] | None:
+    """Block until the operator POSTs a selection (or ``timeout``); returns
+    the kept pose names, or None on timeout. Consumes the selection file
+    and marks the review done."""
+    sel = os.path.join(artifact_dir, POSE_SELECTION_FILE)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(sel):
+            with open(sel) as f:
+                keep = json.load(f).get("keep", [])
+            review = os.path.join(artifact_dir, POSE_REVIEW_FILE)
+            if os.path.exists(review):
+                os.remove(review)
+            return [str(k) for k in keep]
+        time.sleep(poll)
+    # timed out: clear the review too — a pending panel that nothing will
+    # ever consume would keep soliciting (and falsely acknowledging)
+    # selections after calibration already finished with auto pruning
+    review = os.path.join(artifact_dir, POSE_REVIEW_FILE)
+    if os.path.exists(review):
+        os.remove(review)
+    return None
 
 
 class StageRecorder:
@@ -158,6 +210,13 @@ class _ViewerHandler(BaseHTTPRequestHandler):
                     self._bytes(f.read(), "application/json")
             else:
                 self._json([])
+        elif url.path == "/api/poses":
+            p = os.path.join(self.root, POSE_REVIEW_FILE)
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    self._bytes(f.read(), "application/json")
+            else:
+                self._json({"status": "none", "poses": {}})
         elif url.path == "/api/file":
             name = parse_qs(url.query).get("name", [""])[0]
             # no traversal: basename only, known extensions only
@@ -175,6 +234,29 @@ class _ViewerHandler(BaseHTTPRequestHandler):
                 self._bytes(f.read(), ctype)
         else:
             self._json({"error": "unknown endpoint"}, 404)
+
+    def do_POST(self):  # noqa: N802 (stdlib handler contract)
+        url = urlparse(self.path)
+        if url.path != "/api/poses":
+            self._json({"error": "unknown endpoint"}, 404)
+            return
+        if not os.path.exists(os.path.join(self.root, POSE_REVIEW_FILE)):
+            self._json({"error": "no pose review pending"}, 409)
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            keep = body["keep"]
+            assert isinstance(keep, list)
+        except Exception:
+            self._json({"error": "body must be JSON {\"keep\": [names]}"}, 400)
+            return
+        sel = os.path.join(self.root, POSE_SELECTION_FILE)
+        with open(sel + ".tmp", "w") as f:
+            json.dump({"keep": [str(k) for k in keep],
+                       "t": time.time()}, f)
+        os.replace(sel + ".tmp", sel)
+        self._json({"ok": True, "kept": len(keep)})
 
 
 class ViewerServer:
@@ -227,6 +309,14 @@ _PAGE = r"""<!DOCTYPE html>
  <select id="sel"></select>
  <button id="reload">refresh</button>
  <span id="info">pick an artifact</span>
+</div>
+<div id="poses" style="display:none;padding:8px 12px;background:#171b22">
+ <b>Calibration pose review</b>
+ <span style="opacity:.7">— untick bad poses, then apply
+ (&lt;0.5 px EXCELLENT, &lt;1.0 px GOOD, else POOR)</span>
+ <table id="posetab" style="border-collapse:collapse;margin:6px 0"></table>
+ <button id="poseapply">Apply selection</button>
+ <span id="posemsg"></span>
 </div>
 <canvas id="cv"></canvas>
 <script>
@@ -389,6 +479,43 @@ async function poll(){
   }catch(e){}
   setTimeout(poll,2000);
 }
-fit();list();poll();
+
+// calibration pose review: per-pose reprojection errors + prune
+// (server/gui.py:1211-1250's dialog, non-modal)
+const poseBox=document.getElementById('poses'), poseTab=document.getElementById('posetab');
+function band(e){return e<0.5?['EXCELLENT','#30a46c']:e<1.0?['GOOD','#ad8b00']:['POOR','#e5484d'];}
+async function pollPoses(){
+  try{
+    const j=await (await fetch('api/poses')).json();
+    if(j.status==='pending'){
+      if(!poseBox.dataset.shown){
+        poseBox.dataset.shown='1'; poseBox.style.display='block';
+        poseTab.innerHTML='<tr><th></th><th style="text-align:left">pose</th>'+
+          '<th>cam px</th><th>proj px</th><th>quality</th></tr>';
+        for(const [name,e] of Object.entries(j.poses).sort()){
+          const [q,c]=band(Math.max(e.cam_px,e.proj_px));
+          const tr=document.createElement('tr');
+          tr.innerHTML=`<td><input type="checkbox" data-pose="${name}" `+
+            `${q==='POOR'?'':'checked'}></td><td>${name}</td>`+
+            `<td style="text-align:right">${e.cam_px.toFixed(2)}</td>`+
+            `<td style="text-align:right">${e.proj_px.toFixed(2)}</td>`+
+            `<td style="color:${c}">${q}</td>`;
+          poseTab.appendChild(tr);
+        }
+      }
+    } else if(poseBox.dataset.shown){
+      poseBox.style.display='none'; delete poseBox.dataset.shown;
+    }
+  }catch(e){}
+  setTimeout(pollPoses,2000);
+}
+document.getElementById('poseapply').onclick=async()=>{
+  const keep=[...poseTab.querySelectorAll('input:checked')].map(i=>i.dataset.pose);
+  const r=await fetch('api/poses',{method:'POST',
+    headers:{'Content-Type':'application/json'},body:JSON.stringify({keep})});
+  document.getElementById('posemsg').textContent=
+    r.ok?`kept ${keep.length} poses — calibration resuming`:'apply failed';
+};
+fit();list();poll();pollPoses();
 </script></body></html>
 """

@@ -158,15 +158,31 @@ def postprocess_merged_sharded(mesh_or_devices, points, colors, valid,
         # sits within a few cells; 8 covers nb=20 with headroom
         halo = 8.0 * cell
     if n_dev > 1 and halo > min_slab_z:
-        halo = min_slab_z  # soundness bound: neighbors beyond +-1 slab
-                           # would be invisible to the halo exchange
+        # soundness needs halo <= slab thickness (neighbors beyond +-1 slab
+        # are invisible to the exchange). Clamping is harmless while the
+        # clamped halo still covers the certification radius (~4 cells for
+        # nb<=30 at voxel pitch); below that, interior rows mass-uncertify
+        # and the result would silently diverge — refuse loudly instead.
+        if min_slab_z < 4.0 * cell:
+            raise ValueError(
+                f"slab thickness {min_slab_z:.1f} < certification radius "
+                f"{4.0 * cell:.1f} (4 cells): too many devices for this "
+                f"cloud's z extent — use fewer devices or a smaller "
+                f"final_voxel")
+        halo = min_slab_z
     out = _postprocess_sharded_jit(mesh, pts_sh, cols_sh, valid_sh,
                                    jnp.float32(cell),
                                    jnp.asarray(origin),
                                    jnp.float32(halo),
                                    jnp.float32(outlier_std),
                                    outlier_nb, n_dev)
-    p, c, keep = (np.asarray(x) for x in out)
+    p, c, keep, n_overflow = (np.asarray(x) for x in out)
+    if int(n_overflow.max()) > 0:
+        raise RuntimeError(
+            f"{int(n_overflow.max())} uncertified rows exceeded the "
+            f"per-shard exact-fallback cap ({_BAD_CAP}) — the result would "
+            f"silently drop valid points. A larger halo, larger "
+            f"final_voxel, or fewer devices reduces uncertified rows.")
     keep = keep.reshape(-1)
     return p.reshape(-1, 3)[keep], c.reshape(-1, 3)[keep]
 
@@ -237,6 +253,7 @@ def _postprocess_sharded_jit(mesh, pts, cols, vld, cell, origin, halo,
         # candidates, and their true k-th distances merged per row.
         bad = vv & ~jnp.isfinite(md)
         bad_rank = jnp.cumsum(bad.astype(jnp.int32)) - 1
+        n_overflow = jnp.maximum(bad.sum() - _BAD_CAP, 0)  # host raises
         in_buf = bad & (bad_rank < _BAD_CAP)
         slot = jnp.where(in_buf, bad_rank, _BAD_CAP)
         qbuf = jnp.full((_BAD_CAP + 1, 3), 1e9, jnp.float32
@@ -285,8 +302,8 @@ def _postprocess_sharded_jit(mesh, pts, cols, vld, cell, origin, halo,
             jnp.where(ok, (md - mu) ** 2, 0.0).sum(), _AXIS) / cnt
         thresh = mu + std_ratio * jnp.sqrt(var)
         keep = ok & (md <= thresh)
-        return pv[None], cv[None], keep[None]
+        return pv[None], cv[None], keep[None], n_overflow[None]
 
     fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=(spec, spec, spec))
+                   out_specs=(spec, spec, spec, spec))
     return fn(pts, cols, vld)

@@ -131,6 +131,14 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
                         "pruning by error ceilings)")
     p.add_argument("--max-cam-err", type=float, default=1.0)
     p.add_argument("--max-proj-err", type=float, default=2.0)
+    p.add_argument("--review", default=None, metavar="ARTIFACT_DIR",
+                   help="publish per-pose errors to this viewer artifact "
+                        "dir and WAIT for the operator's in-viewer pose "
+                        "selection before the final solve (the reference "
+                        "GUI's prune dialog, gui.py:1211-1250)")
+    p.add_argument("--review-timeout", type=float, default=600.0,
+                   help="seconds to wait for the in-viewer selection before "
+                        "falling back to auto pruning")
     add_config_args(p)
 
     p = sub.add_parser("inspect-calib",
@@ -286,6 +294,24 @@ def _cmd_calibrate(args) -> int:
         return 0
     if args.poses:
         selected = [p.strip() for p in args.poses.split(",") if p.strip()]
+    elif args.review:
+        from structured_light_for_3d_model_replication_tpu.acquire import (
+            viewer as viewerlib,
+        )
+
+        viewerlib.publish_pose_review(args.review, errors)
+        print(f"pose review published to {args.review} — select poses in "
+              f"the viewer (sl3d viewer {args.review}); waiting up to "
+              f"{args.review_timeout:.0f}s...")
+        selected = viewerlib.await_pose_selection(args.review,
+                                                  args.review_timeout)
+        if selected is not None:
+            selected = [s for s in selected if s in errors]
+        if not selected:  # timeout, empty selection, or no matching names
+            print("no usable selection received — falling back to auto "
+                  "pruning")
+            selected = cp.select_poses(errors, args.max_cam_err,
+                                       args.max_proj_err)
     else:
         selected = cp.select_poses(errors, args.max_cam_err, args.max_proj_err)
     print(f"using {len(selected)}/{len(errors)} poses: {', '.join(sorted(selected))}")

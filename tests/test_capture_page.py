@@ -1,0 +1,59 @@
+"""Static behavioral pinning of the phone capture page against the
+reference PWA (frontend/App.tsx). These assertions pin the SOURCE of each
+behavior the parity matrix (docs/pwa_parity.md) claims; the browser-level
+drive (WebView + canvas.captureStream camera stub) is recorded there too —
+a plain pytest environment has no camera or browser to run it in CI.
+"""
+import os
+import re
+
+_PAGE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "structured_light_for_3d_model_replication_tpu",
+                     "acquire", "capture_page.html")
+
+
+def _src() -> str:
+    with open(_PAGE, encoding="utf-8") as f:
+        return f.read()
+
+
+def test_capture_resolution_requests_4k_ideal():
+    # App.tsx:100-106 asks getUserMedia for ideal 3840x2160; the page must
+    # request at least that so phones negotiate their full sensor mode
+    src = _src()
+    m = re.search(r"width:\s*{\s*ideal:\s*(\d+)\s*}.*?"
+                  r"height:\s*{\s*ideal:\s*(\d+)\s*}", src, re.S)
+    assert m, "no ideal-resolution getUserMedia constraint found"
+    assert int(m.group(1)) >= 3840 and int(m.group(2)) >= 2160
+
+
+def test_capture_canvas_uses_full_sensor_resolution():
+    # App.tsx:227-232 sizes the canvas from video.videoWidth/videoHeight
+    # (the NEGOTIATED stream size, not the CSS size) before drawImage
+    src = _src()
+    assert "video.videoWidth" in src and "video.videoHeight" in src
+    assert re.search(r"canvas\.width\s*=\s*w.*canvas\.height\s*=\s*h", src, re.S)
+    assert re.search(r"drawImage\([^)]*0,\s*0,\s*w,\s*h\)", src)
+
+
+def test_log_is_a_five_entry_ring():
+    # App.tsx:60-62 keeps the newest 5 log lines
+    src = _src()
+    assert re.search(r"logLines\.length\s*>\s*5", src), "5-entry ring missing"
+
+
+def test_poll_cadence_and_command_dedup():
+    # App.tsx polls every 500 ms and dedups on command id
+    src = _src()
+    assert re.search(r"setTimeout\(res,\s*(\d+)\s*-\s*dt\)", src).group(1) == "500"
+    assert "lastProcessedId" in src
+    assert re.search(r"cmd\.id\s*!==\s*lastProcessedId", src)
+
+
+def test_upload_is_multipart_file_field_png():
+    # server contract (shared with the reference server): multipart POST
+    # /upload with the blob under field name "file", PNG encoded
+    src = _src()
+    assert re.search(r'append\("file",\s*blob', src)
+    assert '"image/png"' in src
+    assert "/upload" in src and "/poll_command" in src

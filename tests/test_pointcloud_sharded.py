@@ -64,6 +64,17 @@ def test_slab_partition_rejects_too_thin_clouds(rng):
         pcs.shard_points_by_slab(flat, None, None, 8, 5.0)
 
 
+def test_flat_cloud_over_many_devices_raises_not_diverges(rng):
+    # review scenario: a surface-ish cloud only ~16 z-cells deep over 8
+    # devices -> slabs thinner than the certification radius; shrinking the
+    # halo would mass-uncertify interior rows and silently drop valid
+    # points, so the call must refuse instead
+    flat = rng.uniform(0, 80, (20_000, 3)).astype(np.float32)
+    flat[:, 2] *= 0.2  # z extent 16 at cell=1 -> 2 cells per slab
+    with pytest.raises(ValueError, match="certification radius"):
+        pcs.postprocess_merged_sharded(8, flat, None, None, final_voxel=1.0)
+
+
 def test_slab_partition_rejects_oversize_grids(rng):
     # >1023 cells/axis would overflow the packed 30-bit keys and silently
     # merge distinct voxels (review repro: 4685-point divergence) — raise

@@ -37,6 +37,58 @@ def test_viewer_serves_artifacts_and_progress(tmp_path, rng):
         assert prog[1]["stage"] == "autoscan" and prog[1]["remaining_s"] == 50.0
 
 
+def test_pose_review_roundtrip(tmp_path):
+    """The in-viewer prune flow (gui.py:1211-1250 parity): publish errors ->
+    GET shows pending review -> operator POSTs a keep list -> the waiting
+    calibrate process receives it and the review clears."""
+    import threading
+
+    from structured_light_for_3d_model_replication_tpu.acquire import (
+        viewer as vw,
+    )
+
+    errors = {"pose_1": (0.31, 0.62), "pose_2": (1.8, 2.4),
+              "pose_3": (0.45, 0.71)}
+    vw.publish_pose_review(str(tmp_path), errors)
+    with ViewerServer(str(tmp_path), host="127.0.0.1", port=0) as v:
+        base = f"http://127.0.0.1:{v.port}"
+        j = json.load(_get(base, "/api/poses"))
+        assert j["status"] == "pending"
+        assert j["poses"]["pose_2"]["cam_px"] == 1.8
+        page = _get(base, "/").read().decode()
+        assert "pose review" in page.lower()  # panel shipped with the page
+
+        got: list = []
+        waiter = threading.Thread(
+            target=lambda: got.append(
+                vw.await_pose_selection(str(tmp_path), timeout=20)))
+        waiter.start()
+        req = urllib.request.Request(
+            base + "/api/poses",
+            data=json.dumps({"keep": ["pose_1", "pose_3"]}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        resp = json.load(urllib.request.urlopen(req, timeout=10))
+        assert resp == {"ok": True, "kept": 2}
+        waiter.join(timeout=20)
+        assert got == [["pose_1", "pose_3"]]
+        # consumed: review cleared, a fresh GET reports none pending
+        assert json.load(_get(base, "/api/poses"))["status"] == "none"
+
+
+def test_pose_selection_rejected_without_pending_review(tmp_path):
+    with ViewerServer(str(tmp_path), host="127.0.0.1", port=0) as v:
+        base = f"http://127.0.0.1:{v.port}"
+        req = urllib.request.Request(
+            base + "/api/poses", data=b'{"keep": []}',
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+        else:  # pragma: no cover
+            raise AssertionError("expected 409 with no review pending")
+
+
 def test_viewer_blocks_traversal_and_unknown(tmp_path):
     (tmp_path / "ok.ply").write_bytes(b"ply\nend_header\n")
     secret = tmp_path.parent / "secret.ply"
