@@ -25,6 +25,9 @@ def main() -> None:
     ap.add_argument("--register", action="store_true",
                     help="also sweep register_pairs trial/ICP knobs")
     ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--trials", type=int, default=2048,
+                    help="ransac_trials for the merge runs (bench uses 2048; "
+                         "the library default is 4096)")
     args = ap.parse_args()
 
     import jax
@@ -52,10 +55,12 @@ def main() -> None:
               for i in range(len(off) - 1)]
     print(f"backend={jax.default_backend()} views={len(clouds)}")
 
+    mcfg = MergeConfig(ransac_trials=args.trials)
     for it in range(args.runs):
         tm: dict = {}
         t0 = time.perf_counter()
-        p, c, T = rec.merge_360(clouds, log=lambda m: None, timings=tm)
+        p, c, T = rec.merge_360(clouds, cfg=mcfg, log=lambda m: None,
+                                timings=tm)
         print(f"run{it}: {time.perf_counter() - t0:.3f}s stages={tm} "
               f"pts={len(p)}")
 
