@@ -21,18 +21,49 @@ __all__ = ["CameraSettings", "AndroidCameraClient"]
 
 @dataclass
 class CameraSettings:
-    """Manual camera controls; None fields are left at the phone's defaults."""
+    """Manual camera controls; None fields are left at the phone's defaults.
+
+    Field names are pythonic; ``to_dict`` emits the EXACT wire keys the
+    reference device app parses (Camera2Controller.kt:167-185 reads
+    ``exposure_time_ns`` / ``focus_distance`` / ``zoom_ratio`` / ``eis`` —
+    unknown keys are silently ignored by its ``as?`` casts, so a wrong
+    name would no-op without an error; docs/android_protocol.md pins the
+    full key set and tests/test_android_client.py asserts it)."""
 
     exposure_ns: int | None = None
     iso: int | None = None
+    exposure_compensation: int | None = None
+    ae_mode: str | None = None          # "on" | "off" (manual)
+    af_mode: str | None = None          # "auto" | "off" (manual)
     focus_diopters: float | None = None
     awb_mode: str | None = None
     zoom: float | None = None
+    # eis/ois are independent wire controls (EIS's frame warp corrupts
+    # structured-light correspondence; OIS does not) — set them separately,
+    # or use `stabilization` as a both-at-once convenience
+    eis: bool | None = None
+    ois: bool | None = None
     stabilization: bool | None = None
     jpeg_quality: int | None = None
+    camera_id: str | None = None
+
+    _WIRE_KEYS = {  # pythonic field -> reference wire key
+        "exposure_ns": "exposure_time_ns",
+        "focus_diopters": "focus_distance",
+        "zoom": "zoom_ratio",
+    }
 
     def to_dict(self) -> dict:
-        return {k: v for k, v in asdict(self).items() if v is not None}
+        out = {}
+        for k, v in asdict(self).items():
+            if v is None:
+                continue
+            if k == "stabilization":  # convenience: explicit eis/ois win
+                out.setdefault("eis", bool(v))
+                out.setdefault("ois", bool(v))
+            else:
+                out[self._WIRE_KEYS.get(k, k)] = v
+        return out
 
 
 class AndroidCameraClient:

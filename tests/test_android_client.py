@@ -1,0 +1,156 @@
+"""AndroidCameraClient against a FakeAndroidDevice implementing the
+conformance spec (docs/android_protocol.md — derived from the reference's
+CameraHostServer.kt / Camera2Controller.kt). The fake reproduces the real
+app's silently-ignores-unknown-keys behavior, so a client emitting wrong
+wire key names fails these tests instead of no-opping on a real phone."""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.acquire.android import (
+    AndroidCameraClient,
+    CameraSettings,
+)
+
+# the exact settings key set the reference app parses
+# (Camera2Controller.kt:167-185)
+SPEC_KEYS = {"camera_id", "jpeg_quality", "ae_mode", "exposure_time_ns",
+             "iso", "exposure_compensation", "af_mode", "focus_distance",
+             "awb_mode", "eis", "ois", "zoom_ratio"}
+
+_JPEG = b"\xff\xd8\xff\xe0" + b"\x00" * 64 + b"\xff\xd9"
+
+
+class _FakeHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # pragma: no cover
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Access-Control-Allow-Origin", "*")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/status":
+            self._json({"ok": True, "device": "FakePixel", "sdkInt": 34,
+                        "activeCameraId": "0", "cameraIds": ["0", "1"],
+                        "port": self.server.server_address[1]})
+        elif self.path == "/capabilities":
+            self._json({"cameras": [{
+                "cameraId": "0", "facing": "back", "rawSupported": False,
+                "aeCompensationRange": [-24, 24], "isoRange": [50, 6400],
+                "exposureTimeNsRange": [1000, 1_000_000_000],
+                "maxDigitalZoom": 8.0}], "notes": "fake"})
+        else:
+            self.send_error(404)
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", "0"))
+        raw = self.rfile.read(n) if n else b""
+        body = json.loads(raw) if raw else {}
+        if self.path == "/settings":
+            # the real app's `as?` casts ignore unknown keys without error;
+            # the fake RECORDS them so tests can assert none were sent
+            self.server.seen_keys.update(body)          # type: ignore
+            applied = dict(self.server.applied)          # type: ignore
+            for k in SPEC_KEYS & set(body):
+                applied[_CAMEL[k]] = body[k]
+            self.server.applied = applied                # type: ignore
+            self._json({"ok": True, "applied": applied})
+        elif self.path == "/capture/jpeg":
+            self.server.seen_keys.update(body)           # type: ignore
+            meta = {k: self.server.applied.get(k) for k in  # type: ignore
+                    ("cameraId", "jpegQuality", "aeMode", "exposureTimeNs",
+                     "iso", "afMode", "focusDistance", "zoomRatio")}
+            self.send_response(200)
+            self.send_header("Content-Type", "image/jpeg")
+            self.send_header("X-Capture-Meta", json.dumps(meta))
+            self.send_header("Content-Length", str(len(_JPEG)))
+            self.end_headers()
+            self.wfile.write(_JPEG)
+        else:
+            self.send_error(404)
+
+
+_CAMEL = {"camera_id": "cameraId", "jpeg_quality": "jpegQuality",
+          "ae_mode": "aeMode", "exposure_time_ns": "exposureTimeNs",
+          "iso": "iso", "exposure_compensation": "exposureCompensation",
+          "af_mode": "afMode", "focus_distance": "focusDistance",
+          "awb_mode": "awbMode", "eis": "eis", "ois": "ois",
+          "zoom_ratio": "zoomRatio"}
+
+
+@pytest.fixture()
+def fake_device():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _FakeHandler)
+    httpd.seen_keys = set()           # type: ignore[attr-defined]
+    httpd.applied = {"cameraId": "0", "jpegQuality": 95}  # type: ignore
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield httpd
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_status_and_capabilities(fake_device):
+    c = AndroidCameraClient("127.0.0.1", fake_device.server_address[1])
+    assert c.reachable()
+    st = c.status()
+    assert st["ok"] and st["cameraIds"] == ["0", "1"]
+    caps = c.capabilities()
+    assert caps["cameras"][0]["isoRange"] == [50, 6400]
+
+
+def test_settings_emit_only_reference_wire_keys(fake_device):
+    # the key-name regression class: a wrong name (e.g. 'exposure_ns')
+    # no-ops silently on the real app; assert every emitted key is one the
+    # reference actually parses, and that the manual values land
+    c = AndroidCameraClient("127.0.0.1", fake_device.server_address[1])
+    s = CameraSettings(exposure_ns=8_333_333, iso=100, focus_diopters=2.5,
+                       awb_mode="daylight", zoom=1.5, stabilization=True,
+                       jpeg_quality=97, ae_mode="off", af_mode="off",
+                       exposure_compensation=-2, camera_id="0")
+    resp = c.apply_settings(s)
+    assert resp["ok"]
+    unknown = fake_device.seen_keys - SPEC_KEYS
+    assert not unknown, f"keys the device app would ignore: {unknown}"
+    ap = resp["applied"]
+    assert ap["exposureTimeNs"] == 8_333_333
+    assert ap["focusDistance"] == 2.5
+    assert ap["zoomRatio"] == 1.5
+    assert ap["eis"] is True and ap["ois"] is True
+    assert ap["jpegQuality"] == 97 and ap["iso"] == 100
+
+
+def test_mixed_stabilization_state_expressible():
+    # EIS's frame warp corrupts correspondence, OIS doesn't — ois-only must
+    # be expressible, and explicit flags win over the convenience bool
+    d = CameraSettings(eis=False, ois=True).to_dict()
+    assert d == {"eis": False, "ois": True}
+    d = CameraSettings(eis=False, stabilization=True).to_dict()
+    assert d["eis"] is False and d["ois"] is True
+
+
+def test_capture_jpeg_returns_bytes_and_meta(fake_device, tmp_path):
+    c = AndroidCameraClient("127.0.0.1", fake_device.server_address[1])
+    c.apply_settings(CameraSettings(iso=200))
+    jpeg, meta = c.capture_jpeg()
+    assert jpeg.startswith(b"\xff\xd8") and jpeg.endswith(b"\xff\xd9")
+    assert meta["iso"] == 200
+    # sequencer CaptureFn contract: capture_to_path writes the frame
+    out = tmp_path / "frame.jpg"
+    meta2 = c.capture_to_path(str(out))
+    assert out.read_bytes() == jpeg
+    assert meta2["iso"] == 200
+
+
+def test_unreachable_is_false():
+    assert not AndroidCameraClient("127.0.0.1", 1).reachable()
