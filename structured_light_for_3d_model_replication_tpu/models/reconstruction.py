@@ -136,7 +136,8 @@ def _preprocess_views(clouds, voxel: float, sample_before: int):
     n_views = len(sampled)
     n_raw = -(-max(len(p) for p, _ in sampled) // 8192) * 8192
     chunk = max(1, min(n_views, (8 << 20) // n_raw))  # <= ~100 MB f32 points
-    compacted = []
+    views_p = []      # device-resident voxelized views (no 14 MB D2H+H2D:
+    counts = []       # on a tunneled chip those round trips are network time)
     for s in range(0, n_views, chunk):
         part = sampled[s:s + chunk]
         pts = np.full((chunk, n_raw, 3), 1e9, np.float32)
@@ -146,15 +147,28 @@ def _preprocess_views(clouds, voxel: float, sample_before: int):
             valid[k, :len(p_s)] = True
         p_all, v_all = _voxel_views_jit(jnp.asarray(pts), jnp.asarray(valid),
                                         jnp.float32(voxel))
-        p_all = np.asarray(p_all)
-        v_all = np.asarray(v_all)
-        compacted.extend(p_all[k][v_all[k]] for k in range(len(part)))
+        # survivor COUNTS are the only host transfer (survivors occupy a
+        # contiguous slot prefix — test_voxel_downsample_survivor_prefix);
+        # each view is sliced to its chunk's 2048-bucket immediately so the
+        # big [chunk, n_raw] buffer frees at loop end — holding every
+        # chunk's full-slot output until the final stack would defeat the
+        # residency bound this loop exists for
+        cnts = np.asarray(v_all.sum(axis=1))[:len(part)].astype(int)
+        counts.extend(int(x) for x in cnts)
+        bucket = -(-max(int(cnts.max()), 1) // 2048) * 2048
+        views_p.extend(p_all[k, :bucket] for k in range(len(part)))
 
-    # re-pad the survivors to one size and batch normals+FPFH the same way
-    n_pad = -(-max(max(len(p) for p in compacted), 1) // 2048) * 2048
-    padded = [_pad_prep(p_c, n_pad) for p_c in compacted]
-    p_stack = jnp.stack([p for p, _ in padded])
-    v_stack = jnp.stack([v for _, v in padded])
+    # pad every view up to ONE size on device and batch normals+FPFH;
+    # invalid slots hold zeros, which every downstream op masks via
+    # `valid` (knn parks them at _FAR itself)
+    n_pad = -(-max(max(counts), 1) // 2048) * 2048
+    views_p = [vp if vp.shape[0] == n_pad else
+               jnp.concatenate([vp, jnp.zeros((n_pad - vp.shape[0], 3),
+                                              jnp.float32)])
+               for vp in views_p]
+    p_stack = jnp.stack(views_p)
+    v_stack = (jnp.asarray(counts, jnp.int32)[:, None]
+               > jnp.arange(n_pad, dtype=jnp.int32)[None, :])
     nr_all, feat_all = _features_views_jit(p_stack, v_stack,
                                            jnp.float32(5.0 * voxel))
     return [_Prep(p_stack[i], v_stack[i], nr_all[i], feat_all[i])
@@ -266,16 +280,33 @@ def _postprocess_merged(points, colors, cfg: MergeConfig, tm: dict | None = None
 
     tm = tm if tm is not None else {}
     valid = np.ones(len(points), bool)
+    # one stage sequence, two compaction strategies: on accelerators the
+    # cloud stays DEVICE-RESIDENT between the voxel pass and the outlier
+    # probe (prefix-slice compaction, one scalar sync) — the host-compact
+    # strategy bounces the ~12 MB cloud through the host twice, and on a
+    # TUNNELED chip every transfer + sync is a network round trip. The
+    # prefix slice is sound because survivors occupy a contiguous slot
+    # prefix (group segment ids ascend in key order; the invalid-sentinel
+    # key sorts last — pinned by test_voxel_downsample_survivor_prefix).
+    fused = (jax.default_backend() != "cpu"
+             and bool(cfg.final_voxel and cfg.final_voxel > 0)
+             and cfg.outlier_nb > 0
+             and not (cfg.sample_after and cfg.sample_after > 1))
     if cfg.final_voxel and cfg.final_voxel > 0:
         t0 = _time.perf_counter()
         p, c, v = pc.voxel_downsample(jnp.asarray(points), jnp.asarray(colors),
                                       jnp.asarray(valid), float(cfg.final_voxel))
-        keep = np.asarray(v)
-        points = np.asarray(p)[keep]
-        colors = np.asarray(c)[keep]
-        valid = np.ones(len(points), bool)
+        if fused:
+            n_keep = int(np.asarray(v.sum()))
+            n_pad = min(-(-max(n_keep, 1) // 8192) * 8192, p.shape[0])
+            points, colors, valid = p[:n_pad], c[:n_pad], v[:n_pad]
+        else:
+            keep = np.asarray(v)
+            points = np.asarray(p)[keep]
+            colors = np.asarray(c)[keep]
+            valid = np.ones(len(points), bool)
         tm["final_voxel_s"] = round(_time.perf_counter() - t0, 3)
-    if cfg.sample_after and cfg.sample_after > 1:
+    if cfg.sample_after and cfg.sample_after > 1:  # host arrays (not fused)
         points = points[:: cfg.sample_after]
         colors = colors[:: cfg.sample_after]
         valid = valid[:: cfg.sample_after]
@@ -289,9 +320,11 @@ def _postprocess_merged(points, colors, cfg: MergeConfig, tm: dict | None = None
         m = np.asarray(pc.statistical_outlier_mask(
             jnp.asarray(points), jnp.asarray(valid),
             cfg.outlier_nb, cfg.outlier_std, voxelized_cell=cell))
-        points, colors = points[m], colors[m]
+        keep = np.asarray(valid) & m
+        points = np.asarray(points)[keep]
+        colors = np.asarray(colors)[keep]
         tm["outlier_s"] = round(_time.perf_counter() - t0, 3)
-    return points, colors
+    return np.asarray(points), np.asarray(colors)
 
 
 def merge_360_posegraph(clouds, cfg: MergeConfig | None = None, log=print,

@@ -203,6 +203,22 @@ def test_voxel_downsample_packed_matches_lex(rng):
     np.testing.assert_array_equal(c_a[v_a][sa], c_b[v_b][sb])
 
 
+def test_voxel_downsample_survivor_prefix(rng):
+    # the merge postprocess's device-side compaction slices the first
+    # sum(valid) slots — BOTH voxel paths must keep survivors as a
+    # contiguous prefix (segment ids ascend in key order; the invalid
+    # sentinel key sorts last)
+    pts = rng.uniform(0, 30, (5000, 3)).astype(np.float32)
+    valid = rng.random(5000) > 0.3
+    cols = rng.integers(0, 256, (5000, 3)).astype(np.uint8)
+    args = (jnp.asarray(pts), jnp.asarray(cols), jnp.asarray(valid),
+            jnp.float32(2.0))
+    for fn in (pc._voxel_downsample_packed, pc._voxel_downsample_lex):
+        v = np.asarray(fn(*args)[2])
+        n = int(v.sum())
+        assert v[:n].all() and not v[n:].any(), fn.__name__
+
+
 def test_statistical_outlier_inf_mean_distance(rng):
     # regression: a point whose k-th neighbor is out of search range (inf
     # mean distance) must be dropped WITHOUT poisoning mu/sigma and wiping
