@@ -176,28 +176,34 @@ def _preprocess_views(clouds, voxel: float, sample_before: int):
 
 
 def _register_chain_batched(preps, cfg: MergeConfig, voxel: float,
-                            loop_closure: bool):
+                            loop_closure: bool, mesh=None):
     """All chain pairs (i-1 <- i), plus optionally (0 <- n-1), registered in
-    ONE device launch via ops.registration.register_pairs. Returns host
-    arrays (T [P,4,4], gfit [P], ifit [P], irmse [P])."""
+    ONE device launch via ops.registration.register_pairs — or sharded over
+    ``mesh`` (pairs split across every device, zero hot-path collectives)
+    when one is given. Returns host arrays (T [P,4,4], gfit [P], ifit [P],
+    irmse [P])."""
     srcs = preps[1:] + ([preps[-1]] if loop_closure else [])
     dsts = preps[:-1] + ([preps[0]] if loop_closure else [])
-    T, gfit, ifit, irmse = reg.register_pairs(
-        jnp.stack([p.points for p in srcs]),
-        jnp.stack([p.valid for p in srcs]),
-        jnp.stack([p.features for p in srcs]),
-        jnp.stack([p.points for p in dsts]),
-        jnp.stack([p.valid for p in dsts]),
-        jnp.stack([p.features for p in dsts]),
-        jnp.stack([p.normals for p in dsts]),
-        max_dist=voxel * 1.5, icp_max_dist=voxel * float(cfg.icp_dist_ratio),
-        trials=cfg.ransac_trials, icp_iters=cfg.icp_iters)
+    args = (jnp.stack([p.points for p in srcs]),
+            jnp.stack([p.valid for p in srcs]),
+            jnp.stack([p.features for p in srcs]),
+            jnp.stack([p.points for p in dsts]),
+            jnp.stack([p.valid for p in dsts]),
+            jnp.stack([p.features for p in dsts]),
+            jnp.stack([p.normals for p in dsts]))
+    kw = dict(max_dist=voxel * 1.5,
+              icp_max_dist=voxel * float(cfg.icp_dist_ratio),
+              trials=cfg.ransac_trials, icp_iters=cfg.icp_iters)
+    if mesh is not None:
+        T, gfit, ifit, irmse = reg.register_pairs_sharded(mesh, *args, **kw)
+    else:
+        T, gfit, ifit, irmse = reg.register_pairs(*args, **kw)
     return (np.asarray(T, np.float32), np.asarray(gfit, np.float32),
             np.asarray(ifit, np.float32), np.asarray(irmse, np.float32))
 
 
 def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
-              step_callback=None, timings: dict | None = None):
+              step_callback=None, timings: dict | None = None, mesh=None):
     """Merge ordered per-view clouds into one 360-degree cloud.
 
     clouds: list of (points [N,3] f32, colors [N,3] u8) in turntable order.
@@ -209,6 +215,12 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
     independent given the odometry formulation, all N-1 registrations run as
     one batched launch, and only the (cheap, host-side) T_accum chain stays
     sequential.
+
+    ``mesh``: optional jax.sharding.Mesh — the multi-chip path: chain pairs
+    shard across every device (register_pairs_sharded) and the final
+    voxel+outlier pass runs slab-sharded (postprocess_merged_sharded,
+    falling back to the single-device pass when the cloud is too thin to
+    slab). A 24-view merge on a v5e-8 registers 3 pairs per chip.
 
     ``timings``: optional dict filled with per-stage wall seconds
     (preprocess_s / register_s / accumulate_s / postprocess_s).
@@ -231,7 +243,7 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
     tm["preprocess_s"] = round(_time.perf_counter() - t0, 3)
     t0 = _time.perf_counter()
     T_all, gfit_all, ifit_all, irmse_all = _register_chain_batched(
-        preps, cfg, voxel, loop_closure=False)
+        preps, cfg, voxel, loop_closure=False, mesh=mesh)
     tm["register_s"] = round(_time.perf_counter() - t0, 3)
 
     t0 = _time.perf_counter()
@@ -261,7 +273,25 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
     t0 = _time.perf_counter()
     points = np.concatenate(merged_p)
     colors = np.concatenate(merged_c)
-    points, colors = _postprocess_merged(points, colors, cfg, tm)
+    if mesh is not None and cfg.final_voxel and cfg.final_voxel > 0 \
+            and cfg.outlier_nb > 0 \
+            and not (cfg.sample_after and cfg.sample_after > 1):
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            pointcloud_sharded as pcs,
+        )
+
+        try:
+            points, colors = pcs.postprocess_merged_sharded(
+                mesh, points, colors, None, float(cfg.final_voxel),
+                cfg.outlier_nb, cfg.outlier_std)
+        except (ValueError, RuntimeError) as e:
+            # cloud too thin / too wide to slab, or fallback-cap overflow:
+            # the single-device pass is always correct, just unsharded
+            log(f"[merge_360] sharded postprocess unavailable ({e}); "
+                f"single-device pass")
+            points, colors = _postprocess_merged(points, colors, cfg, tm)
+    else:
+        points, colors = _postprocess_merged(points, colors, cfg, tm)
     tm["postprocess_s"] = round(_time.perf_counter() - t0, 3)
     return points, colors, transforms
 

@@ -709,9 +709,12 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
     axes = tuple(mesh.axis_names)
 
     def _pad(a):
-        a = np.asarray(a)
+        # device-aware: jnp.asarray is a no-op for device-resident stacks
+        # (merge_360's mesh route builds them on device) — an np.asarray
+        # here would bounce tens of MB through the host per merge
+        a = jnp.asarray(a)
         if pad:
-            a = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
+            a = jnp.concatenate([a, jnp.repeat(a[-1:], pad, axis=0)])
         return a
 
     arrays = [_pad(a) for a in (src_pts, src_valid, src_feat, dst_pts,
@@ -743,7 +746,7 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
         in_specs=(spec,) * 8,
         out_specs=(spec, spec, spec, spec),
     ))
-    inputs = [jnp.asarray(a) for a in arrays]
+    inputs = arrays
     try:
         T, gfit, ifit, irmse = fn(*inputs, keys)
     except Exception:
