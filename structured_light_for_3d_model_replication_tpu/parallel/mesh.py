@@ -51,6 +51,20 @@ def make_mesh(n_data: int | None = None, n_model: int | None = None,
     return Mesh(grid, (AXIS_DATA, AXIS_MODEL))
 
 
+def merge_mesh(parallel_cfg=None) -> Mesh | None:
+    """The mesh for the sharded 360 merge, resolved in ONE place so every
+    merge_360 call site (CLI stage, warmup's cache priming, embedders)
+    compiles the same program: a full-device make_mesh() when
+    ``parallel.merge_mesh`` is on and >1 device is attached, else None
+    (single-device hosts and the default config are unaffected)."""
+    if parallel_cfg is not None and not getattr(parallel_cfg, "merge_mesh",
+                                                False):
+        return None
+    if len(jax.devices()) < 2:
+        return None
+    return make_mesh()
+
+
 def view_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a [V, F, H, W] view-batch: views over data, rows over model."""
     return NamedSharding(mesh, P(AXIS_DATA, None, AXIS_MODEL, None))

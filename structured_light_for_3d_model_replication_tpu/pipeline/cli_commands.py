@@ -574,7 +574,15 @@ def _cmd_warmup(args) -> int:
         print(f"[warmup] rendered {args.merge_views} merge views "
               f"({time.perf_counter() - t0:.1f}s, host)")
         t0 = time.perf_counter()
-        merge_360(clouds, cfg=cfg.merge, log=lambda m: None)
+        from structured_light_for_3d_model_replication_tpu.parallel import (
+            mesh as meshlib,
+        )
+
+        # same mesh resolution as the real merge-360 stage: warming the
+        # unsharded program while parallel.merge_mesh routes real runs
+        # through shard_map would leave the cache cold where it matters
+        merge_360(clouds, cfg=cfg.merge, log=lambda m: None,
+                  mesh=meshlib.merge_mesh(cfg.parallel))
         print(f"[warmup] merge chain: {time.perf_counter() - t0:.1f}s")
     print("[warmup] done — subsequent processes reuse these executables "
           "via the persistent cache")

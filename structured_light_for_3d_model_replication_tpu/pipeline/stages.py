@@ -297,13 +297,28 @@ def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
             c = np.zeros_like(d["points"], dtype=np.uint8)
         clouds.append((np.asarray(d["points"], np.float32), np.asarray(c, np.uint8)))
 
+    mesh = None
+    if cfg.parallel.merge_mesh:
+        if cfg.merge.method == "posegraph":
+            log("[merge] parallel.merge_mesh is ignored for "
+                "method='posegraph' (the pose-graph merge is unsharded)")
+        else:
+            from structured_light_for_3d_model_replication_tpu.parallel import (
+                mesh as meshlib,
+            )
+
+            mesh = meshlib.merge_mesh(cfg.parallel)
+            if mesh is not None:
+                log(f"[merge] sharding the chain over "
+                    f"{mesh.devices.size} devices (parallel.merge_mesh)")
     with prof.trace():
         if cfg.merge.method == "posegraph":
             points, colors, transforms = recon.merge_360_posegraph(
                 clouds, cfg.merge, log=log, step_callback=step_callback)
         else:
             points, colors, transforms = recon.merge_360(
-                clouds, cfg.merge, log=log, step_callback=step_callback)
+                clouds, cfg.merge, log=log, step_callback=step_callback,
+                mesh=mesh)
     ply.write_ply(output_ply, points, colors)
     log(f"[merge] wrote {output_ply} ({len(points):,} points)")
     return points, colors, transforms

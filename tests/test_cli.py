@@ -107,6 +107,23 @@ def test_merge_and_mesh(recon_dir, tmp_path):
     assert len(faces) > 50
 
 
+def test_merge_360_sharded_over_virtual_mesh(recon_dir, tmp_path, capsys):
+    # parallel.merge_mesh=true on the 8-virtual-device test env: the chain
+    # registers sharded and the postprocess runs slab-sharded (or falls
+    # back with a log line) — the CLI surface of merge_360(mesh=...)
+    merged = str(tmp_path / "merged_sharded.ply")
+    rc = cli_main(["merge-360", recon_dir, merged,
+                   "--set", "parallel.merge_mesh=true",
+                   "--set", "merge.voxel_size=4.0",
+                   "--set", "merge.ransac_trials=512",
+                   "--set", "merge.icp_iters=10",
+                   "--set", "merge.final_voxel=1.0",
+                   "--set", "merge.outlier_nb=10"])
+    assert rc == 0
+    assert "sharding the chain over 8 devices" in capsys.readouterr().out
+    assert len(plyio.read_ply(merged)["points"]) > 500
+
+
 def test_patterns(tmp_path):
     out = str(tmp_path / "pats")
     rc = cli_main(["patterns", out, "--set", "projector.width=64",
