@@ -1,0 +1,115 @@
+"""One-shot TPU validation session: run the moment the device tunnel is
+healthy again, in ONE serialized process chain (one TPU client at a time —
+concurrent clients and mid-claim kills are what wedge the pool tunnel, see
+BENCH_NOTES.md).
+
+Sequence (each step is a subprocess that fully exits before the next):
+  1. preflight probe (3 min bound) — abort politely if the tunnel is wedged
+  2. accelerator smoke test (pytest tests/test_tpu_smoke.py) — every device
+     path at real shapes, incl. the voxelized outlier probe and the
+     bitexact-on-device record
+  3. tools/profile_merge.py --register — per-stage merge timings + the
+     trial/ICP sweep (the round-3 wedge-window optimizations, re-measured)
+  4. python bench.py — the full record line
+  5. write BENCH_SELF_r<N>.json from the bench line
+
+Timeouts are deliberately FAR above expected runtimes (the wedge lesson:
+never kill a TPU client anywhere near its expected finish); pass --step to
+run a single step instead of the chain.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# expected wall ~3-8 min each on a warm cache; limits are 4-10x that
+STEPS = [
+    ("smoke", [sys.executable, "-m", "pytest",
+               "tests/test_tpu_smoke.py", "-x", "-q", "-rs"], 2400),
+    ("profile_merge", [sys.executable, "tools/profile_merge.py",
+                       "--register"], 2400),
+    ("bench", [sys.executable, "bench.py"], 4200),
+]
+
+
+def log(msg: str) -> None:
+    print(f"[tpu-session +{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def run_step(name: str, cmd, limit: int) -> tuple[int, str]:
+    log(f"step {name}: {' '.join(cmd)} (limit {limit}s)")
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True,
+                              timeout=limit)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired as e:
+        log(f"step {name} EXCEEDED {limit}s — killed (tunnel may be "
+            f"re-wedged; stop the session and re-probe before retrying)")
+        out = (e.stdout or b"")
+        return -9, out.decode() if isinstance(out, bytes) else str(out)
+    log(f"step {name}: rc={rc} in {time.time() - t0:.0f}s")
+    tail = (proc.stderr or "")[-2000:]
+    if tail:
+        print(tail, file=sys.stderr, flush=True)
+    return rc, proc.stdout or ""
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--step", choices=[s[0] for s in STEPS], default=None,
+                    help="run one step instead of the whole chain")
+    ap.add_argument("--round", type=int, default=4,
+                    help="round number for the BENCH_SELF_r<N>.json record")
+    ap.add_argument("--skip-smoke", action="store_true")
+    args = ap.parse_args()
+
+    sys.path.insert(0, ROOT)
+    from structured_light_for_3d_model_replication_tpu.utils.preflight import (
+        accelerator_preflight,
+    )
+
+    status, detail = accelerator_preflight()
+    log(f"preflight: {status} ({detail})")
+    if status != "ok":
+        sys.exit(f"tunnel not healthy ({status}) — not starting any TPU work")
+
+    steps = [s for s in STEPS if args.step is None or s[0] == args.step]
+    if args.skip_smoke:
+        steps = [s for s in steps if s[0] != "smoke"]
+    bench_line = None
+    for name, cmd, limit in steps:
+        rc, out = run_step(name, cmd, limit)
+        if name != "bench":
+            print(out[-4000:], flush=True)
+        if name == "bench" and rc == 0:
+            for line in reversed(out.strip().splitlines()):
+                try:
+                    bench_line = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        if rc != 0 and name == "smoke":
+            log("smoke failed — continuing to measurements anyway (their "
+                "provenance fields tell the real story)")
+
+    if bench_line is not None:
+        rec = os.path.join(ROOT, f"BENCH_SELF_r{args.round:02d}.json")
+        bench_line["recorded_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                  time.gmtime())
+        bench_line["recorded_by"] = "tools/tpu_session.py"
+        with open(rec, "w") as f:
+            json.dump(bench_line, f, indent=1)
+        log(f"wrote {rec}: value={bench_line.get('value')} "
+            f"backend={bench_line.get('backend')} "
+            f"error={bench_line.get('error')}")
+        print(json.dumps(bench_line), flush=True)
+    log("session done")
+
+
+if __name__ == "__main__":
+    main()
