@@ -273,9 +273,7 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
     t0 = _time.perf_counter()
     points = np.concatenate(merged_p)
     colors = np.concatenate(merged_c)
-    if mesh is not None and cfg.final_voxel and cfg.final_voxel > 0 \
-            and cfg.outlier_nb > 0 \
-            and not (cfg.sample_after and cfg.sample_after > 1):
+    if mesh is not None and _full_postprocess(cfg):
         from structured_light_for_3d_model_replication_tpu.ops import (
             pointcloud_sharded as pcs,
         )
@@ -303,6 +301,16 @@ def _sample_every(p, c, every):
     return p, c
 
 
+def _full_postprocess(cfg: MergeConfig) -> bool:
+    """True when the config runs the full voxel->outlier chain with no
+    intermediate subsample — the shape both the fused (device-resident)
+    single-chip strategy and the slab-sharded multi-chip postprocess
+    accelerate; one predicate so their gates can't drift apart."""
+    return (bool(cfg.final_voxel and cfg.final_voxel > 0)
+            and cfg.outlier_nb > 0
+            and not (cfg.sample_after and cfg.sample_after > 1))
+
+
 def _postprocess_merged(points, colors, cfg: MergeConfig, tm: dict | None = None):
     """Final voxel/sample/outlier chain shared by both merge modes
     (processing.py:605-629)."""
@@ -318,10 +326,7 @@ def _postprocess_merged(points, colors, cfg: MergeConfig, tm: dict | None = None
     # prefix slice is sound because survivors occupy a contiguous slot
     # prefix (group segment ids ascend in key order; the invalid-sentinel
     # key sorts last — pinned by test_voxel_downsample_survivor_prefix).
-    fused = (jax.default_backend() != "cpu"
-             and bool(cfg.final_voxel and cfg.final_voxel > 0)
-             and cfg.outlier_nb > 0
-             and not (cfg.sample_after and cfg.sample_after > 1))
+    fused = jax.default_backend() != "cpu" and _full_postprocess(cfg)
     if cfg.final_voxel and cfg.final_voxel > 0:
         t0 = _time.perf_counter()
         p, c, v = pc.voxel_downsample(jnp.asarray(points), jnp.asarray(colors),
