@@ -157,9 +157,9 @@ class ParallelConfig:
     backend: str = "jax"    # 'jax' | 'numpy' (bit-exact CPU reference path)
     use_bf16_features: bool = True  # bf16 for feature/dist matmuls, fp32 accumulation
     # run the 360 merge over a device mesh (register_pairs_sharded + slab-
-    # sharded postprocess) whenever >1 device is attached; single-device
-    # hosts are unaffected. Ignored (with a log line) by
-    # merge.method='posegraph', whose global optimization is unsharded.
+    # sharded postprocess; for method='posegraph' the edge registrations
+    # shard and only the small host-side pose-graph solve stays local)
+    # whenever >1 device is attached; single-device hosts are unaffected
     merge_mesh: bool = False
 
 

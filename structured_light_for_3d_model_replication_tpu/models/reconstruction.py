@@ -273,25 +273,30 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
     t0 = _time.perf_counter()
     points = np.concatenate(merged_p)
     colors = np.concatenate(merged_c)
+    points, colors = _postprocess_dispatch(points, colors, cfg, tm, mesh, log)
+    tm["postprocess_s"] = round(_time.perf_counter() - t0, 3)
+    return points, colors, transforms
+
+
+def _postprocess_dispatch(points, colors, cfg: MergeConfig, tm, mesh, log):
+    """Slab-sharded postprocess over ``mesh`` when the config runs the full
+    voxel->outlier chain; the single-device pass otherwise (and as the
+    fallback when the cloud cannot slab)."""
     if mesh is not None and _full_postprocess(cfg):
         from structured_light_for_3d_model_replication_tpu.ops import (
             pointcloud_sharded as pcs,
         )
 
         try:
-            points, colors = pcs.postprocess_merged_sharded(
+            return pcs.postprocess_merged_sharded(
                 mesh, points, colors, None, float(cfg.final_voxel),
                 cfg.outlier_nb, cfg.outlier_std)
         except (ValueError, RuntimeError) as e:
             # cloud too thin / too wide to slab, or fallback-cap overflow:
             # the single-device pass is always correct, just unsharded
-            log(f"[merge_360] sharded postprocess unavailable ({e}); "
+            log(f"[merge] sharded postprocess unavailable ({e}); "
                 f"single-device pass")
-            points, colors = _postprocess_merged(points, colors, cfg, tm)
-    else:
-        points, colors = _postprocess_merged(points, colors, cfg, tm)
-    tm["postprocess_s"] = round(_time.perf_counter() - t0, 3)
-    return points, colors, transforms
+    return _postprocess_merged(points, colors, cfg, tm)
 
 
 def _sample_every(p, c, every):
@@ -363,11 +368,16 @@ def _postprocess_merged(points, colors, cfg: MergeConfig, tm: dict | None = None
 
 
 def merge_360_posegraph(clouds, cfg: MergeConfig | None = None, log=print,
-                        pg_iters: int = 20, step_callback=None):
+                        pg_iters: int = 20, step_callback=None, mesh=None):
     """Multiway pose-graph merge: the robust mode the reference keeps in its
     legacy layer (Old/360Merge.py:50-78 — sequential edges + a first<->last
     loop-closure edge, globally optimized with LM; Old/new360Merge.py adds the
     per-pair FPFH/RANSAC init this uses too).
+
+    ``mesh``: same multi-chip path as merge_360 — the edge registrations
+    (the dominant cost) shard across devices and the postprocess runs
+    slab-sharded; only the (small, host-side) pose-graph solve stays
+    unsharded.
 
     Returns (points, colors, transforms) with transforms[i] = world-from-view-i
     after global optimization (world = view 0).
@@ -380,12 +390,13 @@ def merge_360_posegraph(clouds, cfg: MergeConfig | None = None, log=print,
     voxel = float(cfg.voxel_size)
     n = len(clouds)
     if n < 3:
-        return merge_360(clouds, cfg, log=log, step_callback=step_callback)
+        return merge_360(clouds, cfg, log=log, step_callback=step_callback,
+                         mesh=mesh)
 
     preps = _preprocess_views(clouds, voxel, cfg.sample_before)
     # one launch: n-1 odometry edges (i-1 <- i) + the loop closure (0 <- n-1)
     T_all, gfit_all, ifit_all, irmse_all = _register_chain_batched(
-        preps, cfg, voxel, loop_closure=True)
+        preps, cfg, voxel, loop_closure=True, mesh=mesh)
 
     edges_i, edges_j, edge_T, edge_w = [], [], [], []
     init = [np.eye(4, dtype=np.float32)]
@@ -430,7 +441,7 @@ def merge_360_posegraph(clouds, cfg: MergeConfig | None = None, log=print,
             step_callback(i, merged_p, merged_c)
     points = np.concatenate(merged_p)
     colors = np.concatenate(merged_c)
-    points, colors = _postprocess_merged(points, colors, cfg)
+    points, colors = _postprocess_dispatch(points, colors, cfg, {}, mesh, log)
     return points, colors, transforms
 
 

@@ -299,22 +299,19 @@ def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
 
     mesh = None
     if cfg.parallel.merge_mesh:
-        if cfg.merge.method == "posegraph":
-            log("[merge] parallel.merge_mesh is ignored for "
-                "method='posegraph' (the pose-graph merge is unsharded)")
-        else:
-            from structured_light_for_3d_model_replication_tpu.parallel import (
-                mesh as meshlib,
-            )
+        from structured_light_for_3d_model_replication_tpu.parallel import (
+            mesh as meshlib,
+        )
 
-            mesh = meshlib.merge_mesh(cfg.parallel)
-            if mesh is not None:
-                log(f"[merge] sharding the chain over "
-                    f"{mesh.devices.size} devices (parallel.merge_mesh)")
+        mesh = meshlib.merge_mesh(cfg.parallel)
+        if mesh is not None:
+            log(f"[merge] sharding the chain over "
+                f"{mesh.devices.size} devices (parallel.merge_mesh)")
     with prof.trace():
         if cfg.merge.method == "posegraph":
             points, colors, transforms = recon.merge_360_posegraph(
-                clouds, cfg.merge, log=log, step_callback=step_callback)
+                clouds, cfg.merge, log=log, step_callback=step_callback,
+                mesh=mesh)
         else:
             points, colors, transforms = recon.merge_360(
                 clouds, cfg.merge, log=log, step_callback=step_callback,

@@ -254,6 +254,28 @@ def test_estimate_spacing_recovers_grid_pitch():
     assert abs(s - 2.5) < 0.26  # subsample stride may skip true neighbors
 
 
+def test_exact_outlier_default_auto_cell_on_accelerator(rng, monkeypatch):
+    # the accelerator large-N DEFAULT (approximate=False, no voxel hint):
+    # auto-estimated probe cell -> exact ring probe + chunked fallback —
+    # must remove the same outlier set as the cKDTree reference. Simulated
+    # accel dispatch: backend name patched, gate shrunk so 12k counts as
+    # "large" (the real gate needs 65k+ points, too slow for CPU CI).
+    import jax
+
+    pts = rng.uniform(0, 60, (12_000, 3)).astype(np.float32)
+    out = rng.uniform(180, 240, (25, 3)).astype(np.float32)
+    cloud = np.concatenate([pts, out]).astype(np.float32)
+    valid = np.ones(len(cloud), bool)
+    m_np = pc.statistical_outlier_mask_np(cloud, valid, 20, 2.0)
+
+    monkeypatch.setattr(knnlib, "_BRUTE_MAX", 4096)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    m_ex = np.asarray(pc.statistical_outlier_mask(
+        jnp.asarray(cloud), jnp.asarray(valid), 20, 2.0))
+    assert (m_ex != m_np).sum() <= 2  # f32-vs-f64 threshold ties only
+    assert not m_ex[len(pts):].any()  # all far outliers removed
+
+
 def test_voxelized_outlier_chunked_fallback_all_uncertified(rng):
     # a probe cell many times the true spacing packs 3+ occupants into every
     # cell -> zero rows certify -> the WHOLE cloud goes through the chunked

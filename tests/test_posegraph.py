@@ -1,6 +1,7 @@
 """SE(3) ops + pose-graph optimization: round trips, drift correction on a
 synthetic turntable loop, and the posegraph merge mode (Old/360Merge.py
 capability)."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -118,3 +119,14 @@ def test_merge_360_posegraph_closes_the_loop(rng):
     assert len(pts) == len(cols)
     d = rec.chamfer_distance(pts[:20000], clouds[0][0])
     assert d < 4.0, d
+
+    # mesh route: edge registrations sharded over the 8-virtual-device
+    # mesh, pose-graph solve host-side — same surface
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("pairs",))
+    pts_m, _, T_m = rec.merge_360_posegraph(clouds, cfg, log=lambda *a: None,
+                                            mesh=mesh)
+    assert len(T_m) == 4
+    d_m = rec.chamfer_distance(pts_m[:20000], clouds[0][0])
+    assert d_m < 4.0, d_m
