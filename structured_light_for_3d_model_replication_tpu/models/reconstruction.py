@@ -195,9 +195,12 @@ def _register_chain_batched(preps, cfg: MergeConfig, voxel: float,
               icp_max_dist=voxel * float(cfg.icp_dist_ratio),
               trials=cfg.ransac_trials, icp_iters=cfg.icp_iters)
     if mesh is not None:
-        T, gfit, ifit, irmse = reg.register_pairs_sharded(mesh, *args, **kw)
+        out = reg.register_pairs_sharded(mesh, *args, **kw)
     else:
-        T, gfit, ifit, irmse = reg.register_pairs(*args, **kw)
+        out = reg.register_pairs(*args, **kw)
+    # ONE gathered transfer for all four results (separate np.asarray calls
+    # are four round trips on a tunneled device)
+    T, gfit, ifit, irmse = jax.device_get(out)
     return (np.asarray(T, np.float32), np.asarray(gfit, np.float32),
             np.asarray(ifit, np.float32), np.asarray(irmse, np.float32))
 
@@ -334,8 +337,11 @@ def _postprocess_merged(points, colors, cfg: MergeConfig, tm: dict | None = None
     fused = jax.default_backend() != "cpu" and _full_postprocess(cfg)
     if cfg.final_voxel and cfg.final_voxel > 0:
         t0 = _time.perf_counter()
-        p, c, v = pc.voxel_downsample(jnp.asarray(points), jnp.asarray(colors),
-                                      jnp.asarray(valid), float(cfg.final_voxel))
+        # RAW numpy in: voxel_downsample's dispatch then reads the grid
+        # extent on the host instead of probing the device (one fewer
+        # round-trip sync before the launch)
+        p, c, v = pc.voxel_downsample(np.asarray(points), np.asarray(colors),
+                                      valid, float(cfg.final_voxel))
         if fused:
             n_keep = int(np.asarray(v.sum()))
             n_pad = min(-(-max(n_keep, 1) // 8192) * 8192, p.shape[0])
