@@ -24,13 +24,24 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--register", action="store_true",
                     help="also sweep register_pairs trial/ICP knobs")
+    ap.add_argument("--postprocess-ab", action="store_true",
+                    help="A/B the postprocess compaction strategies on the "
+                         "merged cloud: device-resident prefix slice vs "
+                         "host compact-between-stages (the round-4 "
+                         "transfer-trim hypothesis)")
     ap.add_argument("--runs", type=int, default=3)
     ap.add_argument("--trials", type=int, default=2048,
                     help="ransac_trials for the merge runs (bench uses 2048; "
                          "the library default is 4096)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the cpu platform (smoke/debug; the env var "
+                         "alone loses to this box's sitecustomize)")
     args = ap.parse_args()
 
     import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     jax.config.update("jax_compilation_cache_dir",
@@ -56,13 +67,51 @@ def main() -> None:
     print(f"backend={jax.default_backend()} views={len(clouds)}")
 
     mcfg = MergeConfig(ransac_trials=args.trials)
+    merged_raw = None
     for it in range(args.runs):
         tm: dict = {}
         t0 = time.perf_counter()
         p, c, T = rec.merge_360(clouds, cfg=mcfg, log=lambda m: None,
                                 timings=tm)
         print(f"run{it}: {time.perf_counter() - t0:.3f}s stages={tm} "
-              f"pts={len(p)}")
+              f"pts={len(p)}", flush=True)
+
+    if args.postprocess_ab:
+        # rebuild the pre-postprocess merged cloud once, then time both
+        # strategies on the identical input
+        pre = rec._preprocess_views(clouds, float(mcfg.voxel_size), 0)
+        T_all, *_ = rec._register_chain_batched(pre, mcfg,
+                                                float(mcfg.voxel_size),
+                                                loop_closure=False)
+        acc = np.eye(4, dtype=np.float32)
+        parts = [np.asarray(clouds[0][0], np.float32)]
+        for i in range(1, len(clouds)):
+            acc = (acc @ T_all[i - 1]).astype(np.float32)
+            parts.append(np.asarray(clouds[i][0], np.float32)
+                         @ acc[:3, :3].T + acc[:3, 3])
+        merged_raw = np.concatenate(parts).astype(np.float32)
+        cols_raw = np.concatenate([c for _, c in clouds]).astype(np.uint8)
+        # isolate ONLY the compaction strategy: patching the fusion gate
+        # keeps the outlier op on its real accelerator dispatch (faking the
+        # backend name instead would reroute it onto the host-only grid
+        # engine — which raises on accelerators for crash-safety)
+        real_gate = rec._full_postprocess
+        for label, gate in (("device-resident", real_gate),
+                            ("host-compact", lambda cfg: False)):
+            rec._full_postprocess = gate
+            try:
+                best = np.inf
+                for _ in range(max(args.runs, 2)):
+                    tm2: dict = {}
+                    t0 = time.perf_counter()
+                    pp, _ = rec._postprocess_merged(merged_raw.copy(),
+                                                    cols_raw.copy(), mcfg,
+                                                    tm2)
+                    best = min(best, time.perf_counter() - t0)
+                print(f"postprocess[{label}]: best {best:.3f}s stages={tm2} "
+                      f"pts={len(pp)}", flush=True)
+            finally:
+                rec._full_postprocess = real_gate
 
     if not args.register:
         return
