@@ -117,12 +117,19 @@ def _features_views_jit(pts_v, valid_v, feat_radius):
             jnp.concatenate([o[1] for o in outs]))
 
 
-def _preprocess_views(clouds, voxel: float, sample_before: int):
+def _preprocess_views(clouds, voxel: float, sample_before: int,
+                      keep_raw: bool = False):
     """Preprocess every view to ONE fixed padded size: per-view voxel
     downsample (one reused executable) + host compaction, then stacked
     normals+FPFH. A single pad size means a single compile for every
     downstream per-pair stage — the round-2 chain re-jitted whenever
-    consecutive views straddled a 2048 bucket boundary (verdict weak #7)."""
+    consecutive views straddled a 2048 bucket boundary (verdict weak #7).
+
+    ``keep_raw``: also return the raw padded view uploads as device stacks
+    ([V, n_raw, 3] f32, [V, n_raw] bool) — the device-accumulate path
+    reuses them so the transformed merged cloud never round-trips the
+    host (only meaningful when sample_before <= 1, i.e. sampled == full).
+    Returns preps, or (preps, (raw_pts, raw_valid)) with keep_raw."""
     sampled = []
     for p_full, c_full in clouds:
         sampled.append(_sample_every(np.asarray(p_full, np.float32),
@@ -138,6 +145,7 @@ def _preprocess_views(clouds, voxel: float, sample_before: int):
     chunk = max(1, min(n_views, (8 << 20) // n_raw))  # <= ~100 MB f32 points
     views_p = []      # device-resident voxelized views (no 14 MB D2H+H2D:
     counts = []       # on a tunneled chip those round trips are network time)
+    raw_chunks = []
     for s in range(0, n_views, chunk):
         part = sampled[s:s + chunk]
         pts = np.full((chunk, n_raw, 3), 1e9, np.float32)
@@ -145,7 +153,11 @@ def _preprocess_views(clouds, voxel: float, sample_before: int):
         for k, (p_s, _) in enumerate(part):
             pts[k, :len(p_s)] = p_s
             valid[k, :len(p_s)] = True
-        p_all, v_all = _voxel_views_jit(jnp.asarray(pts), jnp.asarray(valid),
+        pts_dev = jnp.asarray(pts)
+        valid_dev = jnp.asarray(valid)
+        if keep_raw:
+            raw_chunks.append((pts_dev, valid_dev, len(part)))
+        p_all, v_all = _voxel_views_jit(pts_dev, valid_dev,
                                         jnp.float32(voxel))
         # survivor COUNTS are the only host transfer (survivors occupy a
         # contiguous slot prefix — test_voxel_downsample_survivor_prefix);
@@ -171,8 +183,13 @@ def _preprocess_views(clouds, voxel: float, sample_before: int):
                > jnp.arange(n_pad, dtype=jnp.int32)[None, :])
     nr_all, feat_all = _features_views_jit(p_stack, v_stack,
                                            jnp.float32(5.0 * voxel))
-    return [_Prep(p_stack[i], v_stack[i], nr_all[i], feat_all[i])
-            for i in range(n_views)]
+    preps = [_Prep(p_stack[i], v_stack[i], nr_all[i], feat_all[i])
+             for i in range(n_views)]
+    if keep_raw:
+        raw_p = jnp.concatenate([p[:k] for p, _, k in raw_chunks])
+        raw_v = jnp.concatenate([v[:k] for _, v, k in raw_chunks])
+        return preps, (raw_p, raw_v)
+    return preps
 
 
 def _register_chain_batched(preps, cfg: MergeConfig, voxel: float,
@@ -241,8 +258,27 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
         points, colors = _postprocess_merged(merged_p[0], merged_c[0], cfg)
         return points, colors, transforms
 
+    # device accumulate: when nothing needs the per-step host clouds (no
+    # preview callback) and the full postprocess chain follows on this
+    # device, the raw per-view uploads from preprocess are reused — the
+    # transformed merged cloud never round-trips the host (~12 MB of f32
+    # saved per merge on a tunneled chip)
+    n_raw_est = -(-max(len(p) for p, _ in clouds) // 8192) * 8192
+    n_actual = sum(len(p) for p, _ in clouds)
+    device_acc = (mesh is None and step_callback is None
+                  and jax.default_backend() != "cpu"
+                  and (not cfg.sample_before or cfg.sample_before <= 1)
+                  and _full_postprocess(cfg)
+                  # HBM bound: the retained raw stack (+ its transformed
+                  # copy) must stay small next to device memory, and the
+                  # padded slot count must not balloon the postprocess
+                  # sort when view sizes are uneven
+                  and n * n_raw_est * 12 <= (1 << 30)
+                  and n_actual >= 0.5 * n * n_raw_est)
     t0 = _time.perf_counter()
-    preps = _preprocess_views(clouds, voxel, cfg.sample_before)
+    pre = _preprocess_views(clouds, voxel, cfg.sample_before,
+                            keep_raw=device_acc)
+    preps, raw = pre if device_acc else (pre, None)
     tm["preprocess_s"] = round(_time.perf_counter() - t0, 3)
     t0 = _time.perf_counter()
     T_all, gfit_all, ifit_all, irmse_all = _register_chain_batched(
@@ -262,6 +298,8 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
             f"rmse {float(irmse_all[i - 1]):.3f}")
         t_accum = (t_accum @ T_all[i - 1]).astype(np.float32)
         transforms.append(t_accum.copy())
+        if device_acc:
+            continue
         cur_p_full = np.asarray(clouds[i][0], np.float32)
         moved = cur_p_full @ t_accum[:3, :3].T + t_accum[:3, 3]
         merged_p.append(moved.astype(np.float32))
@@ -271,17 +309,39 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
             # previews/strides (acquire.viewer.StageRecorder) stays O(V) per
             # step instead of re-copying the whole merged cloud every step
             step_callback(i, merged_p, merged_c)
+    if device_acc:
+        raw_p, raw_v = raw
+        Ts = jnp.asarray(np.stack(transforms))          # [V, 4, 4] tiny H2D
+        moved = _accumulate_views_jit(raw_p, Ts)        # one launch
+        points = moved.reshape(-1, 3)
+        valid_flat = raw_v.reshape(-1)
+        cols = np.zeros((n, raw_p.shape[1], 3), np.uint8)
+        for i, (_, c_full) in enumerate(clouds):
+            cols[i, :len(c_full)] = np.asarray(c_full, np.uint8)
+        colors = jnp.asarray(cols).reshape(-1, 3)
     tm["accumulate_s"] = round(_time.perf_counter() - t0, 3)
 
     t0 = _time.perf_counter()
-    points = np.concatenate(merged_p)
-    colors = np.concatenate(merged_c)
-    points, colors = _postprocess_dispatch(points, colors, cfg, tm, mesh, log)
+    if not device_acc:
+        points = np.concatenate(merged_p)
+        colors = np.concatenate(merged_c)
+        valid_flat = None
+    points, colors = _postprocess_dispatch(points, colors, cfg, tm, mesh, log,
+                                           valid=valid_flat)
     tm["postprocess_s"] = round(_time.perf_counter() - t0, 3)
     return points, colors, transforms
 
 
-def _postprocess_dispatch(points, colors, cfg: MergeConfig, tm, mesh, log):
+@jax.jit
+def _accumulate_views_jit(raw_p, Ts):
+    """Apply per-view accumulated transforms on device: the host loop's
+    matmuls as one vmapped launch, reusing registration's transform_points
+    (single source of truth for the HIGHEST-precision pin)."""
+    return jax.vmap(reg.transform_points)(Ts, raw_p)
+
+
+def _postprocess_dispatch(points, colors, cfg: MergeConfig, tm, mesh, log,
+                          valid=None):
     """Slab-sharded postprocess over ``mesh`` when the config runs the full
     voxel->outlier chain; the single-device pass otherwise (and as the
     fallback when the cloud cannot slab)."""
@@ -292,14 +352,14 @@ def _postprocess_dispatch(points, colors, cfg: MergeConfig, tm, mesh, log):
 
         try:
             return pcs.postprocess_merged_sharded(
-                mesh, points, colors, None, float(cfg.final_voxel),
+                mesh, points, colors, valid, float(cfg.final_voxel),
                 cfg.outlier_nb, cfg.outlier_std)
         except (ValueError, RuntimeError) as e:
             # cloud too thin / too wide to slab, or fallback-cap overflow:
             # the single-device pass is always correct, just unsharded
             log(f"[merge] sharded postprocess unavailable ({e}); "
                 f"single-device pass")
-    return _postprocess_merged(points, colors, cfg, tm)
+    return _postprocess_merged(points, colors, cfg, tm, valid=valid)
 
 
 def _sample_every(p, c, every):
@@ -319,13 +379,17 @@ def _full_postprocess(cfg: MergeConfig) -> bool:
             and not (cfg.sample_after and cfg.sample_after > 1))
 
 
-def _postprocess_merged(points, colors, cfg: MergeConfig, tm: dict | None = None):
+def _postprocess_merged(points, colors, cfg: MergeConfig,
+                        tm: dict | None = None, valid=None):
     """Final voxel/sample/outlier chain shared by both merge modes
-    (processing.py:605-629)."""
+    (processing.py:605-629). ``points``/``colors`` may be host or device
+    arrays (the device-accumulate path hands over padded device stacks
+    with their ``valid`` mask)."""
     import time as _time
 
     tm = tm if tm is not None else {}
-    valid = np.ones(len(points), bool)
+    if valid is None:
+        valid = np.ones(len(points), bool)
     # one stage sequence, two compaction strategies: on accelerators the
     # cloud stays DEVICE-RESIDENT between the voxel pass and the outlier
     # probe (prefix-slice compaction, one scalar sync) — the host-compact
@@ -337,10 +401,15 @@ def _postprocess_merged(points, colors, cfg: MergeConfig, tm: dict | None = None
     fused = jax.default_backend() != "cpu" and _full_postprocess(cfg)
     if cfg.final_voxel and cfg.final_voxel > 0:
         t0 = _time.perf_counter()
-        # RAW numpy in: voxel_downsample's dispatch then reads the grid
-        # extent on the host instead of probing the device (one fewer
-        # round-trip sync before the launch)
-        p, c, v = pc.voxel_downsample(np.asarray(points), np.asarray(colors),
+        # host arrays stay numpy so voxel_downsample's dispatch reads the
+        # grid extent on the host (no probe sync); device-resident input
+        # (the device-accumulate path) must NOT be np.asarray'd — that
+        # would pull the whole cloud down, the very transfer this avoids
+        pts_in = points if isinstance(points, jax.Array) else \
+            np.asarray(points)
+        cols_in = colors if isinstance(colors, jax.Array) else \
+            np.asarray(colors)
+        p, c, v = pc.voxel_downsample(pts_in, cols_in,
                                       valid, float(cfg.final_voxel))
         if fused:
             n_keep = int(np.asarray(v.sum()))

@@ -125,6 +125,44 @@ def test_postprocess_fused_accel_path_matches_compacting_path(rng, monkeypatch):
     assert len(p_fus) == len(c_fus)
 
 
+def test_merge_device_accumulate_matches_host_path(rng, monkeypatch):
+    """The device-accumulate route (raw uploads reused, transforms applied
+    on device, postprocess fed device stacks) must keep the same merged
+    set as the host accumulate loop."""
+    import jax
+
+    from structured_light_for_3d_model_replication_tpu.config import MergeConfig
+
+    base = _rand_cloud(rng, 6000)
+    clouds = []
+    for ang in [0, 15, 30]:
+        Rw = np.asarray(syn.rotate_y(ang), np.float32)
+        world = _transform(Rw, np.zeros(3, np.float32), base)
+        vis = world[:, 2] < np.percentile(world[:, 2], 70)
+        clouds.append((world[vis].astype(np.float32),
+                       np.full((int(vis.sum()), 3), 128, np.uint8)))
+    cfg = MergeConfig(voxel_size=2.0, ransac_trials=1024, icp_iters=15,
+                      final_voxel=1.0, outlier_nb=10)
+
+    p_host, c_host, T_h = rec.merge_360(clouds, cfg, log=lambda *a: None)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    called = []
+    orig_acc = rec._accumulate_views_jit
+    monkeypatch.setattr(rec, "_accumulate_views_jit",
+                        lambda *a: (called.append(1), orig_acc(*a))[1])
+    p_dev, c_dev, T_d = rec.merge_360(clouds, cfg, log=lambda *a: None)
+    assert called, "device-accumulate path did not activate"
+
+    # registration is identical (same seed/code) -> transforms match...
+    np.testing.assert_allclose(np.stack(T_d), np.stack(T_h), atol=1e-5)
+    # ...and the merged SETS agree up to f32 transform/threshold ties
+    hs = {tuple(np.round(r, 3)) for r in p_host}
+    ds = {tuple(np.round(r, 3)) for r in p_dev}
+    assert len(hs ^ ds) <= max(4, len(hs) // 200), (len(hs), len(ds),
+                                                    len(hs ^ ds))
+    assert len(p_dev) == len(c_dev)
+
+
 def test_chamfer_identical_is_zero(rng):
     a = _rand_cloud(rng, 2000)
     assert rec.chamfer_distance(a, a) < 1e-3
