@@ -41,10 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax layout
-    from jax.experimental.shard_map import shard_map
+from structured_light_for_3d_model_replication_tpu.utils.jax_compat import shard_map
 
 from structured_light_for_3d_model_replication_tpu.ops import pointcloud as pc
 

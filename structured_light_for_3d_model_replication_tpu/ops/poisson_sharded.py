@@ -21,8 +21,11 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (
+    shard_map_unchecked,
+)
 
 from structured_light_for_3d_model_replication_tpu.ops.poisson import (
     PoissonResult,
@@ -175,11 +178,10 @@ def poisson_solve_sharded(points, normals, valid=None, depth: int = 10,
 
     spec_grid = P(_AXIS, None, None)
 
-    @functools.partial(
-        shard_map, mesh=mesh,
+    @shard_map_unchecked(
+        mesh=mesh,
         in_specs=(P(), P(), P()),
         out_specs=(spec_grid, spec_grid),
-        check_rep=False,
     )
     def solve(pts, nrm, w):
         zi = jax.lax.axis_index(_AXIS)

@@ -698,10 +698,10 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
     duplicate rows, which are dropped from the returned arrays.
     """
     from jax.sharding import PartitionSpec
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover - older jax layout
-        from jax.experimental.shard_map import shard_map
+
+    from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (
+        shard_map,
+    )
 
     p = src_pts.shape[0]
     n_dev = int(np.prod(list(mesh.shape.values())))

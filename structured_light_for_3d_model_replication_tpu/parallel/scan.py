@@ -12,7 +12,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+from structured_light_for_3d_model_replication_tpu.utils.jax_compat import shard_map
 
 from structured_light_for_3d_model_replication_tpu.ops.graycode import _decode_impl
 from structured_light_for_3d_model_replication_tpu.ops.triangulate import (

@@ -1,0 +1,29 @@
+"""jax API-layout compatibility shims shared by the sharded ops.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to ``jax.shard_map``
+(deprecated in jax 0.8, removed later), and its replication-checking kwarg
+was renamed ``check_rep`` -> ``check_vma`` in the same move. Centralising
+the shim here keeps the four call sites (ops/registration,
+ops/pointcloud_sharded, ops/poisson_sharded, parallel/scan) from drifting.
+"""
+from __future__ import annotations
+
+import functools
+
+try:
+    from jax import shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover - older jax layout
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+    _CHECK_KW = "check_rep"
+
+__all__ = ["shard_map", "shard_map_unchecked"]
+
+
+def shard_map_unchecked(**kwargs):
+    """``shard_map`` decorator with replication/VMA checking disabled,
+    under whichever kwarg name this jax spells it."""
+    kwargs[_CHECK_KW] = False
+    return functools.partial(shard_map, **kwargs)

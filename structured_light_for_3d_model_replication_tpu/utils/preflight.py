@@ -14,16 +14,29 @@ import sys
 
 __all__ = ["accelerator_preflight"]
 
-_PROBE = "import jax; print(jax.default_backend())"
+# init AND execute: a wedged tunnel has two hang signatures — PJRT client
+# creation blocking forever (round-3 incidents), and client init succeeding
+# while the first device execution stalls with zero socket traffic (round-4
+# incident, 2026-07-31: two probes passed, then the smoke run sat 28 min at
+# 0 CPU inside its first compile). Running one tiny op catches both; on a
+# healthy tunnel it adds ~1-2 s.
+_PROBE = """\
+import jax
+b = jax.default_backend()
+if b != "cpu":
+    import jax.numpy as jnp
+    jax.block_until_ready(jnp.add(jnp.float32(1), jnp.float32(1)))
+print(b)
+"""
 
 
 def accelerator_preflight(timeout: float = 180.0, cwd: str | None = None
                           ) -> tuple[str, str]:
-    """Probe the ambient jax backend in a subprocess.
+    """Probe the ambient jax backend (init + one device op) in a subprocess.
 
     Returns (status, detail): status is ``"ok"`` (detail = backend name),
-    ``"hung"`` (init exceeded ``timeout``), or ``"failed"`` (nonzero exit;
-    detail = stderr tail).
+    ``"hung"`` (init or first execution exceeded ``timeout``), or
+    ``"failed"`` (nonzero exit; detail = stderr tail).
     """
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
@@ -32,7 +45,8 @@ def accelerator_preflight(timeout: float = 180.0, cwd: str | None = None
                                capture_output=True, text=True,
                                timeout=timeout, env=env, cwd=cwd)
     except subprocess.TimeoutExpired:
-        return "hung", f"backend init exceeded {timeout:.0f}s (tunnel wedged?)"
+        return "hung", (f"backend init/exec exceeded {timeout:.0f}s "
+                        f"(tunnel wedged?)")
     if probe.returncode != 0:
         return "failed", (probe.stderr or "")[-300:]
     lines = (probe.stdout or "").strip().splitlines()

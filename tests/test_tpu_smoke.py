@@ -157,9 +157,9 @@ print(json.dumps(out))
 def test_flagship_paths_on_accelerator():
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    # fast preflight: a wedged accelerator tunnel hangs INSIDE backend init
-    # (observed: PJRT client creation blocking indefinitely when the pool
-    # lost a killed client's grant) — skip rather than stall the suite
+    # fast preflight: a wedged accelerator tunnel hangs inside backend init
+    # OR inside the first device execution (both signatures observed; the
+    # shared probe runs init + one tiny op) — skip rather than stall
     from structured_light_for_3d_model_replication_tpu.utils.preflight import (
         accelerator_preflight,
     )

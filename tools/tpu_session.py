@@ -43,20 +43,32 @@ def log(msg: str) -> None:
 def run_step(name: str, cmd, limit: int) -> tuple[int, str]:
     log(f"step {name}: {' '.join(cmd)} (limit {limit}s)")
     t0 = time.time()
+    # own session/process GROUP: a limit-kill must take down the step's
+    # whole tree — killing only the direct child (e.g. pytest) orphans the
+    # TPU-client grandchild it spawned, which then holds a claim while the
+    # watcher starts new clients: the documented concurrent-client wedge
+    import signal
+
+    proc = subprocess.Popen(cmd, cwd=ROOT, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
     try:
-        proc = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True,
-                              timeout=limit)
+        out, err = proc.communicate(timeout=limit)
         rc = proc.returncode
-    except subprocess.TimeoutExpired as e:
-        log(f"step {name} EXCEEDED {limit}s — killed (tunnel may be "
-            f"re-wedged; stop the session and re-probe before retrying)")
-        out = (e.stdout or b"")
-        return -9, out.decode() if isinstance(out, bytes) else str(out)
+    except subprocess.TimeoutExpired:
+        log(f"step {name} EXCEEDED {limit}s — killing its process group "
+            f"(tunnel may be re-wedged; re-probe before retrying)")
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        out, err = proc.communicate()
+        return -9, out or ""
     log(f"step {name}: rc={rc} in {time.time() - t0:.0f}s")
-    tail = (proc.stderr or "")[-2000:]
+    tail = (err or "")[-2000:]
     if tail:
         print(tail, file=sys.stderr, flush=True)
-    return rc, proc.stdout or ""
+    return rc, out or ""
 
 
 def main() -> None:
@@ -66,6 +78,9 @@ def main() -> None:
     ap.add_argument("--round", type=int, default=4,
                     help="round number for the BENCH_SELF_r<N>.json record")
     ap.add_argument("--skip-smoke", action="store_true")
+    ap.add_argument("--skip-preflight", action="store_true",
+                    help="caller (tools/tpu_watch.py) just probed; don't "
+                         "spend a second claim cycle re-probing")
     args = ap.parse_args()
 
     sys.path.insert(0, ROOT)
@@ -73,19 +88,30 @@ def main() -> None:
         accelerator_preflight,
     )
 
-    status, detail = accelerator_preflight()
-    log(f"preflight: {status} ({detail})")
-    if status != "ok":
-        sys.exit(f"tunnel not healthy ({status}) — not starting any TPU work")
+    if not args.skip_preflight:
+        status, detail = accelerator_preflight()
+        log(f"preflight: {status} ({detail})")
+        if status != "ok":
+            sys.exit(f"tunnel not healthy ({status}) — not starting any "
+                     f"TPU work")
 
     steps = [s for s in STEPS if args.step is None or s[0] == args.step]
     if args.skip_smoke:
         steps = [s for s in steps if s[0] != "smoke"]
     bench_line = None
-    for name, cmd, limit in steps:
+    aborted = False
+    for i, (name, cmd, limit) in enumerate(steps):
+        if i > 0:
+            # the previous step exited; confirm the tunnel still answers
+            # (init + one op) before opening the next claim
+            status, detail = accelerator_preflight()
+            log(f"inter-step preflight: {status} ({detail})")
+            if status != "ok":
+                log(f"tunnel unhealthy before step {name} — aborting the "
+                    f"rest of the session")
+                aborted = True
+                break
         rc, out = run_step(name, cmd, limit)
-        if name != "bench":
-            print(out[-4000:], flush=True)
         if name == "bench" and rc == 0:
             for line in reversed(out.strip().splitlines()):
                 try:
@@ -93,6 +119,20 @@ def main() -> None:
                     break
                 except json.JSONDecodeError:
                     continue
+        if name != "bench" or rc != 0 or bench_line is None:
+            # always keep the step's tail in the session log — a failed
+            # bench during a rare recovery window is exactly when its
+            # stdout matters most
+            print(out[-4000:], flush=True)
+        if rc == -9:
+            # a step that had to be KILLED at its limit means the tunnel
+            # stalled mid-claim; every further step would stall the same
+            # way (round-4 lesson: smoke sat 28 min at zero I/O while the
+            # chain was set to push on regardless)
+            log(f"step {name} was killed at its limit — aborting the "
+                f"session; re-probe before any new TPU work")
+            aborted = True
+            break
         if rc != 0 and name == "smoke":
             log("smoke failed — continuing to measurements anyway (their "
                 "provenance fields tell the real story)")
@@ -109,6 +149,12 @@ def main() -> None:
             f"error={bench_line.get('error')}")
         print(json.dumps(bench_line), flush=True)
     log("session done")
+    # exit status is the contract with tools/tpu_watch.py: only a session
+    # that produced a bench record counts as complete — an aborted chain
+    # exiting 0 would stop the watcher with nothing captured
+    want_bench = args.step in (None, "bench")
+    if aborted or (want_bench and bench_line is None):
+        sys.exit(3)
 
 
 if __name__ == "__main__":
