@@ -70,6 +70,56 @@ def test_ransac_global_registration_large_rotation(rng):
     assert np.median(err) < 5.0, np.median(err)
 
 
+def test_ransac_2048_trials_on_low_overlap_pair(rng):
+    """Second validation scene for the trials default (ADVICE r3): the 2048
+    default was picked on the bench scene's high-overlap chain pairs; this
+    pair shares well under half its surface, the regime the advisor warned
+    could regress vs the reference's 100k-trial early-stop loop
+    (sl_system.py RANSAC semantics). 2048 must still register it, and must
+    not land meaningfully below 4096 on the same pair."""
+    base = _rand_cloud(rng, 3000)
+    views = []
+    for ang in [0.0, 85.0]:
+        Rw = np.asarray(syn.rotate_y(ang), np.float32)
+        world = _transform(Rw, np.zeros(3, np.float32), base)
+        # tighter cut than the merge test (40th pct) + an 85-degree step:
+        # the two front-facing crescents share ~40% of their points
+        vis = world[:, 2] < np.percentile(world[:, 2], 40)
+        views.append((world[vis] + rng.normal(0, 0.05, (int(vis.sum()), 3))
+                      .astype(np.float32), vis))
+    (dst, vis0), (src, vis1) = views
+    overlap = float((vis0 & vis1).sum() / min(vis0.sum(), vis1.sum()))
+    assert overlap < 0.5, f"scene not low-overlap enough ({overlap:.2f})"
+
+    fits = {}
+    for trials in (2048, 4096):
+        vd = jnp.ones(len(dst), bool)
+        vs_ = jnp.ones(len(src), bool)
+        nd = nrmlib.estimate_normals(jnp.asarray(dst), vd, 20)
+        ns_ = nrmlib.estimate_normals(jnp.asarray(src), vs_, 20)
+        fd = reg.fpfh_features(jnp.asarray(dst), nd, vd, radius=12.0, k=48)
+        fs = reg.fpfh_features(jnp.asarray(src), ns_, vs_, radius=12.0, k=48)
+        res = reg.ransac_global_registration(src, fs, None, dst, fd, None,
+                                             max_dist=5.0, trials=trials)
+        fits[trials] = float(res.fitness)
+        # production shape (register_pairs): the global pose seeds ICP;
+        # what must survive low overlap is the REFINED alignment
+        nd_o = nrmlib.orient_normals(jnp.asarray(dst), nd, vd)
+        icp = reg.icp_point_to_plane(src, None, dst, None, nd_o,
+                                     init_transform=res.transform,
+                                     max_dist=5.0, iters=30)
+        T = np.asarray(icp.transform)
+        moved = _transform(T[:3, :3], T[:3, 3], src)
+        # the refined pose must put the shared sliver back on the dst
+        # surface: nearest-dst distance over the best-aligned 40%
+        d = np.linalg.norm(moved[:, None, :] - dst[None, :, :], axis=-1)
+        nn = d.min(axis=1)
+        k40 = int(0.4 * len(nn))
+        assert np.median(np.sort(nn)[:k40]) < 1.0, trials
+    assert fits[2048] > 0.2, fits
+    assert fits[2048] > fits[4096] - 0.1, fits
+
+
 def test_merge_360_recovers_turntable_poses(rng):
     """Four 90-degree turntable views of a lumpy object with partial overlap:
     the merged cloud must lie on the view-0 surface (low Chamfer to it)."""

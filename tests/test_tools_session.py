@@ -1,0 +1,99 @@
+"""TPU session/watch tooling: the pieces whose failure loses a recovery
+window — the whole-tree limit-kill (an orphaned device client mid-claim is
+the documented tunnel-wedge trigger) and the clean-bench-line guard (a
+degraded line written as BENCH_SELF would later be cited as the clean
+first-party TPU record)."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_session_module():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_session", os.path.join(_ROOT, "tools", "tpu_session.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def session():
+    return _load_session_module()
+
+
+def test_parse_clean_bench_line_accepts_clean_tpu(session):
+    line = {"metric": "m", "value": 1.9, "backend": "tpu", "error": None}
+    out = "noise\n" + json.dumps(line) + "\n"
+    assert session.parse_clean_bench_line(out, log=lambda m: None) == line
+
+
+def test_parse_clean_bench_line_rejects_cpu_fallback(session):
+    msgs = []
+    line = {"value": 106.0, "backend": "cpu",
+            "error": "ambient child failed; cpu fallback"}
+    out = json.dumps(line)
+    assert session.parse_clean_bench_line(out, log=msgs.append) is None
+    assert any("degraded" in m for m in msgs)
+
+
+def test_parse_clean_bench_line_rejects_errored_tpu(session):
+    line = {"value": 3.5, "backend": "tpu", "error": "parent deadline hit"}
+    assert session.parse_clean_bench_line(
+        json.dumps(line), log=lambda m: None) is None
+
+
+def test_parse_clean_bench_line_handles_garbage(session):
+    assert session.parse_clean_bench_line("", log=lambda m: None) is None
+    assert session.parse_clean_bench_line("no json here",
+                                          log=lambda m: None) is None
+    # a bare number parses as JSON but is not a record
+    assert session.parse_clean_bench_line("3.553", log=lambda m: None) is None
+
+
+def test_run_step_limit_kill_takes_down_grandchild(session, tmp_path):
+    """The limit-kill must clear the step's whole process tree: killing only
+    the direct child orphans the device-client grandchild it spawned, which
+    then holds a pool claim concurrently with the watcher's next probe."""
+    pidfile = tmp_path / "grandchild.pid"
+    prog = (
+        "import subprocess, sys, time\n"
+        "p = subprocess.Popen([sys.executable, '-c', 'import time; "
+        "time.sleep(60)'])\n"
+        f"open({str(pidfile)!r}, 'w').write(str(p.pid))\n"
+        "time.sleep(60)\n"
+    )
+    t0 = time.time()
+    rc, _ = session.run_step("t", [sys.executable, "-c", prog], limit=3)
+    assert rc == -9
+    assert time.time() - t0 < 30
+    # the grandchild must be gone (or a zombie about to be reaped), not
+    # running: signal 0 probes existence
+    gpid = int(pidfile.read_text())
+    for _ in range(50):
+        try:
+            os.kill(gpid, 0)
+        except ProcessLookupError:
+            break
+        # may exist briefly as a zombie owned by init; confirm it is not
+        # actually running
+        stat = subprocess.run(["ps", "-o", "stat=", "-p", str(gpid)],
+                              capture_output=True, text=True).stdout.strip()
+        if not stat or stat.startswith("Z"):
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail(f"grandchild {gpid} still alive after group kill")
+
+
+def test_run_step_normal_completion(session):
+    rc, out = session.run_step(
+        "t", [sys.executable, "-c", "print('hello')"], limit=30)
+    assert rc == 0
+    assert "hello" in out

@@ -5,12 +5,15 @@ BENCH_NOTES.md).
 
 Sequence (each step is a subprocess that fully exits before the next):
   1. preflight probe (3 min bound) — abort politely if the tunnel is wedged
-  2. accelerator smoke test (pytest tests/test_tpu_smoke.py) — every device
-     path at real shapes, incl. the voxelized outlier probe and the
-     bitexact-on-device record
+  2. python bench.py — the full record line, FIRST: recovery windows can
+     close at any moment, and the bench record is the artifact that
+     matters; it exercises the whole pipeline with per-phase provenance
+     and its own CPU-fallback child, so it doubles as the smoke run
   3. tools/profile_merge.py --register — per-stage merge timings + the
      trial/ICP sweep (the round-3 wedge-window optimizations, re-measured)
-  4. python bench.py — the full record line
+  4. accelerator smoke test (pytest tests/test_tpu_smoke.py) — every device
+     path at real shapes, incl. the voxelized outlier probe and the
+     bitexact-on-device record
   5. write BENCH_SELF_r<N>.json from the bench line
 
 Timeouts are deliberately FAR above expected runtimes (the wedge lesson:
@@ -26,13 +29,14 @@ import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# expected wall ~3-8 min each on a warm cache; limits are 4-10x that
+# expected wall ~3-8 min each on a warm cache; limits are 4-10x that.
+# bench FIRST: it is the record that matters and the window may be short.
 STEPS = [
-    ("smoke", [sys.executable, "-m", "pytest",
-               "tests/test_tpu_smoke.py", "-x", "-q", "-rs"], 2400),
+    ("bench", [sys.executable, "bench.py"], 4200),
     ("profile_merge", [sys.executable, "tools/profile_merge.py",
                        "--register"], 2400),
-    ("bench", [sys.executable, "bench.py"], 4200),
+    ("smoke", [sys.executable, "-m", "pytest",
+               "tests/test_tpu_smoke.py", "-x", "-q", "-rs"], 2400),
 ]
 
 
@@ -63,12 +67,41 @@ def run_step(name: str, cmd, limit: int) -> tuple[int, str]:
         except (ProcessLookupError, PermissionError):
             proc.kill()
         out, err = proc.communicate()
+        # bench.py logs every phase to STDERR (stdout carries only the
+        # final JSON line) — a killed bench with no stderr tail would
+        # leave zero trace of which phase stalled
+        tail = (err or "")[-2000:]
+        if tail:
+            print(tail, file=sys.stderr, flush=True)
         return -9, out or ""
     log(f"step {name}: rc={rc} in {time.time() - t0:.0f}s")
     tail = (err or "")[-2000:]
     if tail:
         print(tail, file=sys.stderr, flush=True)
     return rc, out or ""
+
+
+def parse_clean_bench_line(out: str, log=log):
+    """Last JSON line of a bench run, or None if absent or degraded.
+
+    A degraded (CPU-fallback / errored) line must NOT become the
+    BENCH_SELF record: bench.py points future degraded runs at the newest
+    BENCH_SELF_r*.json as the clean first-party TPU line.
+    """
+    line = None
+    for cand in reversed(out.strip().splitlines()):
+        try:
+            line = json.loads(cand)
+            break
+        except json.JSONDecodeError:
+            continue
+    if not isinstance(line, dict):
+        return None
+    if line.get("backend") != "tpu" or line.get("error"):
+        log(f"bench line degraded (backend={line.get('backend')}, "
+            f"error={line.get('error')!r}) — not recording it")
+        return None
+    return line
 
 
 def main() -> None:
@@ -113,12 +146,7 @@ def main() -> None:
                 break
         rc, out = run_step(name, cmd, limit)
         if name == "bench" and rc == 0:
-            for line in reversed(out.strip().splitlines()):
-                try:
-                    bench_line = json.loads(line)
-                    break
-                except json.JSONDecodeError:
-                    continue
+            bench_line = parse_clean_bench_line(out, log)
         if name != "bench" or rc != 0 or bench_line is None:
             # always keep the step's tail in the session log — a failed
             # bench during a rare recovery window is exactly when its
@@ -134,8 +162,8 @@ def main() -> None:
             aborted = True
             break
         if rc != 0 and name == "smoke":
-            log("smoke failed — continuing to measurements anyway (their "
-                "provenance fields tell the real story)")
+            log("smoke failed — bench/profile measurements (if any) were "
+                "already captured; their provenance fields tell the story")
 
     if bench_line is not None:
         rec = os.path.join(ROOT, f"BENCH_SELF_r{args.round:02d}.json")
@@ -149,11 +177,16 @@ def main() -> None:
             f"error={bench_line.get('error')}")
         print(json.dumps(bench_line), flush=True)
     log("session done")
-    # exit status is the contract with tools/tpu_watch.py: only a session
-    # that produced a bench record counts as complete — an aborted chain
-    # exiting 0 would stop the watcher with nothing captured
+    # exit status is the contract with tools/tpu_watch.py: a session that
+    # produced the bench record IS complete (exit 0) even if later steps
+    # aborted — re-running the whole chain would re-risk the tunnel for
+    # data already captured. Without a record (and one was expected),
+    # exit nonzero so the watcher keeps trying.
     want_bench = args.step in (None, "bench")
-    if aborted or (want_bench and bench_line is None):
+    if want_bench:
+        if bench_line is None:
+            sys.exit(3)
+    elif aborted:
         sys.exit(3)
 
 

@@ -29,9 +29,9 @@ def log(msg: str) -> None:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--gap", type=float, default=1020.0,
-                    help="seconds between probe STARTS (probe itself takes "
-                         "up to 180 s; default keeps the ~20 min cadence)")
+    ap.add_argument("--gap", type=float, default=1200.0,
+                    help="seconds between probe STARTS (the ~20 min "
+                         "cadence from BENCH_NOTES)")
     ap.add_argument("--round", type=int, default=4)
     ap.add_argument("--max-hours", type=float, default=24.0,
                     help="give up after this long")
