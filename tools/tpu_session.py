@@ -5,16 +5,20 @@ BENCH_NOTES.md).
 
 Sequence (each step is a subprocess that fully exits before the next):
   1. preflight probe (3 min bound) — abort politely if the tunnel is wedged
-  2. python bench.py — the full record line, FIRST: recovery windows can
-     close at any moment, and the bench record is the artifact that
-     matters; it exercises the whole pipeline with per-phase provenance
-     and its own CPU-fallback child, so it doubles as the smoke run
-  3. tools/profile_merge.py --register — per-stage merge timings + the
+  2. warm — bench.py's own child entry run once with a limit far above any
+     compile bill, so every executable lands in the persistent cache in a
+     process that is allowed to finish (round-4 lesson: a cold cache put
+     the bench merge >15 min into compiles and the bench parent's child
+     watchdog killed the TPU client mid-claim — the wedge trigger itself)
+  3. python bench.py — the full record line, now warm end-to-end; it
+     exercises the whole pipeline with per-phase provenance and its own
+     CPU-fallback child, so it doubles as the smoke run
+  4. tools/profile_merge.py --register — per-stage merge timings + the
      trial/ICP sweep (the round-3 wedge-window optimizations, re-measured)
-  4. accelerator smoke test (pytest tests/test_tpu_smoke.py) — every device
+  5. accelerator smoke test (pytest tests/test_tpu_smoke.py) — every device
      path at real shapes, incl. the voxelized outlier probe and the
      bitexact-on-device record
-  5. write BENCH_SELF_r<N>.json from the bench line
+  6. write BENCH_SELF_r<N>.json from the bench line
 
 Timeouts are deliberately FAR above expected runtimes (the wedge lesson:
 never kill a TPU client anywhere near its expected finish); pass --step to
@@ -30,8 +34,16 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # expected wall ~3-8 min each on a warm cache; limits are 4-10x that.
-# bench FIRST: it is the record that matters and the window may be short.
+# warm FIRST: it is bench.py's own child entry point run once with a limit
+# far above any compile bill, so every executable lands in the persistent
+# cache in a process that is ALLOWED to finish. Round-4 lesson: a cold
+# cache put bench's merge phase >15 min into compiles and the parent's
+# 20-min child watchdog killed the TPU client mid-claim — the exact wedge
+# trigger the watchdog exists to avoid. After the warm step, bench's own
+# child runs minutes inside its watchdog instead of straddling it.
 STEPS = [
+    ("warm", [sys.executable, "bench.py", "--child",
+              os.path.join(ROOT, ".bench_warm.json"), "--views=24"], 5400),
     ("bench", [sys.executable, "bench.py"], 4200),
     ("profile_merge", [sys.executable, "tools/profile_merge.py",
                        "--register"], 2400),
@@ -42,6 +54,23 @@ STEPS = [
 
 def log(msg: str) -> None:
     print(f"[tpu-session +{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def _save_step_log(name: str, out: str, err: str) -> None:
+    """Persist a step's full streams — the 2000-char tail printed inline
+    lost the phase-by-phase child log exactly when a killed step needed
+    diagnosing (round-4 bench kill left no record of which merge stage the
+    20 minutes went into)."""
+    d = os.path.join(ROOT, "tools", "session_logs")
+    try:
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"{name}.{time.strftime('%m%d-%H%M%S')}.log")
+        with open(path, "w") as f:
+            f.write("==== stdout ====\n" + (out or "") +
+                    "\n==== stderr ====\n" + (err or ""))
+        log(f"step {name}: full log -> {os.path.relpath(path, ROOT)}")
+    except OSError:
+        pass
 
 
 def run_step(name: str, cmd, limit: int) -> tuple[int, str]:
@@ -59,6 +88,7 @@ def run_step(name: str, cmd, limit: int) -> tuple[int, str]:
     try:
         out, err = proc.communicate(timeout=limit)
         rc = proc.returncode
+        log(f"step {name}: rc={rc} in {time.time() - t0:.0f}s")
     except subprocess.TimeoutExpired:
         log(f"step {name} EXCEEDED {limit}s — killing its process group "
             f"(tunnel may be re-wedged; re-probe before retrying)")
@@ -67,14 +97,11 @@ def run_step(name: str, cmd, limit: int) -> tuple[int, str]:
         except (ProcessLookupError, PermissionError):
             proc.kill()
         out, err = proc.communicate()
-        # bench.py logs every phase to STDERR (stdout carries only the
-        # final JSON line) — a killed bench with no stderr tail would
-        # leave zero trace of which phase stalled
-        tail = (err or "")[-2000:]
-        if tail:
-            print(tail, file=sys.stderr, flush=True)
-        return -9, out or ""
-    log(f"step {name}: rc={rc} in {time.time() - t0:.0f}s")
+        rc = -9
+    # bench.py logs every phase to STDERR (stdout carries only the final
+    # JSON line) — a killed bench with no stderr tail would leave zero
+    # trace of which phase stalled
+    _save_step_log(name, out, err)
     tail = (err or "")[-2000:]
     if tail:
         print(tail, file=sys.stderr, flush=True)
@@ -91,11 +118,13 @@ def parse_clean_bench_line(out: str, log=log):
     line = None
     for cand in reversed(out.strip().splitlines()):
         try:
-            line = json.loads(cand)
-            break
+            parsed = json.loads(cand)
         except json.JSONDecodeError:
             continue
-    if not isinstance(line, dict):
+        if isinstance(parsed, dict):  # skip stray scalar JSON noise
+            line = parsed
+            break
+    if line is None:
         return None
     if line.get("backend") != "tpu" or line.get("error"):
         log(f"bench line degraded (backend={line.get('backend')}, "
