@@ -46,10 +46,15 @@ MERGE_PROJ = (512, 256)
 CPU_FALLBACK_VIEWS = 4      # forced-CPU child measures 4 views, extrapolates
 ROOT = os.path.dirname(os.path.abspath(__file__))
 CACHE = os.path.join(ROOT, ".bench_cache.npz")
-CHILD_TIMEOUT_TPU = 1200    # >= 600 s beyond worst-case (cold compiles ~500 s
-                            # on this one-core host): killing a TPU client
-                            # near its expected runtime is what wedges the
-                            # pool tunnel (observed twice in round 3)
+CHILD_TIMEOUT_TPU = 1800    # killing a TPU client near its expected runtime
+                            # is what wedges the pool tunnel (observed twice
+                            # in round 3, once in round 4: a fully-cold
+                            # round-4 merge spent >15 min in tunnel-side
+                            # compiles and the old 1200 s limit killed it
+                            # mid-claim). The real mitigation is the warm
+                            # step tools/tpu_session.py now runs first — the
+                            # bench child on a warm cache finishes in
+                            # minutes, nowhere near this limit.
 CHILD_TIMEOUT_CPU = 480
 # a wedged tunnel recovers on a server-side lease timescale: probe it for a
 # bounded window before degrading (round-3 verdict #2 — the record artifact
@@ -229,9 +234,10 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
     res["decode_compile_s"] = round(max(decode_first - best, 0.0), 2)
     res["decode_backend"] = backend
     try:  # which decode lowering actually ran (fused Mosaic vs jnp path)
-        res["decode_path"] = ("fused-pallas" if scanner._can_fuse(views_dev)
-                              else "jnp")
+        can_fuse = scanner._can_fuse(views_dev)
+        res["decode_path"] = "fused-pallas" if can_fuse else "jnp"
     except Exception:
+        can_fuse = False
         res["decode_path"] = "unknown"
     res["views_measured"] = views
     res["mpix_per_s"] = round(N_VIEWS * CAM[0] * CAM[1] / (best * scale) / 1e6, 1)
@@ -241,10 +247,41 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
         f"(={res['mpix_per_s']} Mpix/s, {n_valid0} valid pts in view 0)")
     save()
 
+    # A/B the other decode lowering (r4: the auto path chose fused-pallas at
+    # 285 Mpix/s where round 3's jnp path measured 476 — record both so the
+    # dispatch default is chosen from evidence, not assumption)
+    if can_fuse and backend != "cpu":
+        def run_alt():
+            out = scanner.forward_views(views_dev, thresh_mode="manual",
+                                        shadow_val=40.0, contrast_val=10.0,
+                                        use_fused=False)
+            jax.block_until_ready(out.points)
+
+        t0 = time.perf_counter()
+        run_alt()  # compile + warm
+        alt_first = time.perf_counter() - t0
+        alt_best = np.inf
+        for _ in range(n_rep):
+            t0 = time.perf_counter()
+            run_alt()
+            alt_best = min(alt_best, time.perf_counter() - t0)
+        res["decode_alt_path"] = "jnp"
+        res["decode_alt_s"] = round(alt_best * scale, 4)
+        res["decode_alt_compile_s"] = round(max(alt_first - alt_best, 0.0), 2)
+        log(f"child: phase A alt (jnp) best {alt_best:.3f}s "
+            f"(auto={res['decode_path']} {res['decode_triangulate_s']}s "
+            f"scaled; alt {res['decode_alt_s']}s scaled)")
+        if res["decode_alt_s"] < 0.9 * res["decode_triangulate_s"]:
+            log("child: NOTE — the jnp lowering beat the fused kernel by "
+                ">10%; consider flipping the forward_views default")
+        save()
+
     # ---- bit-exact export verification (BASELINE contract, verdict r3 #3):
     # decode view 0 on-device (integer maps are bit-exact by construction),
-    # then the EAGER per-primitive triangulation — compare the compacted
-    # cloud with the NumPy reference bit for bit, and record what it costs.
+    # then triangulate(bitexact=True) — host-NumPy float math at the export
+    # boundary (TPU f32 divide/rsqrt are not IEEE-identical, measured r4) —
+    # compare the compacted cloud with the NumPy reference bit for bit, and
+    # record what it costs.
     from structured_light_for_3d_model_replication_tpu.ops import (
         graycode as gc_mod,
         triangulate as tri_mod,
@@ -364,7 +401,9 @@ def _run_child(args: list[str], timeout: int) -> dict | None:
 
 _PHASE_KEYS = {
     "decode_triangulate_s": ("decode_triangulate_s", "decode_compile_s",
-                             "decode_backend", "decode_path", "mpix_per_s",
+                             "decode_backend", "decode_path",
+                             "decode_alt_path", "decode_alt_s",
+                             "decode_alt_compile_s", "mpix_per_s",
                              "views_measured", "pallas"),
     "chamfer_mm": ("chamfer_mm", "chamfer_backend"),
     "bitexact": ("bitexact", "bitexact_cost_s", "bitexact_backend"),
@@ -528,7 +567,8 @@ def main() -> None:
             return
 
         for k in ("decode_triangulate_s", "decode_compile_s", "decode_backend",
-                  "decode_path", "mpix_per_s", "merge_s", "merge_steady_s",
+                  "decode_path", "decode_alt_path", "decode_alt_s",
+                  "decode_alt_compile_s", "mpix_per_s", "merge_s", "merge_steady_s",
                   "merge_compile_s", "merge_backend", "chamfer_mm",
                   "chamfer_backend", "bitexact", "bitexact_cost_s",
                   "bitexact_backend", "pallas", "views_measured",

@@ -150,8 +150,8 @@ class SLScanner:
         return self._fwd(frames, jnp.float32(s), jnp.float32(c))
 
     def forward_views(self, frames_v, thresh_mode: str = "otsu",
-                      shadow_val: float = 40.0, contrast_val: float = 10.0
-                      ) -> CloudResult:
+                      shadow_val: float = 40.0, contrast_val: float = 10.0,
+                      use_fused: bool | None = None) -> CloudResult:
         """Batched views: uint8 [V, F, H, W] -> CloudResult with leading V axis.
 
         Runs as ONE jitted program that lax.map's the single-view forward over
@@ -160,11 +160,21 @@ class SLScanner:
         view's worth (a 24-view vmap materializes every view's plane gather at
         once — the round-2 HBM OOM) and keeping the Pallas decode kernel on its
         single-view lowering.
+
+        ``use_fused``: None (default) auto-dispatches via ``_can_fuse``;
+        False forces the jnp lowering; True requires the fused Mosaic
+        kernel (raises if the configuration cannot fuse). The override
+        exists so bench/profiling can A/B the two lowerings on the same
+        process and the default can be chosen from measurements.
         """
         frames_v = jnp.asarray(frames_v)
         ss, cs = graycode.resolve_thresholds_views(frames_v, thresh_mode,
                                                    shadow_val, contrast_val)
-        if self._can_fuse(frames_v):
+        can = self._can_fuse(frames_v)
+        if use_fused and not can:
+            raise ValueError("use_fused=True but this configuration cannot "
+                             "take the fused Mosaic kernel (see _can_fuse)")
+        if can if use_fused is None else use_fused:
             return self._fused_views(frames_v, ss, cs)
         return _scan_forward_views(frames_v, jnp.asarray(ss, jnp.float32),
                                    jnp.asarray(cs, jnp.float32), self.rays,

@@ -28,12 +28,16 @@ it), so compiled coordinates can differ from the NumPy backend by 1-2 ULP
 (~1e-5 mm at scene scale); validity masks and decoded integer maps are always
 bit-exact. Tests pin this contract: masks exactly equal, points to <=1e-3 mm.
 
-``bitexact=True`` removes even that ULP gap: the SAME ``_triangulate_impl``
-runs op-by-op in eager mode, where every jnp primitive is its own XLA
-executable — nothing fuses, so nothing contracts, and each f32 op rounds
-individually exactly like its NumPy twin (verified bit-for-bit over every
-pixel slot at 1080p, tests/test_synthetic_e2e.py). Calibration prep (rays,
-plane tables) is host-NumPy in this mode so the constants are bit-identical.
+``bitexact=True`` removes even that ULP gap by running the float math
+through the NumPy twin itself at the export boundary: the device supplies
+the decoded integer maps and mask (bit-exact by construction), they are
+fetched to host, and ``_triangulate_impl`` executes with ``xp=np`` — the
+same code path ``triangulate_np`` runs, so equality is by construction,
+not by luck. This replaced an eager per-primitive device variant: eager
+dispatch avoids FMA contraction, but TPU hardware f32 divide/rsqrt are
+not IEEE-correctly-rounded, so op-by-op device execution still differed
+from NumPy on TPU (measured r4: chamfer-level mismatches at 30.3 s/view
+in eager dispatch overhead; the host path is exact and ~0.7 s/view).
 """
 from __future__ import annotations
 
@@ -237,12 +241,14 @@ def triangulate(
     closed-form plane polynomial per pixel instead — no gather, ~20x faster
     on TPU for scattered decode maps, within ~1e-5 relative of the table.
 
-    ``bitexact``: run the identical implementation EAGERLY (one XLA
-    executable per primitive, so no FMA contraction anywhere) with host-
-    NumPy calibration prep — coordinates then match triangulate_np bit for
-    bit (the BASELINE "bit-exact point cloud vs CPU path" contract), at the
-    cost of ~30 eager kernel dispatches instead of one fused program.
-    Requires plane_eval='table' (the NumPy reference path).
+    ``bitexact``: fetch the (integer-exact) decode maps to host and run the
+    float math through the NumPy twin — coordinates then match
+    triangulate_np bit for bit BY CONSTRUCTION (the BASELINE "bit-exact
+    point cloud vs CPU path" contract). Device eager execution cannot honor
+    this on TPU: hardware f32 divide/rsqrt round differently from IEEE
+    NumPy even without fusion. Requires plane_eval='table' (the NumPy
+    reference path). Cost: one H*W device→host fetch + ~0.7 s/view of host
+    arithmetic, export-boundary only (like compact_cloud).
     """
     _check_plane_eval(plane_eval)
     if bitexact:
@@ -253,15 +259,12 @@ def triangulate(
         if isinstance(col_map, jax.core.Tracer):
             raise ValueError(
                 "bitexact=True cannot run under an enclosing jit/vmap "
-                "trace: the ops would fuse and FMA-contract again, silently"
-                " voiding the bit-exactness contract. Call it eagerly.")
-        h, w = col_map.shape
-        rays, oc, p_col, p_row = _prep_calib(calib, h, w, np)
-        return _triangulate_impl(
-            jnp.asarray(col_map), jnp.asarray(row_map), jnp.asarray(mask),
-            jnp.asarray(texture), jnp.asarray(rays), jnp.asarray(oc),
-            jnp.asarray(p_col), jnp.asarray(p_row),
-            row_mode=row_mode, epipolar_tol=float(epipolar_tol), xp=jnp,
+                "trace: it fetches to host and computes with NumPy. Call "
+                "it eagerly at the export boundary.")
+        return triangulate_np(
+            np.asarray(col_map), np.asarray(row_map), np.asarray(mask),
+            np.asarray(texture), calib,
+            row_mode=row_mode, epipolar_tol=float(epipolar_tol),
         )
     h, w = col_map.shape
     rays, oc, p_col, p_row = _prep_calib(calib, h, w, jnp)

@@ -106,8 +106,8 @@ def reconstruct_source(source, calib: dict, cfg: Config, scanner=None):
             plane_eval=tcfg.plane_eval,
         )
     elif scanner is not None and not tcfg.bitexact:
-        # the scanner's fused program contracts FMAs — a bitexact config must
-        # take the eager branch below no matter what the caller passed
+        # a bitexact config must take the branch below (device decode +
+        # host-NumPy triangulation) no matter what the caller passed
         cloud = scanner.forward(frames, thresh_mode=dcfg.thresh_mode,
                                 shadow_val=dcfg.shadow_val,
                                 contrast_val=dcfg.contrast_val)
@@ -146,8 +146,8 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
         raise ValueError(f"no scan sources found under {target!r} (mode={mode})")
 
     scanner = None
-    # bitexact export runs the eager per-primitive path in reconstruct_source,
-    # never the scanner's fused program (fusion is what contracts FMAs)
+    # bitexact export triangulates through the NumPy twin in
+    # reconstruct_source, never the scanner's fused program
     if cfg.parallel.backend != "numpy" and not cfg.triangulate.bitexact:
         from structured_light_for_3d_model_replication_tpu.models.scanner import (
             SLScanner,
