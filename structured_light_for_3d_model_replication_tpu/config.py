@@ -57,11 +57,12 @@ class TriangulateConfig:
     # 'quadratic' = closed-form per-pixel plane evaluation (no gather, ~20x
     # faster triangulation on TPU, within ~1e-5 relative of the table)
     plane_eval: str = "table"
-    # run triangulation eagerly (one XLA kernel per primitive: no FMA
-    # contraction) so exported coordinates match the NumPy backend bit for
-    # bit; needs plane_eval='table'. ~30 dispatches instead of one fused
-    # program — for export paths where the BASELINE bit-exactness contract
-    # matters more than the last milliseconds
+    # export-path triangulation through the NumPy twin: device decode
+    # supplies integer-exact maps, the float math runs on host so exported
+    # coordinates match the NumPy backend bit for bit (~0.7 s/view; TPU
+    # f32 divide/rsqrt are not IEEE-identical, so no device-side path can
+    # honor this). Needs plane_eval='table' — for export paths where the
+    # BASELINE bit-exactness contract matters more than throughput
     bitexact: bool = False
 
 

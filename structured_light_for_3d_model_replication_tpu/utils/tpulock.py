@@ -1,0 +1,66 @@
+"""One-TPU-client-at-a-time advisory lock for this repo's tooling.
+
+Every tunnel wedge on record (BENCH_NOTES.md) traces to one of two
+triggers: concurrent TPU clients on this one-core host, or a client
+killed mid-claim. The tools already self-serialize *within* one chain
+(tools/tpu_session.py runs steps strictly sequentially), but nothing
+stopped two independent entry points — the driver's round-end
+``bench.py``, a ``tools/tpu_watch.py`` probe, a manual smoke run — from
+opening claims concurrently. This module gives them all one advisory
+``flock`` on ``<repo>/.tpu_lock``.
+
+flock, not a pidfile: the kernel releases the lock the instant the
+holder's fd closes — including SIGKILL of the whole process group — so
+there is no stale-lock state to reap after the kills the wedge playbook
+sometimes requires.
+
+Holders spawning TPU-using children set ``SL3D_TPU_LOCK_HELD=1`` in the
+child environment; children then skip acquisition instead of deadlocking
+against their parent's lock.
+"""
+from __future__ import annotations
+
+import fcntl
+import os
+import time
+
+__all__ = ["acquire_tpu_lock", "held_by_parent", "HOLD_ENV"]
+
+HOLD_ENV = "SL3D_TPU_LOCK_HELD"
+
+
+def held_by_parent() -> bool:
+    """True when an ancestor process already holds the lock for us."""
+    return os.environ.get(HOLD_ENV, "") == "1"
+
+
+def acquire_tpu_lock(root: str, timeout: float = 0.0, poll: float = 5.0):
+    """Try to take the repo-wide TPU claim lock.
+
+    Returns the open file object (hold it for the claim's lifetime; the
+    lock dies with the fd) or ``None`` if another process still held it
+    after ``timeout`` seconds. ``timeout=0`` means one non-blocking try.
+    A caller whose parent set ``SL3D_TPU_LOCK_HELD=1`` gets a no-lock
+    sentinel open file immediately (the parent's claim covers it).
+    """
+    path = os.path.join(root, ".tpu_lock")
+    f = open(path, "a+")
+    if held_by_parent():
+        return f  # parent's flock covers this process tree
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+            try:  # who-holds breadcrumb for humans; lock truth is the flock
+                f.seek(0)
+                f.truncate()
+                f.write(f"pid {os.getpid()} since {time.strftime('%H:%M:%S')}\n")
+                f.flush()
+            except OSError:
+                pass
+            return f
+        except OSError:
+            if time.monotonic() >= deadline:
+                f.close()
+                return None
+            time.sleep(min(poll, max(0.1, deadline - time.monotonic())))

@@ -160,6 +160,16 @@ print(json.dumps(out))
 def test_flagship_paths_on_accelerator():
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    # one TPU client at a time, repo-wide: a manual run of this test while
+    # a validation session/bench is mid-claim is the concurrent-client
+    # wedge. (Under tools/tpu_session.py the parent holds the lock and
+    # sets the pass-through env.) Held for the test's lifetime; the flock
+    # dies with the process.
+    from structured_light_for_3d_model_replication_tpu.utils import tpulock
+
+    lock = tpulock.acquire_tpu_lock(_ROOT, timeout=60)  # noqa: F841
+    if lock is None:
+        pytest.skip("another TPU client holds .tpu_lock")
     # fast preflight: a wedged accelerator tunnel hangs inside backend init
     # OR inside the first device execution (both signatures observed; the
     # shared probe runs init + one tiny op) — skip rather than stall

@@ -20,6 +20,13 @@ sys.path.insert(0, ROOT)
 def main() -> None:
     views = int(sys.argv[1]) if len(sys.argv) > 1 else 24
 
+    from structured_light_for_3d_model_replication_tpu.utils import tpulock
+
+    lock = tpulock.acquire_tpu_lock(ROOT, timeout=60)  # noqa: F841
+    if lock is None:  # held for process lifetime; fd close releases
+        sys.exit("another TPU client holds .tpu_lock — not opening a "
+                 "concurrent claim (the lock dies with its holder)")
+
     import jax
     import jax.numpy as jnp
 
@@ -84,6 +91,52 @@ def main() -> None:
         print(f"{label:10s} path={path:5s} first={first:6.2f}s "
               f"steady={best:6.3f}s {mpix:7.1f} Mpix/s "
               f"valid0={len(pts)}{drift}")
+
+    # fused-kernel tile sweep: the default (8, 256) clamps to (8, 128) at
+    # 1080p (1920 % 256 != 0) -> 2025 grid steps per view, plausibly
+    # overhead-bound (r4 bench: fused 285 Mpix/s vs jnp 476). Bigger tiles
+    # amortize grid overhead; VMEM stays comfortable (46*th*tw u8 + ~9
+    # th*tw f32 planes). Measured here so the default is set from on-chip
+    # evidence, not theory.
+    sc = SLScanner(rig.calibration(), cam, cam, row_mode=1,
+                   plane_eval="quadratic")
+    if not sc._can_fuse(stack):
+        print("fused kernel unavailable for this shape — no tile sweep")
+        return
+    rays = sc.rays.reshape(cam[1], cam[0], 3)
+    thr_v = jnp.stack([jnp.full((views,), 40.0, jnp.float32),
+                       jnp.full((views,), 10.0, jnp.float32)], axis=1)
+    n_cols, n_rows, n_use_col, n_use_row, downsample, row_mode, _ = sc._static
+    for th, tw in ((8, 128), (8, 384), (8, 640), (16, 384), (16, 640),
+                   (24, 640), (8, 1920), (40, 1920)):
+        if cam[1] % th or cam[0] % tw:
+            continue
+
+        def run_tiles():
+            pts, valid, tex = pk.scan_points_fused_views(
+                stack, thr_v, rays, sc.oc, sc.poly_col, sc.poly_row,
+                sc.epipolar_tol, n_cols=n_cols, n_rows=n_rows,
+                n_use_col=n_use_col, n_use_row=n_use_row,
+                row_mode=row_mode, downsample=downsample,
+                tile_h=th, tile_w=tw)
+            jax.block_until_ready(pts)
+
+        try:
+            t0 = time.perf_counter()
+            run_tiles()
+            first = time.perf_counter() - t0
+            best = np.inf
+            for _ in range(3):
+                t0 = time.perf_counter()
+                run_tiles()
+                best = min(best, time.perf_counter() - t0)
+        except Exception as e:
+            print(f"fused tile {th:3d}x{tw:<4d}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:120]}")
+            continue
+        mpix = views * cam[0] * cam[1] / best / 1e6
+        print(f"fused tile {th:3d}x{tw:<4d}: first={first:6.2f}s "
+              f"steady={best:6.3f}s {mpix:7.1f} Mpix/s")
 
 
 if __name__ == "__main__":

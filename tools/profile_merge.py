@@ -29,6 +29,12 @@ def main() -> None:
                          "merged cloud: device-resident prefix slice vs "
                          "host compact-between-stages (the round-4 "
                          "transfer-trim hypothesis)")
+    ap.add_argument("--outlier-ab", action="store_true",
+                    help="A/B statistical outlier paths on the merged "
+                         "cloud: exact voxelized ring probe (hinted), "
+                         "unhinted exact, and the opt-in approx_min_k "
+                         "route — times + mask agreement, so the exactness "
+                         "default's cost is a number, not a guess")
     ap.add_argument("--runs", type=int, default=3)
     ap.add_argument("--trials", type=int, default=2048,
                     help="ransac_trials for the merge runs (bench uses 2048; "
@@ -37,6 +43,16 @@ def main() -> None:
                     help="pin the cpu platform (smoke/debug; the env var "
                          "alone loses to this box's sitecustomize)")
     args = ap.parse_args()
+
+    if not args.cpu:
+        from structured_light_for_3d_model_replication_tpu.utils import (
+            tpulock,
+        )
+
+        lock = tpulock.acquire_tpu_lock(ROOT, timeout=60)  # noqa: F841
+        if lock is None:  # held for process lifetime; fd close releases
+            sys.exit("another TPU client holds .tpu_lock — not opening a "
+                     "concurrent claim (the lock dies with its holder)")
 
     import jax
 
@@ -76,9 +92,9 @@ def main() -> None:
         print(f"run{it}: {time.perf_counter() - t0:.3f}s stages={tm} "
               f"pts={len(p)}", flush=True)
 
-    if args.postprocess_ab:
-        # rebuild the pre-postprocess merged cloud once, then time both
-        # strategies on the identical input
+    def build_merged_raw():
+        # rebuild the pre-postprocess merged cloud once, so the A/Bs time
+        # their strategies on the identical real input
         pre = rec._preprocess_views(clouds, float(mcfg.voxel_size), 0)
         T_all, *_ = rec._register_chain_batched(pre, mcfg,
                                                 float(mcfg.voxel_size),
@@ -89,8 +105,11 @@ def main() -> None:
             acc = (acc @ T_all[i - 1]).astype(np.float32)
             parts.append(np.asarray(clouds[i][0], np.float32)
                          @ acc[:3, :3].T + acc[:3, 3])
-        merged_raw = np.concatenate(parts).astype(np.float32)
-        cols_raw = np.concatenate([c for _, c in clouds]).astype(np.uint8)
+        return (np.concatenate(parts).astype(np.float32),
+                np.concatenate([c for _, c in clouds]).astype(np.uint8))
+
+    if args.postprocess_ab:
+        merged_raw, cols_raw = build_merged_raw()
         # isolate ONLY the compaction strategy: patching the fusion gate
         # keeps the outlier op on its real accelerator dispatch (faking the
         # backend name instead would reroute it onto the host-only grid
@@ -112,6 +131,40 @@ def main() -> None:
                       f"pts={len(pp)}", flush=True)
             finally:
                 rec._full_postprocess = real_gate
+
+    if args.outlier_ab:
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            pointcloud as pc,
+        )
+
+        if merged_raw is None:
+            merged_raw, cols_raw = build_merged_raw()
+        # mirror the outlier stage's real input: final-voxel the merged
+        # cloud and compact (host strategy keeps the A/B backend-neutral)
+        vox = float(mcfg.final_voxel or mcfg.voxel_size)
+        p_v, _, v_v = pc.voxel_downsample(merged_raw, cols_raw,
+                                          np.ones(len(merged_raw), bool),
+                                          vox)
+        keep = np.asarray(v_v)
+        pts = np.asarray(p_v)[keep]
+        val = np.ones(len(pts), bool)
+        print(f"outlier A/B input: {len(pts)} voxeled merged points "
+              f"(cell {vox})", flush=True)
+        masks = {}
+        for label, kw in (("hinted-exact", {"voxelized_cell": vox}),
+                          ("unhinted-exact", {}),
+                          ("approx", {"approximate": True})):
+            best = np.inf
+            for _ in range(max(args.runs, 2)):
+                t0 = time.perf_counter()
+                m = np.asarray(pc.statistical_outlier_mask(
+                    jnp.asarray(pts), jnp.asarray(val), mcfg.outlier_nb,
+                    mcfg.outlier_std, **kw))
+                best = min(best, time.perf_counter() - t0)
+            masks[label] = m
+            agree = float((m == masks["hinted-exact"]).mean())
+            print(f"outlier[{label}]: best {best:.3f}s kept {int(m.sum())}"
+                  f"/{len(m)} agree_vs_hinted={agree:.4f}", flush=True)
 
     if not args.register:
         return

@@ -46,7 +46,8 @@ STEPS = [
               os.path.join(ROOT, ".bench_warm.json"), "--views=24"], 5400),
     ("bench", [sys.executable, "bench.py"], 4200),
     ("profile_merge", [sys.executable, "tools/profile_merge.py",
-                       "--register"], 2400),
+                       "--register", "--postprocess-ab", "--outlier-ab"],
+     3000),
     ("smoke", [sys.executable, "-m", "pytest",
                "tests/test_tpu_smoke.py", "-x", "-q", "-rs"], 2400),
 ]
@@ -82,9 +83,12 @@ def run_step(name: str, cmd, limit: int) -> tuple[int, str]:
     # watcher starts new clients: the documented concurrent-client wedge
     import signal
 
+    from structured_light_for_3d_model_replication_tpu.utils import tpulock
+
     proc = subprocess.Popen(cmd, cwd=ROOT, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True,
-                            start_new_session=True)
+                            start_new_session=True,
+                            env={**os.environ, tpulock.HOLD_ENV: "1"})
     try:
         out, err = proc.communicate(timeout=limit)
         rc = proc.returncode
@@ -149,6 +153,17 @@ def main() -> None:
     from structured_light_for_3d_model_replication_tpu.utils.preflight import (
         accelerator_preflight,
     )
+    from structured_light_for_3d_model_replication_tpu.utils.tpulock import (
+        acquire_tpu_lock,
+    )
+
+    # one TPU client at a time, repo-wide: hold the claim lock for the whole
+    # chain so an independent entry point (the driver's round-end bench.py,
+    # a manual run) queues behind us instead of opening a concurrent claim
+    lock = acquire_tpu_lock(ROOT, timeout=120)
+    if lock is None:
+        sys.exit("another process holds .tpu_lock (a TPU client is active) "
+                 "— not starting; the lock dies with its holder, retry then")
 
     if not args.skip_preflight:
         status, detail = accelerator_preflight()

@@ -10,9 +10,11 @@ init + ONE device op, 180 s bound — so the init-ok/exec-stalled signature
 (round-4 incident) cannot trigger a doomed session.
 
 Run detached:  setsid nohup python tools/tpu_watch.py > tpu_watch.log 2>&1 &
-Stop:          kill the printed pid (it only ever probes between sleeps, so
-               any moment is safe to stop it — it never holds a claim while
-               sleeping).
+Stop:          kill the printed pid. Safe at any moment: it never holds a
+               claim while sleeping, and a running tpu_session child owns
+               its OWN .tpu_lock (it keeps excluding other TPU clients
+               even orphaned — let it finish or kill its whole process
+               group too).
 """
 import argparse
 import os
@@ -48,6 +50,9 @@ def main() -> None:
     from structured_light_for_3d_model_replication_tpu.utils.preflight import (
         accelerator_preflight,
     )
+    from structured_light_for_3d_model_replication_tpu.utils.tpulock import (
+        acquire_tpu_lock,
+    )
 
     log(f"pid {os.getpid()} — probing every {args.gap:.0f}s for up to "
         f"{args.max_hours:.1f}h")
@@ -59,6 +64,15 @@ def main() -> None:
     while time.time() < t_end:
         n += 1
         t0 = time.time()
+        # hold the repo-wide claim lock across probe AND session: a probe
+        # is itself a brief TPU client, and racing one against the
+        # driver's round-end bench.py is the concurrent-client wedge
+        lock = acquire_tpu_lock(ROOT, timeout=0)
+        if lock is None:
+            log(f"probe #{n}: skipped — .tpu_lock held elsewhere (another "
+                f"TPU client is active; it dies with its holder)")
+            time.sleep(args.gap)
+            continue
         status, detail = accelerator_preflight(cwd=ROOT)
         log(f"probe #{n}: {status} ({detail}) [{time.time() - t0:.0f}s]")
         # 'hung' is the recoverable wedge we are here to outlast; 'failed'
@@ -82,6 +96,15 @@ def main() -> None:
             else:
                 log("tunnel healthy — starting tpu_session")
                 sessions += 1
+                # hand the claim over rather than down: the session takes
+                # its OWN lock so the claim lives exactly as long as the
+                # session process — if this watcher is killed mid-session,
+                # the orphaned session keeps excluding other clients
+                # (inheriting our lock via HOLD_ENV would instead release
+                # it with us while the session runs on). The handoff gap is
+                # milliseconds; the session waits up to 120 s for the lock.
+                lock.close()
+                lock = None
                 rc = subprocess.call(
                     [sys.executable, "tools/tpu_session.py",
                      "--round", str(args.round), "--skip-preflight"],
@@ -94,6 +117,8 @@ def main() -> None:
                     log(f"{sessions} failed session attempts — giving up")
                     return
                 log("session did not complete cleanly — resuming watch")
+        if lock is not None:
+            lock.close()  # release between cycles; never sleep holding a claim
         dt = time.time() - t0
         if dt < args.gap:
             time.sleep(args.gap - dt)
