@@ -531,8 +531,14 @@ def _scan_fused_kernel(frames_ref, thr_ref, sc_ref, rx_ref, ry_ref, rz_ref,
             q = sc_ref[base + 8 + c]
             comps.append(a + i * (b + i * q))
         nx, ny, nz, d = comps
-        inv = jax.lax.rsqrt(jnp.maximum(nx * nx + ny * ny + nz * nz, 1e-30))
-        return nx * inv, ny * inv, nz * inv, d * inv
+        # direct sqrt+divide, NOT lax.rsqrt: the TPU VPU's rsqrt is a
+        # coarser approximation, and this normalization was the one
+        # primitive where the fused kernel diverged from the jnp lowering
+        # (r4 bench: 0.064 mm chamfer vs the jnp path's 1.3e-4). Divides
+        # (not reciprocal-multiply) reproduce _poly_planes' p/nrm
+        # expression rounding-for-rounding
+        nrm = jnp.sqrt(jnp.maximum(nx * nx + ny * ny + nz * nz, 1e-30))
+        return nx / nrm, ny / nrm, nz / nrm, d / nrm
 
     nx, ny, nz, d = poly_plane(col, n_cols, 4)
     denom = nx * rx + ny * ry + nz * rz
