@@ -62,10 +62,13 @@ CHILD_TIMEOUT_CPU = 480
 TPU_RETRY_WINDOW = 1200     # keep probing up to 20 min
 TPU_PROBE_GAP = 60          # pause between probes that fail FAST (a hung
                             # probe already burns its 180 s timeout)
-LOCK_WAIT = 1500            # queue behind another TPU client (a validation
+LOCK_WAIT = 2400            # queue behind another TPU client (a validation
                             # session mid-chain) rather than racing it; its
-                            # warm cache makes our own run fast afterwards
-PARENT_DEADLINE = 5400      # absolute last resort: emit an error line and
+                            # warm cache makes our own run fast afterwards.
+                            # Waiting holds NO claim, so even an external
+                            # kill during the wait cannot wedge anything —
+                            # waiting long is strictly safer than degrading
+PARENT_DEADLINE = 7200      # absolute last resort: emit an error line and
                             # exit (must cover lock wait + probe window +
                             # TPU child + CPU fallback)
 
