@@ -19,7 +19,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["AXIS_DATA", "AXIS_MODEL", "make_mesh", "view_sharding", "P"]
+__all__ = ["AXIS_DATA", "AXIS_MODEL", "make_mesh", "merge_mesh",
+           "view_sharding", "P"]
 
 AXIS_DATA = "data"
 AXIS_MODEL = "model"
