@@ -46,7 +46,7 @@ MERGE_PROJ = (512, 256)
 CPU_FALLBACK_VIEWS = 4      # forced-CPU child measures 4 views, extrapolates
 ROOT = os.path.dirname(os.path.abspath(__file__))
 CACHE = os.path.join(ROOT, ".bench_cache.npz")
-CHILD_TIMEOUT_TPU = 1800    # killing a TPU client near its expected runtime
+CHILD_TIMEOUT_TPU = 2700    # killing a TPU client near its expected runtime
                             # is what wedges the pool tunnel (observed twice
                             # in round 3, once in round 4: a fully-cold
                             # round-4 merge spent >15 min in tunnel-side
@@ -54,7 +54,9 @@ CHILD_TIMEOUT_TPU = 1800    # killing a TPU client near its expected runtime
                             # mid-claim). The real mitigation is the warm
                             # step tools/tpu_session.py now runs first — the
                             # bench child on a warm cache finishes in
-                            # minutes, nowhere near this limit.
+                            # minutes, nowhere near this limit; the 45 min
+                            # covers a cold run (merge ~15 min + mesh phase)
+                            # when bench runs standalone on changed code.
 CHILD_TIMEOUT_CPU = 480
 # a wedged tunnel recovers on a server-side lease timescale: probe it for a
 # bounded window before degrading (round-3 verdict #2 — the record artifact
@@ -380,6 +382,45 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
             f"{len(merged_p)} pts, mean ICP fitness {res['merge_icp_fit_mean']}")
     save()
 
+    # ---- phase D: mesh the merged cloud (A19/A20, the scan-to-print
+    # tail). Informational — not part of the headline value (BASELINE
+    # scopes the target to decode+triangulate+merge) — and accelerator-
+    # only: the CPU fallback child has a 480 s budget that Poisson at
+    # merged-cloud scale would blow.
+    if backend != "cpu":
+        from structured_light_for_3d_model_replication_tpu.models.meshing import (
+            reconstruct_mesh,
+        )
+
+        try:
+            t0 = time.perf_counter()
+            verts, faces = reconstruct_mesh(merged_p, log=lambda m: None)
+            verts, faces = np.asarray(verts), np.asarray(faces)
+            mesh_first = time.perf_counter() - t0
+            # bank the compile-inclusive result BEFORE the steady rerun so
+            # a watchdog kill mid-rerun cannot lose the measurement
+            res["mesh_s"] = round(mesh_first, 3)
+            res["mesh_backend"] = backend
+            res["mesh_vertices"] = int(len(verts))
+            res["mesh_faces"] = int(len(faces))
+            save()
+            if mesh_first < 120:  # same budget guard as the merge phase
+                t0 = time.perf_counter()
+                verts, faces = reconstruct_mesh(merged_p, log=lambda m: None)
+                verts, faces = np.asarray(verts), np.asarray(faces)
+                mesh_steady = time.perf_counter() - t0
+                res["mesh_s"] = round(mesh_steady, 3)
+                res["mesh_compile_s"] = round(
+                    max(mesh_first - mesh_steady, 0.0), 2)
+            log(f"child: phase D mesh {res['mesh_s']}s "
+                f"(first {mesh_first:.2f}s) {len(verts)} verts "
+                f"{len(faces)} faces")
+        except Exception as e:
+            # meshing must never cost the captured merge record
+            res["mesh_error"] = f"{type(e).__name__}: {e}"[:200]
+            log(f"child: phase D mesh FAILED ({res['mesh_error']})")
+    save()
+
 
 # ---------------------------------------------------------------------------
 # parent: orchestrate with hard timeouts; always print one JSON line
@@ -415,6 +456,8 @@ _PHASE_KEYS = {
                              "views_measured", "pallas"),
     "chamfer_mm": ("chamfer_mm", "chamfer_backend"),
     "bitexact": ("bitexact", "bitexact_cost_s", "bitexact_backend"),
+    "mesh_s": ("mesh_s", "mesh_compile_s", "mesh_backend", "mesh_vertices",
+               "mesh_faces"),
     "merge_s": ("merge_s", "merge_steady_s", "merge_compile_s",
                 "merge_backend", "merge_points", "merge_icp_fit_mean",
                 "merge_stage_s", "merge_stage_first_s",
@@ -606,6 +649,8 @@ def main() -> None:
                   "bitexact_backend", "pallas", "views_measured",
                   "merge_points", "merge_icp_fit_mean", "merge_stage_s",
                   "merge_stage_first_s", "merge_ransac_trials",
+                  "mesh_s", "mesh_compile_s", "mesh_backend",
+                  "mesh_vertices", "mesh_faces", "mesh_error",
                   "backend_error"):
             if k in res and res[k] is not None:
                 final[k] = res[k]
