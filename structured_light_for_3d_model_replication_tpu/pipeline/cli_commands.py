@@ -18,6 +18,8 @@ implementation.
   scan          tab 1    capture one structured-light sequence
   auto-scan     tab 6    full turntable sweep (12 x 30 degrees)
   synth         (new)    render a synthetic scan dataset for tests/demos
+  warmup        (new)    pre-compile flagship programs into the persistent cache
+  doctor        (new)    bounded environment diagnosis (tunnel, lock, cache)
 """
 from __future__ import annotations
 
@@ -207,6 +209,24 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("--views", type=int, default=4)
     p.add_argument("--cam", default="320x240", help="camera WxH")
     p.add_argument("--proj", default="256x128", help="projector WxH")
+    add_config_args(p)
+
+    p = sub.add_parser(
+        "doctor",
+        help="diagnose the execution environment: accelerator tunnel "
+             "health (bounded probe — a wedged tunnel cannot hang this), "
+             "TPU claim lock, persistent compile cache, native IO library")
+    p.add_argument("--probe-timeout", type=float, default=60.0,
+                   help="seconds before the backend probe is declared hung "
+                        "(the full wedge signature needs ~180)")
+    p.add_argument("--no-probe", action="store_true",
+                   help="skip the accelerator probe (report the rest "
+                        "instantly; also the switch for intentionally "
+                        "cpu-only setups)")
+    p.add_argument("--root", default=".",
+                   help="directory whose .tpu_lock/.jax_cache to inspect "
+                        "(the repo root where bench.py and tools/ run; "
+                        "default: current directory)")
     add_config_args(p)
 
 
@@ -619,3 +639,91 @@ def _cmd_synth(args) -> int:
         print(f"[synth] view {i + 1}/{args.views} -> {d}")
     print(f"[synth] calib + {args.views} views under {args.output_root}")
     return 0
+
+
+@_runner("doctor")
+def _cmd_doctor(args) -> int:
+    """One-shot environment diagnosis. Every check is bounded: the backend
+    probe runs in a subprocess (utils.preflight), so a wedged device tunnel
+    prints a verdict instead of hanging the doctor itself."""
+    from structured_light_for_3d_model_replication_tpu.io import native
+    from structured_light_for_3d_model_replication_tpu.utils import tpulock
+    from structured_light_for_3d_model_replication_tpu.utils.preflight import (
+        accelerator_preflight,
+    )
+
+    ok = True
+    # the lock/cache live where the TPU tooling runs (bench.py, tools/ pin
+    # them to their repo root) — doctor must be pointed at that directory
+    root = os.path.abspath(args.root)
+
+    # accelerator tunnel (subprocess probe: init + one device op)
+    if args.no_probe:
+        print("[doctor] backend: probe skipped (--no-probe)")
+    else:
+        status, detail = accelerator_preflight(timeout=args.probe_timeout,
+                                               cwd=root)
+        healthy = status == "ok" and detail != "cpu"
+        print(f"[doctor] backend: {'ok' if healthy else 'FAIL'} — "
+              f"{status} ({detail})"
+              + ("" if healthy else
+                 "; see BENCH_NOTES.md for the wedge playbook"))
+        if status == "ok" and detail == "cpu":
+            # same verdict every TPU tool treats as unhealthy (tpu_session
+            # healthy(), tpu_watch, bench's retry loop): either no
+            # accelerator is attached, or the plugin failed fast — the
+            # wedge variant that alternates with the hung signature
+            print("[doctor]   cpu verdict: no accelerator attached, or "
+                  "the plugin failed fast (wedge variant). Intentionally "
+                  "cpu-only? use --no-probe")
+        ok = ok and healthy
+
+    # TPU claim lock: report the holder without contending for it
+    held, detail = tpulock.probe_tpu_lock(root)
+    if held:
+        print(f"[doctor] tpu lock: HELD ({detail}) — another TPU client "
+              f"is active; it releases on exit/kill")
+    else:
+        print(f"[doctor] tpu lock: {detail}")
+
+    # persistent compile cache
+    cache = os.path.join(root, ".jax_cache")
+    if os.path.isdir(cache):
+        entries = os.listdir(cache)
+
+        def _sz(e):  # entries vanish mid-scan while jax rewrites the cache
+            try:
+                return os.path.getsize(os.path.join(cache, e))
+            except OSError:
+                return 0
+
+        size = sum(_sz(e) for e in entries) / 1e6
+        print(f"[doctor] compile cache: {len(entries)} executables, "
+              f"{size:.1f} MB ({cache})")
+        if not entries:
+            print("[doctor]   hint: run `sl3d warmup` to pre-pay ~30 s of "
+                  "first-scan compiles")
+    else:
+        print(f"[doctor] compile cache: absent ({cache}) — first scan pays "
+              f"the full compile bill; `sl3d warmup` pre-pays it")
+
+    # native IO library
+    if native.available():
+        print("[doctor] native slio: available")
+    else:
+        print("[doctor] native slio: not built (pure-python writers used; "
+              "build with `make -C native`)")
+
+    # optional host-side dependencies
+    for mod, why in (("cv2", "chessboard detection / projector window"),
+                     ("serial", "hardware turntable"),
+                     ("matplotlib", "calibration rig plots")):
+        try:
+            __import__(mod)
+            print(f"[doctor] {mod}: available")
+        except ImportError:
+            print(f"[doctor] {mod}: absent — {why} unavailable (everything "
+                  f"else works)")
+
+    print(f"[doctor] {'all core checks passed' if ok else 'ISSUES FOUND'}")
+    return 0 if ok else 1

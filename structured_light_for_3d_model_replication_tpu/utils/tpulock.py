@@ -24,9 +24,32 @@ import fcntl
 import os
 import time
 
-__all__ = ["acquire_tpu_lock", "held_by_parent", "HOLD_ENV"]
+__all__ = ["acquire_tpu_lock", "probe_tpu_lock", "held_by_parent",
+           "HOLD_ENV"]
 
 HOLD_ENV = "SL3D_TPU_LOCK_HELD"
+
+
+def probe_tpu_lock(root: str) -> tuple[bool, str]:
+    """Report the lock's state without contending for it.
+
+    Returns (held, detail). Uses a shared (LOCK_SH) non-blocking probe —
+    it fails iff someone holds the exclusive claim, and two concurrent
+    probes never conflict with each other; the instant of SH hold cannot
+    be observed by another probe, only by an exactly-simultaneous
+    exclusive acquire (vanishingly small window vs probing with LOCK_EX).
+    """
+    path = os.path.join(root, ".tpu_lock")
+    if not os.path.exists(path):
+        return False, "never taken here"
+    with open(path, "a+") as f:
+        try:
+            fcntl.flock(f.fileno(), fcntl.LOCK_SH | fcntl.LOCK_NB)
+            fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+            return False, "free"
+        except OSError:
+            f.seek(0)
+            return True, f.read().strip() or "unknown holder"
 
 
 def held_by_parent() -> bool:

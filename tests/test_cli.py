@@ -191,3 +191,44 @@ def test_clean_chain_aborts_when_all_points_removed(tmp_path):
     assert any("aborting chain" in m for m in logs)
     d = plyio.read_ply(str(out))
     assert len(d["points"]) == 0
+
+
+def test_doctor_no_probe(tmp_path, capsys):
+    # --no-probe keeps it instant and deterministic (no backend subprocess);
+    # --root at an empty dir exercises the lock-free / cache-absent branches
+    rc = cli_main(["doctor", "--no-probe", "--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "probe skipped" in out
+    assert "tpu lock: never taken here" in out
+    assert "compile cache: absent" in out
+
+
+def test_doctor_reports_held_lock(tmp_path, capsys):
+    from structured_light_for_3d_model_replication_tpu.utils import tpulock
+
+    # hold from a CHILD process: flock is per-open-file, so a same-process
+    # shared probe would succeed against our own exclusive hold
+    import subprocess
+    import sys as _sys
+    import os as _os
+
+    holder = subprocess.Popen(
+        [_sys.executable, "-c",
+         "import sys, time; sys.path.insert(0, sys.argv[2]); "
+         "from structured_light_for_3d_model_replication_tpu.utils import tpulock; "
+         "f = tpulock.acquire_tpu_lock(sys.argv[1], timeout=0); "
+         "print('held', flush=True); time.sleep(30)",
+         str(tmp_path), _os.path.dirname(_os.path.dirname(
+             _os.path.abspath(tpulock.__file__)))],
+        stdout=subprocess.PIPE, text=True,
+        env={k: v for k, v in _os.environ.items() if k != tpulock.HOLD_ENV})
+    try:
+        assert holder.stdout.readline().strip() == "held"
+        rc = cli_main(["doctor", "--no-probe", "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "tpu lock: HELD" in out
+    finally:
+        holder.kill()
+        holder.wait()

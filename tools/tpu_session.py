@@ -165,12 +165,18 @@ def main() -> None:
         sys.exit("another process holds .tpu_lock (a TPU client is active) "
                  "— not starting; the lock dies with its holder, retry then")
 
+    # on this rig "ok (cpu)" means the accelerator plugin failed FAST —
+    # a wedge variant (r4), not a healthy verdict: a chain run on the cpu
+    # ambient backend would burn hours measuring the wrong thing
+    def healthy(status: str, detail: str) -> bool:
+        return status == "ok" and detail != "cpu"
+
     if not args.skip_preflight:
         status, detail = accelerator_preflight()
         log(f"preflight: {status} ({detail})")
-        if status != "ok":
-            sys.exit(f"tunnel not healthy ({status}) — not starting any "
-                     f"TPU work")
+        if not healthy(status, detail):
+            sys.exit(f"tunnel not healthy ({status}, {detail}) — not "
+                     f"starting any TPU work")
 
     steps = [s for s in STEPS if args.step is None or s[0] == args.step]
     if args.skip_smoke:
@@ -183,7 +189,7 @@ def main() -> None:
             # (init + one op) before opening the next claim
             status, detail = accelerator_preflight()
             log(f"inter-step preflight: {status} ({detail})")
-            if status != "ok":
+            if not healthy(status, detail):
                 log(f"tunnel unhealthy before step {name} — aborting the "
                     f"rest of the session")
                 aborted = True

@@ -88,8 +88,17 @@ def main() -> None:
             log("3 identical deterministic failures — giving up")
             return
         if status == "ok" and detail == "cpu":
-            log("ambient backend is cpu-only — nothing to watch for")
-            return
+            # NOT a reason to stop on this rig: the ambient backend is the
+            # accelerator whenever the tunnel is healthy, so a cpu verdict
+            # means the plugin failed FAST this instant — a wedge variant
+            # observed alternating with the hung signature (r4). Keep
+            # watching; the 24 h cap bounds us.
+            log("plugin failed fast — jax fell back to cpu (wedge "
+                "variant); still watching")
+            if lock is not None:
+                lock.close()
+            time.sleep(args.gap)
+            continue
         if status == "ok":
             if args.probe_only:
                 log("tunnel healthy (probe-only mode; not starting session)")
