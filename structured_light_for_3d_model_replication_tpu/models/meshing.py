@@ -124,6 +124,20 @@ def _poisson_dispatch(pts, nr, v, depth: int, log):
     warning rather than failing the pipeline."""
     import jax
 
+    # cap resolution by sampling density: a surface of N samples occupies
+    # ~(2^d)^2 grid cells, so 2^d beyond ~sqrt(N) splats each point into
+    # ever more empty cells — pure cost, no detail. Unlike the reference's
+    # octree (which adapts per-sample, processing.py:697-709), the dense
+    # grid pays (2^d)^3 everywhere: a 50-point degenerate cloud at the
+    # config default depth 10 otherwise steps to a 512^3 dense solve
+    # (134M cells, minutes-to-hours; found by hostile-input probing, r4).
+    n = int(np.asarray(v).sum())
+    density_cap = max(4, int(np.ceil(np.log2(max(n, 2)) / 2)) + 1)
+    if density_cap < depth:
+        log(f"[mesh] poisson depth {depth} -> {density_cap}: {n} points "
+            f"cannot fill a {1 << depth}^3 grid (cap ~ log2(sqrt(N))+1)")
+        depth = density_cap
+
     if depth <= 9:
         res = poisson.poisson_solve(pts, nr, v, depth=depth)
         log(f"[mesh] poisson depth={depth} iso={float(res.iso):.4f}")

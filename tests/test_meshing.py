@@ -1,5 +1,7 @@
 """Meshing: Poisson solve + Surface Nets on analytic shapes — the mesh must
 reproduce known geometry (sphere radius/volume) and be watertight."""
+import time
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -165,3 +167,42 @@ def test_quadric_decimation_config_path(rng):
     assert 0 < len(faces) <= 550
     r = np.linalg.norm(verts, axis=1)
     assert abs(np.median(r) - 50.0) < 3.0
+
+
+def test_poisson_depth_capped_by_point_count(rng):
+    """A tiny/degenerate cloud must never inflate to a huge dense grid:
+    the config default depth 10 on a 50-point collinear cloud used to step
+    to a 512^3 dense solve (134M cells — effectively a hang; r4 hostile-
+    input probe). The dispatch caps depth ~ log2(sqrt(N))+1."""
+    pts = np.stack([np.linspace(0.0, 1.0, 50),
+                    np.zeros(50), np.zeros(50)], 1).astype(np.float32)
+    msgs = []
+    t0 = time.monotonic()
+    verts, faces = meshing.reconstruct_mesh(pts, log=msgs.append)
+    assert time.monotonic() - t0 < 120
+    assert any("-> 4" in m for m in msgs), msgs  # cap engaged at N=50
+    assert len(verts) > 0 and len(faces) > 0
+
+
+def test_poisson_depth_cap_leaves_flagship_scale_alone(monkeypatch):
+    # the bench's ~171k merged cloud must still be allowed the full depth:
+    # drive the REAL dispatch with a stubbed solver and assert the cap
+    # stays out of the way (on 1 CPU device depth 10 then steps down to 9
+    # via the device-count branch, not the density cap)
+    seen = {}
+
+    def fake_solve(pts, nr, v, depth):
+        seen["depth"] = depth
+
+        class R:
+            iso = 0.0
+        return R()
+
+    monkeypatch.setattr(meshing.poisson, "poisson_solve", fake_solve)
+    n = 171_330
+    pts = np.zeros((n, 3), np.float32)
+    logs = []
+    meshing._poisson_dispatch(pts, pts, np.ones(n, bool), depth=10,
+                              log=logs.append)
+    assert not any("cannot fill" in m for m in logs), logs
+    assert seen["depth"] == 9  # 1-device CPU step-down, not the cap

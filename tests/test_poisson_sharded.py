@@ -88,9 +88,13 @@ def test_depth10_default_steps_down_on_cpu(rng, monkeypatch):
         return "sentinel"
 
     monkeypatch.setattr(meshing.poisson, "poisson_solve", fake_solve)
-    pts, nrm = _sphere(rng, n=600)
+    # >65,536 valid points so the density cap (~log2(sqrt(N))+1 >= 10)
+    # leaves depth 10 alone and the CPU step-down branch is what acts
+    pts = rng.normal(size=(70_000, 3)).astype(np.float32)
+    nrm = pts / np.linalg.norm(pts, axis=1, keepdims=True)
     logs = []
     res = meshing._poisson_dispatch(pts, nrm, np.ones(len(pts), bool),
                                     depth=10, log=logs.append)
+    assert not any("cannot fill" in m for m in logs)  # cap stayed out
     assert any("stepping down" in m for m in logs)
     assert seen["depth"] == 9 and res == "sentinel"
