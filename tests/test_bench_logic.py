@@ -92,3 +92,35 @@ def test_fill_takes_pallas_with_decode_phase():
     bench._fill_missing_phases(dead, cpu)
     assert dead["pallas"] == "interpret"
     assert dead["decode_backend"] == "cpu"
+
+
+def test_wait_for_accelerator_rides_out_cpu_fallback_verdicts():
+    # the fast-fail wedge variant: plugin errors out, jax falls back to
+    # cpu, preflight says ("ok","cpu"). That must NOT be accepted as a
+    # healthy verdict (it would yield a clean-looking backend:cpu record)
+    calls = []
+
+    def fake_preflight():
+        calls.append(1)
+        return ("ok", "cpu") if len(calls) < 3 else ("ok", "tpu")
+
+    status, detail, attempts, _ = bench._wait_for_accelerator(
+        fake_preflight, window=300.0, gap=0.0)
+    assert status == "ok" and detail == "tpu" and attempts == 3
+
+
+def test_wait_for_accelerator_labels_persistent_cpu_fallback():
+    import itertools
+    clock = itertools.count(step=200.0)
+    orig = bench.time.monotonic
+    bench.time.monotonic = lambda: float(next(clock))
+    try:
+        status, detail, attempts, waited = bench._wait_for_accelerator(
+            lambda: ("ok", "cpu"), window=1200.0, gap=0.0)
+    finally:
+        bench.time.monotonic = orig
+    # a window full of cpu verdicts returns the distinct cpu-fallback
+    # status (callers label the record; plain "ok" would run the child
+    # on cpu and emit error:null)
+    assert status == "cpu-fallback"
+    assert waited >= 1200.0
