@@ -141,3 +141,40 @@ def test_tpu_lock_parent_held_passthrough(tmp_path, monkeypatch):
     assert second is not None
     second.close()
     first.close()
+
+
+def test_tpu_lock_released_by_sigkill(tmp_path):
+    """The no-stale-lock property the design rests on: the kernel drops the
+    flock the instant the holder dies — even SIGKILL, the signal the wedge
+    playbook sometimes requires — so no reaping/cleanup logic exists to rot."""
+    import signal
+
+    from structured_light_for_3d_model_replication_tpu.utils import tpulock
+
+    env = {k: v for k, v in os.environ.items() if k != tpulock.HOLD_ENV}
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    holder = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys, time; sys.path.insert(0, sys.argv[2]); "
+         "from structured_light_for_3d_model_replication_tpu.utils import "
+         "tpulock; "
+         "f = tpulock.acquire_tpu_lock(sys.argv[1], timeout=0); "
+         "print('held', flush=True); time.sleep(60)",
+         str(tmp_path), _ROOT],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        assert holder.stdout.readline().strip() == "held"
+        held, _ = tpulock.probe_tpu_lock(str(tmp_path))
+        assert held
+        holder.send_signal(signal.SIGKILL)
+        holder.wait()
+        for _ in range(50):  # kernel releases on fd close; allow reaping lag
+            held, detail = tpulock.probe_tpu_lock(str(tmp_path))
+            if not held:
+                break
+            time.sleep(0.1)
+        assert not held, detail
+    finally:
+        if holder.poll() is None:
+            holder.kill()
+            holder.wait()
