@@ -232,3 +232,27 @@ def test_doctor_reports_held_lock(tmp_path, capsys):
     finally:
         holder.kill()
         holder.wait()
+
+
+def test_merge_360_posegraph_method(recon_dir, tmp_path):
+    # the CLI surface of merge_360_posegraph (Old/360Merge.py parity mode):
+    # sequential edges + loop closure, globally optimized — 3 views is the
+    # minimum pose-graph (below that merge_360_posegraph delegates)
+    merged = str(tmp_path / "merged_pg.ply")
+    tjson = str(tmp_path / "transforms_pg.json")
+    rc = cli_main(["merge-360", recon_dir, merged,
+                   "--method", "posegraph",
+                   "--save-transforms", tjson,
+                   "--set", "merge.voxel_size=4.0",
+                   "--set", "merge.ransac_trials=512",
+                   "--set", "merge.icp_iters=10",
+                   "--set", "merge.final_voxel=0",
+                   "--set", "merge.outlier_nb=0"])
+    assert rc == 0
+    pts = plyio.read_ply(merged)["points"]
+    assert len(pts) > 1000
+    transforms = json.load(open(tjson))
+    assert len(transforms) == 3
+    # world = view 0: its optimized pose stays the identity
+    T0 = np.asarray(transforms[0])
+    assert np.allclose(T0, np.eye(4), atol=1e-5)
