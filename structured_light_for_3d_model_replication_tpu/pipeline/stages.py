@@ -183,6 +183,15 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
             log(f"[reconstruct] {name}: {len(pts):,} points -> {out_path}")
             report.outputs.append(out_path)
         except Exception as e:  # per-item tolerance (processing.py:323-330)
+            from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (
+                is_backend_init_error,
+            )
+
+            if is_backend_init_error(e):
+                # process-level condition, not an item failure: propagate
+                # so the CLI's CPU-fallback retry can handle it (otherwise
+                # every item "fails" identically and no retry fires)
+                raise
             log(f"[reconstruct] {name} FAILED: {e}")
             report.failed.append((src, str(e)))
     report.elapsed_s = time.monotonic() - t0

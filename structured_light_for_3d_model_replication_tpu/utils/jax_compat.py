@@ -19,7 +19,7 @@ except ImportError:  # pragma: no cover - older jax layout
 
     _CHECK_KW = "check_rep"
 
-__all__ = ["shard_map", "shard_map_unchecked"]
+__all__ = ["shard_map", "shard_map_unchecked", "is_backend_init_error"]
 
 
 def shard_map_unchecked(**kwargs):
@@ -27,3 +27,13 @@ def shard_map_unchecked(**kwargs):
     under whichever kwarg name this jax spells it."""
     kwargs[_CHECK_KW] = False
     return functools.partial(shard_map, **kwargs)
+
+
+def is_backend_init_error(exc: BaseException) -> bool:
+    """True for the accelerator plugin's fast-fail at first jax use
+    ("Unable/unable to initialize backend ..."), a wedge variant observed
+    live (r4). Shared by the CLI's CPU-fallback retry and the per-item
+    tolerance in pipeline stages: an init failure is a process-level
+    condition, not an item failure — swallowing it per scan would report
+    every item failed with the same error and defeat the CPU retry."""
+    return "nable to initialize backend" in str(exc)

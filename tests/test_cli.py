@@ -256,3 +256,44 @@ def test_merge_360_posegraph_method(recon_dir, tmp_path):
     # world = view 0: its optimized pose stays the identity
     T0 = np.asarray(transforms[0])
     assert np.allclose(T0, np.eye(4), atol=1e-5)
+
+
+def test_backend_init_failure_falls_back_to_cpu(monkeypatch, capsys):
+    # the accelerator plugin failing fast at first jax use must degrade a
+    # user command to the CPU backend with a warning, not kill it
+    # (observed live: "Unable to initialize backend 'axon'...", r4)
+    from structured_light_for_3d_model_replication_tpu.pipeline import (
+        cli_commands,
+    )
+
+    calls = []
+
+    def flaky_runner(args):
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError(
+                "Unable to initialize backend 'axon': Backend 'axon' is "
+                "not in the list of known backends")
+        return 0
+
+    monkeypatch.setitem(cli_commands._RUNNERS, "flaky", flaky_runner)
+    import argparse
+
+    rc = cli_commands.run(argparse.Namespace(command="flaky"))
+    assert rc == 0 and len(calls) == 2
+    assert "retrying this command on the CPU backend" in capsys.readouterr().err
+
+
+def test_unrelated_runtime_errors_still_propagate(monkeypatch):
+    from structured_light_for_3d_model_replication_tpu.pipeline import (
+        cli_commands,
+    )
+
+    def broken_runner(args):
+        raise RuntimeError("something else entirely")
+
+    monkeypatch.setitem(cli_commands._RUNNERS, "broken", broken_runner)
+    import argparse
+
+    with pytest.raises(RuntimeError, match="something else"):
+        cli_commands.run(argparse.Namespace(command="broken"))
