@@ -177,7 +177,10 @@ def test_flagship_paths_on_accelerator():
         accelerator_preflight,
     )
 
-    status, detail = accelerator_preflight(cwd=_ROOT)
+    # 60 s probe, not the full 180: a healthy tunnel answers init+one-op in
+    # ~5-10 s, and this gate only decides skip-vs-run — during a wedge the
+    # full-length probe burned 3 min of EVERY suite run before skipping
+    status, detail = accelerator_preflight(timeout=60.0, cwd=_ROOT)
     if status != "ok":
         pytest.skip(f"accelerator preflight {status}: {detail}")
     proc = subprocess.run([sys.executable, "-c", _SCRIPT],
