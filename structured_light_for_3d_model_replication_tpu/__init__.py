@@ -10,8 +10,8 @@ Unlike the reference (single-process NumPy/OpenCV/Open3D), the compute core here
 vmapped/shard_mapped JAX running on TPU: decode and triangulation are fused XLA
 programs over the full H x W x bitplane stack, point-cloud neighborhood ops are tiled
 matmul-shaped reductions on the MXU, registration is batched-hypothesis RANSAC plus
-fixed-iteration ICP, and meshing is a grid Poisson solve plus vectorized marching
-cubes. Views shard across chips on a `jax.sharding.Mesh` ("data" axis); pixel rows /
+convergence-stopped ICP, and meshing is a grid Poisson solve plus a vectorized
+Surface Nets extractor. Views shard across chips on a `jax.sharding.Mesh` ("data" axis); pixel rows /
 point blocks shard on the "model" axis.
 
 Subpackage map (reference parity in parentheses, see SURVEY.md section 2):

@@ -114,6 +114,12 @@ class MeshConfig:
     # the slab-sharded multi-device solver (steps down to 9 with a warning
     # when only one device is present)
     depth: int = 10
+    # clamp depth to ~log2(sqrt(N))+1 (a denser grid than the sampling
+    # density is pure cost on a DENSE grid — unlike the reference's octree,
+    # which adapts per sample). False honors the requested depth on a
+    # sparse-but-real scan; the hostile-input guard this cap provides
+    # (50 points -> 512^3 solve) is then the caller's responsibility.
+    density_cap: bool = True
     density_trim_quantile: float = 0.02
     # hybrid normal search radius in WORLD units (Open3D Hybrid semantics);
     # 0 = pure kNN (unit-safe default — a fixed radius is only meaningful

@@ -66,7 +66,8 @@ def reconstruct_mesh(points, valid=None, normals=None,
         log(f"[mesh] ball-pivot surface: {len(verts):,} verts, "
             f"{len(faces):,} faces")
     else:
-        res = _poisson_dispatch(pts, nr, v, cfg.depth, log)
+        res = _poisson_dispatch(pts, nr, v, cfg.depth, log,
+                                density_cap=cfg.density_cap)
         verts, faces = surface_nets.extract_surface(
             res.chi, float(res.iso), origin=np.asarray(res.origin),
             cell=float(res.cell))
@@ -116,12 +117,13 @@ def reconstruct_mesh(points, valid=None, normals=None,
     return verts, faces
 
 
-def _poisson_dispatch(pts, nr, v, depth: int, log):
+def _poisson_dispatch(pts, nr, v, depth: int, log, density_cap: bool = True):
     """Dense single-chip Poisson up to depth 9; depth 10+ runs the
     slab-sharded solver across the device mesh (the reference's octree
     default is depth 10, server/gui.py:118 / processing.py:697-709). With
     too few devices for the requested grid the depth is stepped down with a
-    warning rather than failing the pipeline."""
+    warning rather than failing the pipeline. Depth policy:
+    docs/ARCHITECTURE.md "Poisson depth policy"."""
     import jax
 
     # cap resolution by sampling density: a surface of N samples occupies
@@ -131,12 +133,19 @@ def _poisson_dispatch(pts, nr, v, depth: int, log):
     # grid pays (2^d)^3 everywhere: a 50-point degenerate cloud at the
     # config default depth 10 otherwise steps to a 512^3 dense solve
     # (134M cells, minutes-to-hours; found by hostile-input probing, r4).
+    # mesh.density_cap=false honors the requested depth instead.
     n = int(np.asarray(v).sum())
-    density_cap = max(4, int(np.ceil(np.log2(max(n, 2)) / 2)) + 1)
-    if density_cap < depth:
-        log(f"[mesh] poisson depth {depth} -> {density_cap}: {n} points "
-            f"cannot fill a {1 << depth}^3 grid (cap ~ log2(sqrt(N))+1)")
-        depth = density_cap
+    cap = max(4, int(np.ceil(np.log2(max(n, 2)) / 2)) + 1)
+    if cap < depth:
+        if density_cap:
+            log(f"[mesh] poisson depth {depth} -> {cap}: {n} points "
+                f"cannot fill a {1 << depth}^3 grid (cap ~ log2(sqrt(N))+1; "
+                f"set mesh.density_cap=false to force depth {depth})")
+            depth = cap
+        else:
+            log(f"[mesh] density cap disabled: honoring depth {depth} for "
+                f"{n} points (a {1 << depth}^3 dense grid; cap would have "
+                f"chosen {cap})")
 
     if depth <= 9:
         res = poisson.poisson_solve(pts, nr, v, depth=depth)

@@ -9,6 +9,7 @@ ops/pointcloud_sharded, ops/poisson_sharded, parallel/scan) from drifting.
 from __future__ import annotations
 
 import functools
+import re
 
 try:
     from jax import shard_map
@@ -35,5 +36,9 @@ def is_backend_init_error(exc: BaseException) -> bool:
     live (r4). Shared by the CLI's CPU-fallback retry and the per-item
     tolerance in pipeline stages: an init failure is a process-level
     condition, not an item failure — swallowing it per scan would report
-    every item failed with the same error and defeat the CPU retry."""
-    return "nable to initialize backend" in str(exc)
+    every item failed with the same error and defeat the CPU retry.
+
+    Anchored to the message HEAD: an exception that merely *embeds* the
+    phrase (a RuntimeError carrying a child process's stderr tail, say)
+    must not trigger the CLI's silent full-command re-run on CPU."""
+    return re.match(r"[Uu]nable to initialize backend", str(exc)) is not None

@@ -14,14 +14,21 @@ holder's fd closes — including SIGKILL of the whole process group — so
 there is no stale-lock state to reap after the kills the wedge playbook
 sometimes requires.
 
-Holders spawning TPU-using children set ``SL3D_TPU_LOCK_HELD=1`` in the
-child environment; children then skip acquisition instead of deadlocking
-against their parent's lock.
+Holders spawning TPU-using children set ``SL3D_TPU_LOCK_HELD=<holder pid>``
+in the child environment; children then skip acquisition instead of
+deadlocking against their parent's lock. A pid-valued claim is *watched*:
+the child starts a daemon thread that periodically tries the flock itself
+(non-blocking), and the moment the claim goes free — the holder died while
+the child still runs, e.g. a session killed alone rather than by process
+group — the child re-takes it on its own fd so the tree keeps excluding
+other TPU clients. The legacy value ``1`` is still accepted but arms no
+watcher.
 """
 from __future__ import annotations
 
 import fcntl
 import os
+import threading
 import time
 
 __all__ = ["acquire_tpu_lock", "probe_tpu_lock", "held_by_parent",
@@ -54,7 +61,61 @@ def probe_tpu_lock(root: str) -> tuple[bool, str]:
 
 def held_by_parent() -> bool:
     """True when an ancestor process already holds the lock for us."""
-    return os.environ.get(HOLD_ENV, "") == "1"
+    return os.environ.get(HOLD_ENV, "") not in ("", "0")
+
+
+def _watch_holder(f, holder_pid: int, poll: float) -> None:
+    """Daemon-thread body: if the claim-holding ancestor dies while we
+    run, its flock is gone and a new TPU client could start concurrently
+    with us — the exact wedge the lock exists to prevent.
+
+    The probe is the flock itself, not pid liveness: a non-blocking
+    LOCK_EX attempt fails while ANY claim exists (the parent's, or a
+    sibling orphan's that already re-claimed) and succeeds the moment the
+    file goes free — immune to pid reuse and to zombies (a zombie has
+    closed its fds, releasing the flock, yet still answers kill(pid,0)).
+    ``holder_pid`` is only used to warn when the named holder is provably
+    gone but the lock is held by someone else (a raced external claimant:
+    concurrency already happened; make it visible for the post-mortem)."""
+    import sys
+
+    warned = False
+    while True:
+        time.sleep(poll)
+        try:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except ValueError:
+            return  # our own lock file was closed: this client is done
+        except OSError:
+            # claim still held somewhere — normal while the parent lives
+            if not warned and not _pid_alive(holder_pid):
+                print(f"[tpulock] WARNING: claim holder pid {holder_pid} "
+                      f"is gone but .tpu_lock is held elsewhere — a new "
+                      f"client may be running concurrently with this "
+                      f"orphaned one (pid {os.getpid()})", file=sys.stderr)
+                warned = True
+            continue
+        try:  # claim re-established in THIS process; leave a breadcrumb
+            f.seek(0)
+            f.truncate()
+            f.write(f"pid {os.getpid()} (orphan re-claim) since "
+                    f"{time.strftime('%H:%M:%S')}\n")
+            f.flush()
+        except OSError:
+            pass
+        print(f"[tpulock] claim holder pid {holder_pid} gone — re-taken "
+              f"by orphaned child pid {os.getpid()}", file=sys.stderr)
+        return
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # EPERM etc: assume alive (conservative)
 
 
 def acquire_tpu_lock(root: str, timeout: float = 0.0, poll: float = 5.0):
@@ -69,7 +130,13 @@ def acquire_tpu_lock(root: str, timeout: float = 0.0, poll: float = 5.0):
     path = os.path.join(root, ".tpu_lock")
     f = open(path, "a+")
     if held_by_parent():
-        return f  # parent's flock covers this process tree
+        # parent's flock covers this process tree; when the value names
+        # the holder's pid, watch it so an orphaned child re-claims
+        val = os.environ.get(HOLD_ENV, "")
+        if val.isdigit() and int(val) > 1:
+            threading.Thread(target=_watch_holder,
+                             args=(f, int(val), 10.0), daemon=True).start()
+        return f
     deadline = time.monotonic() + timeout
     while True:
         try:

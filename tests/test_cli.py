@@ -219,8 +219,8 @@ def test_doctor_reports_held_lock(tmp_path, capsys):
          "from structured_light_for_3d_model_replication_tpu.utils import tpulock; "
          "f = tpulock.acquire_tpu_lock(sys.argv[1], timeout=0); "
          "print('held', flush=True); time.sleep(30)",
-         str(tmp_path), _os.path.dirname(_os.path.dirname(
-             _os.path.abspath(tpulock.__file__)))],
+         str(tmp_path), _os.path.dirname(_os.path.dirname(_os.path.dirname(
+             _os.path.abspath(tpulock.__file__))))],
         stdout=subprocess.PIPE, text=True,
         env={k: v for k, v in _os.environ.items() if k != tpulock.HOLD_ENV})
     try:

@@ -1,0 +1,71 @@
+"""Two-process jax.distributed bring-up: the path parallel/multihost.py
+exists for. Spawns two CPU processes against a local coordinator; each
+joins the group via ``multihost.initialize``, builds the GLOBAL mesh (4
+devices across 2 processes), and psums a token across every device —
+proving the coordinator handshake, the global device view, and a real
+cross-process collective (gloo), not just the single-process no-op that
+test_aux_capture.py pins."""
+import os
+import socket
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = """
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")  # the env var alone loses to sitecustomize
+addr, pid = sys.argv[1], int(sys.argv[2])
+from structured_light_for_3d_model_replication_tpu.parallel import multihost
+assert multihost.initialize(coordinator_address=addr, num_processes=2,
+                            process_id=pid), "initialize returned False"
+assert multihost.is_multiprocess(), "process_count still 1"
+s = multihost.process_summary()
+assert s["process_count"] == 2 and s["global_devices"] == 4, s
+assert s["local_devices"] == 2, s
+mesh = multihost.global_mesh()
+assert mesh.devices.size == 4, mesh
+import jax.numpy as jnp
+y = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(
+    jnp.ones((jax.local_device_count(),)))
+assert float(y[0]) == 4.0, y
+print(f"proc{pid} ok", flush=True)
+"""
+
+
+def test_two_process_group_global_mesh_and_psum(tmp_path):
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # a fresh backend per child: none of the parent's virtual-device flags
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _CHILD, addr, str(i)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"proc{i} rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
+        assert f"proc{i} ok" in out
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -75,6 +75,36 @@ def test_dense_guard_points_to_sharded(rng):
         raise AssertionError("expected ValueError at depth 10 dense")
 
 
+def test_density_cap_knob_honors_requested_depth(rng, monkeypatch):
+    # mesh.density_cap=false: a sparse-but-real scan may want the requested
+    # depth even though the cap heuristic would clamp it (ADVICE r4) —
+    # the dispatch must honor it and log the rationale instead
+    import types
+
+    from structured_light_for_3d_model_replication_tpu.models import meshing
+
+    seen = {}
+
+    def fake_solve(pts, nr, v, depth):
+        seen["depth"] = depth
+        # the depth<=9 branch logs res.iso, so the stub needs one
+        return types.SimpleNamespace(iso=0.0)
+
+    monkeypatch.setattr(meshing.poisson, "poisson_solve", fake_solve)
+    pts, nrm = _sphere(rng, n=500)  # cap heuristic would choose ~6
+    v = np.ones(len(pts), bool)
+    logs = []
+    meshing._poisson_dispatch(pts, nrm, v, depth=8, log=logs.append,
+                              density_cap=False)
+    assert seen["depth"] == 8
+    assert any("density cap disabled" in m for m in logs)
+    # default (cap on) still clamps and names the escape hatch
+    logs.clear()
+    meshing._poisson_dispatch(pts, nrm, v, depth=8, log=logs.append)
+    assert seen["depth"] < 8
+    assert any("density_cap=false" in m for m in logs)
+
+
 def test_depth10_default_steps_down_on_cpu(rng, monkeypatch):
     # MeshConfig.depth now defaults to 10 (the reference default); on the
     # CPU test platform the dispatch must step down to dense depth 9, not

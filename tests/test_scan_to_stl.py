@@ -1,0 +1,198 @@
+"""The flagship Tab-6 flow end-to-end through the CLI: ``auto-scan`` (sim
+turntable + a protocol-faithful *rendering* phone over live HTTP) ->
+``reconstruct`` -> ``merge-360`` -> ``mesh``, asserting a valid STL of the
+synthetic object (reference: server/gui.py:1700-1787 drives exactly this
+chain from the GUI's auto-scan tab).
+
+The phone here is a physical-camera simulation: it pre-renders the scene
+under every Gray-code pattern at every turntable pose and uploads frame k of
+view v on the (v*F+k)-th capture command. It reads NOTHING from the rig —
+pattern order (01.png..NN.png) and frames-per-view are the wire contract
+(SURVEY.md §2: the 46-frame file contract), and the sweep's rotation
+schedule (turns x step) is the auto-scan contract, so a real phone pointed
+at a real projector would produce byte-equivalent uploads."""
+import json
+import os
+import socket
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.cli import main as cli_main
+from structured_light_for_3d_model_replication_tpu.io import images as imio
+from structured_light_for_3d_model_replication_tpu.io import matfile
+from structured_light_for_3d_model_replication_tpu.io import ply as plyio
+from structured_light_for_3d_model_replication_tpu.io import stl as stlio
+from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
+from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+
+CAM, PROJ = (160, 120), (128, 64)
+TURNS, STEP = 3, 120.0
+PIVOT_DEPTH = 420.0  # sphere_on_background center depth
+RADIUS = 70.0
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _render_sweep(rig, tmpdir):
+    """Pre-encode the full sweep as PNG payloads: what a phone's camera
+    would capture, frame by frame, as auto-scan drives projector+table."""
+    scene = syn.sphere_on_background(depth=PIVOT_DEPTH, radius=RADIUS)
+    obj, background = scene.objects  # the table rotates the object, not the wall
+    pivot = np.array([0.0, 0.0, PIVOT_DEPTH])
+    payloads = []
+    for i, (R, t) in enumerate(syn.turntable_poses(TURNS, STEP, pivot)):
+        frames, _ = syn.render_scene(
+            rig, syn.Scene([obj.transformed(R, t), background]))
+        for k, frame in enumerate(frames):
+            p = os.path.join(tmpdir, f"enc_{i}_{k}.png")
+            imio.save_image(p, frame)
+            payloads.append(open(p, "rb").read())
+    assert len(payloads) == TURNS * gc.frames_per_view(*PROJ)
+    return payloads
+
+
+class RenderingPhone(threading.Thread):
+    """Long-polls /poll_command, dedups command ids, answers each fresh
+    capture with the next pre-rendered frame — the FakePhone of
+    test_acquire.py with a camera instead of a constant payload."""
+
+    def __init__(self, base_url: str, payloads: list[bytes]):
+        super().__init__(daemon=True)
+        self.base = base_url
+        self.payloads = payloads
+        self.stop_flag = threading.Event()
+        self.captures = 0
+        self.errors: list[str] = []
+        self.last_id = None
+
+    def run(self):
+        while not self.stop_flag.is_set():
+            try:
+                with urllib.request.urlopen(self.base + "/poll_command",
+                                            timeout=5) as r:
+                    cmd = json.loads(r.read())
+            except OSError:
+                # server not up yet / long-poll idle timeout: retry gently
+                self.stop_flag.wait(0.05)
+                continue
+            if cmd["action"] == "capture" and cmd["id"] != self.last_id:
+                self.last_id = cmd["id"]
+                if self.captures >= len(self.payloads):
+                    self.errors.append("capture past pre-rendered sweep")
+                    return
+                body, ctype = self._multipart(self.payloads[self.captures])
+                try:
+                    req = urllib.request.Request(
+                        self.base + "/upload", data=body,
+                        headers={"Content-Type": ctype}, method="POST")
+                    with urllib.request.urlopen(req, timeout=5) as r:
+                        if json.loads(r.read())["status"] != "ok":
+                            self.errors.append("upload not ok")
+                except OSError as e:  # pragma: no cover - diagnostic path
+                    self.errors.append(f"upload failed: {e}")
+                self.captures += 1
+
+    @staticmethod
+    def _multipart(payload: bytes):
+        boundary = "sweepboundary7"
+        body = (
+            f"--{boundary}\r\n"
+            'Content-Disposition: form-data; name="file"; filename="f.png"\r\n'
+            "Content-Type: image/png\r\n\r\n"
+        ).encode() + payload + f"\r\n--{boundary}--\r\n".encode()
+        return body, f"multipart/form-data; boundary={boundary}"
+
+
+@pytest.fixture(scope="module")
+def swept_scans(tmp_path_factory):
+    """Drive ``sl3d auto-scan`` against the rendering phone."""
+    tmp = tmp_path_factory.mktemp("sweep")
+    rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+    payloads = _render_sweep(rig, str(tmp))
+    port = _free_port()
+    phone = RenderingPhone(f"http://127.0.0.1:{port}", payloads)
+    phone.start()
+    scans_root = str(tmp / "scans")
+    try:
+        rc = cli_main([
+            "auto-scan", scans_root,
+            "--set", "acquire.simulate=true",
+            "--set", f"acquire.http_port={port}",
+            "--set", f"acquire.turns={TURNS}",
+            "--set", f"acquire.degrees_per_turn={STEP}",
+            "--set", "acquire.settle_ms_scan=0",
+            "--set", "acquire.rotate_timeout_s=10",
+            "--set", "acquire.capture_timeout_s=30",
+            "--set", f"projector.width={PROJ[0]}",
+            "--set", f"projector.height={PROJ[1]}",
+        ])
+    finally:
+        phone.stop_flag.set()
+        phone.join(timeout=5)
+    assert rc == 0
+    assert phone.errors == []
+    assert phone.captures == len(payloads)
+    calib = str(tmp / "calib.mat")
+    matfile.save_calibration(calib, rig.calibration())
+    return scans_root, calib
+
+
+def test_auto_scan_wrote_view_contract(swept_scans):
+    scans_root, _ = swept_scans
+    views = sorted(os.listdir(scans_root))
+    assert views == ["scan_000deg_scan", "scan_120deg_scan",
+                     "scan_240deg_scan"]
+    n = gc.frames_per_view(*PROJ)
+    for v in views:
+        names = sorted(os.listdir(os.path.join(scans_root, v)))
+        assert names[0] == "01.png" and len(names) == n
+
+
+def test_scan_to_stl_chain(swept_scans, tmp_path):
+    scans_root, calib = swept_scans
+
+    views_dir = str(tmp_path / "views")
+    rc = cli_main(["reconstruct", scans_root, "--calib", calib,
+                   "--mode", "batch", "--output", views_dir,
+                   "--set", f"decode.n_cols={PROJ[0]}",
+                   "--set", f"decode.n_rows={PROJ[1]}",
+                   "--set", "decode.thresh_mode=manual"])
+    assert rc == 0
+    plys = [f for f in os.listdir(views_dir) if f.endswith(".ply")]
+    assert len(plys) == TURNS
+    for f in plys:
+        assert len(plyio.read_ply(os.path.join(views_dir, f))["points"]) > 500
+
+    merged = str(tmp_path / "merged.ply")
+    rc = cli_main(["merge-360", views_dir, merged,
+                   "--set", "merge.voxel_size=4.0",
+                   "--set", "merge.ransac_trials=1024",
+                   "--set", "merge.icp_iters=15",
+                   "--set", "merge.final_voxel=0",
+                   "--set", "merge.outlier_nb=0"])
+    assert rc == 0
+    pts = plyio.read_ply(merged)["points"]
+    assert len(pts) > 1000
+
+    out_stl = str(tmp_path / "model.stl")
+    rc = cli_main(["mesh", merged, out_stl,
+                   "--set", "mesh.depth=5",
+                   "--set", "mesh.density_trim_quantile=0"])
+    assert rc == 0
+    verts, faces, _ = stlio.read_stl(out_stl)
+    assert len(faces) > 50
+    # the mesh must actually contain the scanned object: some surface near
+    # the sphere (r=70 about [0,0,420]), and nothing wildly out of scene
+    d = np.linalg.norm(verts - np.array([0.0, 0.0, PIVOT_DEPTH]), axis=1)
+    assert d.min() < RADIUS * 1.3
+    assert np.isfinite(verts).all()
+    assert verts[:, 2].max() < 700.0  # scene back wall is at z=560

@@ -143,6 +143,60 @@ def test_tpu_lock_parent_held_passthrough(tmp_path, monkeypatch):
     first.close()
 
 
+def test_tpu_lock_orphan_child_reclaims(tmp_path, monkeypatch):
+    """A pid-valued HOLD_ENV claim is watched: when the holding ancestor
+    dies while the covered child still runs, the child must re-take the
+    flock itself — otherwise the orphaned TPU client runs claim-less and a
+    new client can start concurrently (the documented wedge trigger)."""
+    import signal
+
+    from structured_light_for_3d_model_replication_tpu.utils import tpulock
+
+    env = {k: v for k, v in os.environ.items() if k != tpulock.HOLD_ENV}
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    holder = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys, time; "
+         "from structured_light_for_3d_model_replication_tpu.utils import "
+         "tpulock; "
+         "f = tpulock.acquire_tpu_lock(sys.argv[1], timeout=0); "
+         "print('held', flush=True); time.sleep(120)",
+         str(tmp_path)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    child_f = None
+    try:
+        assert holder.stdout.readline().strip() == "held"
+        # covered child; acquire_tpu_lock also arms the 10 s production
+        # watcher on this fd — the extra 0.1 s-poll thread below is the one
+        # this test waits on (both probe the same flock; idempotent)
+        monkeypatch.setenv(tpulock.HOLD_ENV, str(holder.pid))
+        child_f = tpulock.acquire_tpu_lock(str(tmp_path), timeout=0)
+        assert child_f is not None
+        import threading
+
+        t = threading.Thread(target=tpulock._watch_holder,
+                             args=(child_f, holder.pid, 0.1), daemon=True)
+        t.start()
+        holder.send_signal(signal.SIGKILL)
+        holder.wait()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        # the claim must be held again — now by US (this process)
+        held, detail = tpulock.probe_tpu_lock(str(tmp_path))
+        assert held, detail
+        assert str(os.getpid()) in detail and "orphan re-claim" in detail
+        child_f.close()
+        child_f = None
+        held, _ = tpulock.probe_tpu_lock(str(tmp_path))
+        assert not held
+    finally:
+        if child_f is not None:
+            child_f.close()
+        if holder.poll() is None:
+            holder.kill()
+            holder.wait()
+
+
 def test_tpu_lock_released_by_sigkill(tmp_path):
     """The no-stale-lock property the design rests on: the kernel drops the
     flock the instant the holder dies — even SIGKILL, the signal the wedge

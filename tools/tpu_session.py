@@ -88,7 +88,11 @@ def run_step(name: str, cmd, limit: int) -> tuple[int, str]:
     proc = subprocess.Popen(cmd, cwd=ROOT, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True,
                             start_new_session=True,
-                            env={**os.environ, tpulock.HOLD_ENV: "1"})
+                            env={**os.environ,
+                                 # pid-valued: children watch this holder
+                                 # and re-claim the flock if it dies (see
+                                 # utils/tpulock._watch_holder)
+                                 tpulock.HOLD_ENV: str(os.getpid())})
     try:
         out, err = proc.communicate(timeout=limit)
         rc = proc.returncode
