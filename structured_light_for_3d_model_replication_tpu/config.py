@@ -162,7 +162,10 @@ class ParallelConfig:
     data_axis: int = 0      # shards turntable views; 0 = use all available devices
     model_axis: int = 1     # shards pixel rows / point blocks within a view
     backend: str = "jax"    # 'jax' | 'numpy' (bit-exact CPU reference path)
-    use_bf16_features: bool = True  # bf16 for feature/dist matmuls, fp32 accumulation
+    # bf16 FPFH feature-distance matmuls with f32 accumulation (one MXU
+    # pass vs HIGHEST's three) on accelerator backends; geometry stays f32.
+    # true = auto (bf16 on accelerators, f32 on hosts); false = f32 everywhere
+    use_bf16_features: bool = True
     # run the 360 merge over a device mesh (register_pairs_sharded + slab-
     # sharded postprocess; for method='posegraph' the edge registrations
     # shard and only the small host-side pose-graph solve stays local)

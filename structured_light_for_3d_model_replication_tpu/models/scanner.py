@@ -100,9 +100,11 @@ class SLScanner:
                              self.poly_col, self.poly_row,
                              jnp.float32(self.epipolar_tol), cfg=self._static)
 
-    def _can_fuse(self, frames_v) -> bool:
+    def _fuse_capable(self, frames_v) -> bool:
         """The single-pass Mosaic kernel handles the flagship configuration:
-        quadratic plane eval, row_mode 0/1, uint8 tile-aligned frames."""
+        quadratic plane eval, row_mode 0/1, uint8 tile-aligned frames.
+        Capability only — whether the fused lowering CAN run, not whether
+        auto-dispatch should pick it (see ``_can_fuse``)."""
         from structured_light_for_3d_model_replication_tpu.ops import (
             pallas_kernels as pk,
         )
@@ -117,6 +119,19 @@ class SLScanner:
                 and frames_v.shape[-3] >= need
                 and (w, h) == self.cam_size   # frames match the calibrated camera
                 and h % 8 == 0 and w % 128 == 0)
+
+    def _can_fuse(self, frames_v) -> bool:
+        """Auto-dispatch policy: capability AND the explicit opt-in. The
+        on-chip A/B (r4 window: fused 0.1747 s vs jnp 0.1045 s at 24 views
+        @1080p, BENCH_NOTES.md) measured the hand-written kernel SLOWER
+        than XLA's own lowering of the same arithmetic, so jnp is the
+        default and the fused kernel stays behind ``SLSCAN_PALLAS=1``
+        until a measurement says otherwise."""
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            pallas_kernels as pk,
+        )
+
+        return pk.scan_fused_requested() and self._fuse_capable(frames_v)
 
     def _fused_views(self, frames_v, shadow_v, contrast_v) -> CloudResult:
         from structured_light_for_3d_model_replication_tpu.ops import (
@@ -170,11 +185,10 @@ class SLScanner:
         frames_v = jnp.asarray(frames_v)
         ss, cs = graycode.resolve_thresholds_views(frames_v, thresh_mode,
                                                    shadow_val, contrast_val)
-        can = self._can_fuse(frames_v)
-        if use_fused and not can:
+        if use_fused and not self._fuse_capable(frames_v):
             raise ValueError("use_fused=True but this configuration cannot "
-                             "take the fused Mosaic kernel (see _can_fuse)")
-        if can if use_fused is None else use_fused:
+                             "take the fused Mosaic kernel (see _fuse_capable)")
+        if self._can_fuse(frames_v) if use_fused is None else use_fused:
             return self._fused_views(frames_v, ss, cs)
         return _scan_forward_views(frames_v, jnp.asarray(ss, jnp.float32),
                                    jnp.asarray(cs, jnp.float32), self.rays,

@@ -242,10 +242,15 @@ def _decode_impl(
         if (pk.use_pallas() and frames.dtype == jnp.uint8
                 and h % 8 == 0 and w % 128 == 0):
             # fused Pallas path: one VMEM pass over the stack (bit-exact twin
-            # of the arithmetic below; gated to tile-aligned frames).  The
-            # except arm only helps eager callers — under an outer jit a
-            # Mosaic failure surfaces at that jit's compile; the compiled-
-            # kernel probe in pallas_mode() is the guard for that case.
+            # of the arithmetic below; gated to tile-aligned frames). This
+            # decode-maps kernel stays AUTO — it was active inside the r4
+            # A/B's faster "jnp" arm (0.1045 s), so it is part of the
+            # measured winner; only the single-pass scan kernel
+            # (scan_points_fused_views) measured slower and sits behind the
+            # SLSCAN_PALLAS=1 opt-in. The except arm only helps eager
+            # callers — under an outer jit a Mosaic failure surfaces at that
+            # jit's compile; the compiled-kernel probe in pallas_mode() is
+            # the guard for that case.
             try:
                 col, row, mask = pk.decode_maps_fused(
                     frames, shadow_thresh, contrast_thresh,

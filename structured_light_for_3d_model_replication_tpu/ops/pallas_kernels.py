@@ -144,6 +144,20 @@ def use_pallas() -> bool:
     return pallas_mode() == "compiled"
 
 
+def scan_fused_requested() -> bool:
+    """Explicit opt-in for the single-pass fused SCAN Mosaic kernel
+    (scan_points_fused_views: decode + triangulate in one kernel).
+
+    The on-chip A/B (r4: fused scan 0.1747 s vs the jnp lowering's
+    0.1045 s, 24 views @1080p) measured this kernel slower, so it is no
+    longer the auto-dispatch default: ``SLSCAN_PALLAS=1`` (or
+    ``force``/``fused``) requests it. Every other Mosaic kernel —
+    including decode_maps_fused, which ran INSIDE the winning "jnp" arm,
+    plus nn1 and radius_count — stays auto whenever ``use_pallas()``."""
+    return os.environ.get("SLSCAN_PALLAS", "").strip().lower() in (
+        "1", "on", "true", "force", "fused")
+
+
 def _interpret() -> bool:
     return not use_pallas()
 

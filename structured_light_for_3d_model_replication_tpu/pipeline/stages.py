@@ -316,15 +316,18 @@ def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
         if mesh is not None:
             log(f"[merge] sharding the chain over "
                 f"{mesh.devices.size} devices (parallel.merge_mesh)")
+    # parallel.use_bf16_features=true keeps the auto policy (bf16 feature
+    # matmuls on accelerators only); false forces f32 everywhere
+    fb16 = None if cfg.parallel.use_bf16_features else False
     with prof.trace():
         if cfg.merge.method == "posegraph":
             points, colors, transforms = recon.merge_360_posegraph(
                 clouds, cfg.merge, log=log, step_callback=step_callback,
-                mesh=mesh)
+                mesh=mesh, feat_bf16=fb16)
         else:
             points, colors, transforms = recon.merge_360(
                 clouds, cfg.merge, log=log, step_callback=step_callback,
-                mesh=mesh)
+                mesh=mesh, feat_bf16=fb16)
     ply.write_ply(output_ply, points, colors)
     log(f"[merge] wrote {output_ply} ({len(points):,} points)")
     return points, colors, transforms

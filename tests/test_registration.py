@@ -70,6 +70,31 @@ def test_ransac_global_registration_large_rotation(rng):
     assert np.median(err) < 5.0, np.median(err)
 
 
+def test_ransac_bf16_feature_matmul_still_aligns(rng):
+    """parallel.use_bf16_features wiring: the bf16 feature cross product
+    (the accelerator default — one MXU pass instead of HIGHEST's three)
+    only picks argmin correspondences; RANSAC + refine must still recover
+    the pose. Forced on here so the CPU suite exercises the arm the TPU
+    runs by default."""
+    dst = _rand_cloud(rng, 3000)
+    R = np.asarray(syn.rotate_y(30.0), np.float32)
+    t = np.array([12.0, 2.0, -6.0], np.float32)
+    src = _transform(R.T, -R.T @ t, dst)
+    vd = jnp.ones(len(dst), bool)
+    nd = nrmlib.estimate_normals(jnp.asarray(dst), vd, 20)
+    ns_ = nrmlib.estimate_normals(jnp.asarray(src), vd, 20)
+    fd = reg.fpfh_features(jnp.asarray(dst), nd, vd, radius=12.0, k=48)
+    fs = reg.fpfh_features(jnp.asarray(src), ns_, vd, radius=12.0, k=48)
+    res = reg.ransac_global_registration(src, fs, None, dst, fd, None,
+                                         max_dist=5.0, trials=2048,
+                                         feat_bf16=True)
+    assert float(res.fitness) > 0.5, float(res.fitness)
+    T = np.asarray(res.transform)
+    moved = _transform(T[:3, :3], T[:3, 3], src)
+    err = np.linalg.norm(moved - dst, axis=1)
+    assert np.median(err) < 5.0, np.median(err)
+
+
 def test_ransac_2048_trials_on_low_overlap_pair(rng):
     """Second validation scene for the trials default (ADVICE r3): the 2048
     default was picked on the bench scene's high-overlap chain pairs; this
@@ -194,13 +219,18 @@ def test_merge_device_accumulate_matches_host_path(rng, monkeypatch):
     cfg = MergeConfig(voxel_size=2.0, ransac_trials=1024, icp_iters=15,
                       final_voxel=1.0, outlier_nb=10)
 
-    p_host, c_host, T_h = rec.merge_360(clouds, cfg, log=lambda *a: None)
+    # pin feat_bf16 explicitly: the faked "tpu" backend below would flip
+    # the auto bf16-feature policy between the two runs, and this test is
+    # about the accumulate path, not the matmul precision policy
+    p_host, c_host, T_h = rec.merge_360(clouds, cfg, log=lambda *a: None,
+                                        feat_bf16=False)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     called = []
     orig_acc = rec._accumulate_views_jit
     monkeypatch.setattr(rec, "_accumulate_views_jit",
                         lambda *a: (called.append(1), orig_acc(*a))[1])
-    p_dev, c_dev, T_d = rec.merge_360(clouds, cfg, log=lambda *a: None)
+    p_dev, c_dev, T_d = rec.merge_360(clouds, cfg, log=lambda *a: None,
+                                      feat_bf16=False)
     assert called, "device-accumulate path did not activate"
 
     # registration is identical (same seed/code) -> transforms match...

@@ -127,6 +127,36 @@ out["bitexact_on_device"] = bool(
     (np.asarray(c_bx.points) == c_np.points).all()
     and (np.asarray(c_bx.valid) == c_np.valid).all())
 
+# on-device decode+triangulate CHAMFER vs the bit-exact NumPy twin on a
+# real rendered scene (r4 regression class: the one recovery window
+# measured 0.064 mm — 500x round 3 — from the fused kernel's plane
+# normalization; the fix landed CPU-validated only. Pin BOTH lowerings
+# here so device f32 divide/rsqrt behavior is asserted on hardware.)
+from structured_light_for_3d_model_replication_tpu.models.reconstruction import (
+    chamfer_distance,
+)
+MCAM = (512, 256)   # tile-aligned so the fused lowering is capable too
+mrig = syn.default_rig(cam_size=MCAM, proj_size=MCAM)
+vf, _ = syn.render_scene(mrig, syn.sphere_on_background())
+dec_np = gc.decode_stack_np(np.asarray(vf), n_cols=MCAM[0], n_rows=MCAM[1],
+                            thresh_mode="manual")
+cl_np = tri.triangulate_np(dec_np.col_map, dec_np.row_map, dec_np.mask,
+                           dec_np.texture, mrig.calibration(), row_mode=1)
+np_pts, _ = tri.compact_cloud(cl_np)
+sc2 = SLScanner(mrig.calibration(), MCAM, MCAM, row_mode=1,
+                plane_eval="quadratic")
+stack1 = jnp.asarray(vf)[None]
+r_jnp = sc2.forward_views(stack1, thresh_mode="manual", use_fused=False)
+dev_pts = np.asarray(r_jnp.points[0])[np.asarray(r_jnp.valid[0])]
+out["chamfer_n_dev"] = int(len(dev_pts))
+out["chamfer_mm_jnp"] = float(chamfer_distance(dev_pts, np_pts)) \
+    if len(dev_pts) else None
+if sc2._fuse_capable(stack1):
+    r_f = sc2.forward_views(stack1, thresh_mode="manual", use_fused=True)
+    f_pts = np.asarray(r_f.points[0])[np.asarray(r_f.valid[0])]
+    out["chamfer_mm_fused"] = float(chamfer_distance(f_pts, np_pts)) \
+        if len(f_pts) else None
+
 # kabsch orthogonality ON DEVICE: the TPU's bf16-class default matmul
 # precision bent rotations by 2e-2 before the precision pins; the CPU
 # suite cannot see that class of error
@@ -202,3 +232,13 @@ def test_flagship_paths_on_accelerator():
     # host, so it must hold on ANY backend (device-eager could not — TPU
     # f32 divide/rsqrt rounding, measured false on the real chip, r4)
     assert out.get("bitexact_on_device") is True, out
+    # hard contract (VERDICT r4 #3): on-device chamfer vs the NumPy twin
+    # must be sub-micron-class on a real rendered scene — the r4 window's
+    # 0.064 mm fused-kernel regression must be shown dead on hardware,
+    # for BOTH lowerings (the fused one stays reachable via SLSCAN_PALLAS)
+    assert out.get("chamfer_n_dev", 0) > 1000, out
+    assert out.get("chamfer_mm_jnp") is not None \
+        and out["chamfer_mm_jnp"] < 1e-3, out
+    if "chamfer_mm_fused" in out:
+        assert out.get("chamfer_mm_fused") is not None \
+            and out["chamfer_mm_fused"] < 1e-3, out

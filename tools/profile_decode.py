@@ -58,18 +58,22 @@ def main() -> None:
         jnp.stack([jnp.roll(base, i * 7, axis=2) for i in range(views)]))
 
     ref_pts = None
-    for label, plane_eval, force_jnp in (("table-jnp", "table", True),
-                                         ("quad-jnp", "quadratic", True),
-                                         ("quad-auto", "quadratic", False)):
+    # the fused arm forces use_fused=True (auto-dispatch now defaults to
+    # jnp after the r4 on-chip A/B; the profiler measures both regardless)
+    for label, plane_eval, fused in (("table-jnp", "table", False),
+                                     ("quad-jnp", "quadratic", False),
+                                     ("quad-fused", "quadratic", True)):
         sc = SLScanner(rig.calibration(), cam, cam, row_mode=1,
                        plane_eval=plane_eval)
-        if force_jnp:
-            sc._can_fuse = lambda f: False  # pin the jnp lowering
-        path = "fused" if (not force_jnp and sc._can_fuse(stack)) else "jnp"
+        if fused and not sc._fuse_capable(stack):
+            print(f"{label:10s} fused kernel unavailable for this shape")
+            continue
+        path = "fused" if fused else "jnp"
 
         def run():
             out = sc.forward_views(stack, thresh_mode="manual",
-                                   shadow_val=40.0, contrast_val=10.0)
+                                   shadow_val=40.0, contrast_val=10.0,
+                                   use_fused=fused)
             jax.block_until_ready(out.points)
             return out
 
@@ -100,7 +104,7 @@ def main() -> None:
     # evidence, not theory.
     sc = SLScanner(rig.calibration(), cam, cam, row_mode=1,
                    plane_eval="quadratic")
-    if not sc._can_fuse(stack):
+    if not sc._fuse_capable(stack):
         print("fused kernel unavailable for this shape — no tile sweep")
         return
     rays = sc.rays.reshape(cam[1], cam[0], 3)

@@ -179,7 +179,8 @@ def main() -> None:
                jnp.stack([x.valid for x in dsts]),
                jnp.stack([x.features for x in dsts]),
                jnp.stack([x.normals for x in dsts]))
-    for trials, icp_iters in ((4096, 30), (2048, 30), (1024, 30), (2048, 10)):
+    for trials, icp_iters in ((4096, 30), (2048, 30), (1024, 30), (2048, 10),
+                              (1024, 15)):
         t = np.inf
         for _ in range(2):
             t0 = time.perf_counter()
