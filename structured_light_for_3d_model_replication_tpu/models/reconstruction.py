@@ -431,25 +431,16 @@ def _postprocess_merged(points, colors, cfg: MergeConfig,
         valid = valid[:: cfg.sample_after]
     if cfg.outlier_nb > 0:
         t0 = _time.perf_counter()
-        if jax.default_backend() == "cpu":
-            # degraded mode: the cKDTree twin computes the identical
-            # Open3D statistics ~13x faster than the host grid-hash kNN
-            # (22.3 s -> 1.7 s at the bench's 170k merged cloud, r4
-            # VERDICT weak #5) — on the backend users hit when the
-            # accelerator is wedged, the np twin IS the fast path
-            m = pc.statistical_outlier_mask_np(
-                np.asarray(points), np.asarray(valid),
-                cfg.outlier_nb, cfg.outlier_std)
-        else:
-            # after the final voxel pass cells hold (near-)single
-            # occupants (uniform sampling keeps that property) — the
-            # voxelized fast path probes a bounded cell neighborhood
-            # instead of dense distance rows
-            cell = (float(cfg.final_voxel)
-                    if cfg.final_voxel and cfg.final_voxel > 0 else None)
-            m = np.asarray(pc.statistical_outlier_mask(
-                jnp.asarray(points), jnp.asarray(valid),
-                cfg.outlier_nb, cfg.outlier_std, voxelized_cell=cell))
+        # after the final voxel pass cells hold (near-)single occupants
+        # (uniform sampling keeps that property) — the voxelized fast
+        # path probes a bounded cell neighborhood instead of dense
+        # distance rows. On host backends at this scale the op itself
+        # delegates to the cKDTree twin (degraded-mode fast path).
+        cell = (float(cfg.final_voxel)
+                if cfg.final_voxel and cfg.final_voxel > 0 else None)
+        m = np.asarray(pc.statistical_outlier_mask(
+            jnp.asarray(points), jnp.asarray(valid),
+            cfg.outlier_nb, cfg.outlier_std, voxelized_cell=cell))
         keep = np.asarray(valid) & m
         points = np.asarray(points)[keep]
         colors = np.asarray(colors)[keep]

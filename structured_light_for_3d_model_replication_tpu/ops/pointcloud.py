@@ -83,11 +83,23 @@ def statistical_outlier_mask(points, valid, nb_neighbors: int = 20,
     the probe cannot certify. Results match the generic path exactly
     (same Open3D statistics). Without the hint, large accelerator clouds
     estimate an equivalent cell from the median nearest-neighbor spacing.
-    Ignored on host backends (grid kNN is faster there) and when the grid
-    would not fit 1024 cells/axis."""
-    accel = (not isinstance(points, jax.core.Tracer)
-             and jax.default_backend() != "cpu")
+    Ignored on host backends — concrete host calls above 32768 points
+    delegate to the cKDTree twin instead (same statistics, ~13x faster
+    than the host grid kNN) — and when the grid would not fit 1024
+    cells/axis."""
+    concrete = not (isinstance(points, jax.core.Tracer)
+                    or isinstance(valid, jax.core.Tracer))
+    accel = concrete and jax.default_backend() != "cpu"
     n = points.shape[0]
+    if concrete and not accel and n > 32768:
+        # host backend at production scale: the cKDTree twin computes the
+        # identical Open3D statistics ~13x faster than the host grid kNN
+        # (22.3 s -> 1.7 s at the bench's 170k merged cloud, r5) — on the
+        # backend users hit when the accelerator is wedged, the np twin
+        # IS the fast path. Small clouds stay on the jax arm (no win to
+        # harvest there, and the CPU parity tests keep their teeth).
+        return jnp.asarray(statistical_outlier_mask_np(
+            np.asarray(points), np.asarray(valid), nb_neighbors, std_ratio))
     if accel and not (approximate and voxelized_cell is None):
         # accelerators only: on hosts the 729-offset searchsorted probe is
         # ~2x slower than the grid-hash kNN (measured 69 s vs 29 s on the

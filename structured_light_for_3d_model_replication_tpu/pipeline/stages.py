@@ -254,6 +254,8 @@ def clean_cloud(input_ply: str, output_ply: str, cfg: Config | None = None,
                                  radius=ccfg.radius,
                                  nb_points=ccfg.radius_nb_points))
         elif step == "statistical":
+            # degraded jax-on-CPU delegates inside the op itself to the
+            # cKDTree twin at production scale (see statistical_outlier_mask)
             fn = (pc.statistical_outlier_mask_np if use_np
                   else pc.statistical_outlier_mask)
             keep = np.asarray(fn(pts if use_np else jnp.asarray(pts),
