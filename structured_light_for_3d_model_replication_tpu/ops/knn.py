@@ -318,7 +318,12 @@ def _radius_blocks(points, valid, radius, block_q: int, block_b: int,
 
 def knn_np(points: np.ndarray, valid: np.ndarray | None, k: int,
            exclude_self: bool = True):
-    """cKDTree reference. Same contract as knn() (unpadded N allowed)."""
+    """cKDTree reference. Same contract as knn() (unpadded N allowed).
+
+    This twin IS the production host path at merged-cloud scale (see
+    statistical_outlier_mask's delegation), so the common case — at
+    least k+1 valid points — is fully vectorized; only degenerate tiny
+    clouds take the per-row fill loop."""
     from scipy.spatial import cKDTree
 
     n = points.shape[0]
@@ -328,9 +333,25 @@ def knn_np(points: np.ndarray, valid: np.ndarray | None, k: int,
     tree = cKDTree(points[vi])
     kk = k + 1 if exclude_self else k
     kk = min(kk, len(vi))
-    d, j = tree.query(points, k=kk)
-    d = np.atleast_2d(d)
-    j = np.atleast_2d(j)
+    d, j = tree.query(points, k=kk, workers=-1)
+    # scipy squeezes the k axis when kk == 1; restore the (n, kk) contract
+    # explicitly (np.atleast_2d would put the restored axis on the wrong
+    # side, silently transposing the outputs)
+    d = np.asarray(d).reshape(n, kk)
+    j = np.asarray(j).reshape(n, kk)
+    if exclude_self and kk == k + 1:
+        # every row has >= k non-self candidates: drop the (at most one)
+        # self entry by inf-ing it and re-taking the k smallest — d is
+        # already sorted, so a stable argsort only moves the self slot
+        cand = vi[j]                                   # [n, k+1] global ids
+        dd = np.where(cand == np.arange(n)[:, None], np.inf, d)
+        order = np.argsort(dd, axis=1, kind="stable")[:, :k]
+        rows = np.arange(n)[:, None]
+        return (cand[rows, order].astype(np.int32),
+                (dd[rows, order].astype(np.float32) ** 2))
+    if not exclude_self and kk == k:
+        return (vi[j].astype(np.int32), (d.astype(np.float32) ** 2))
+    # degenerate: fewer valid points than k(+1) — per-row fill
     idx = np.zeros((n, k), np.int32)
     d2 = np.full((n, k), np.inf, np.float32)
     for row in range(n):

@@ -110,3 +110,26 @@ def test_knn_dense_approx_matches_exact(big_cloud):
     np.testing.assert_allclose(dj, dn, atol=1e-2)
     assert valid[np.asarray(idx_j)[valid]].all()  # invalid never a neighbor
     assert (np.asarray(idx_j)[valid] != np.arange(n)[valid][:, None]).all()
+
+
+def test_knn_np_k1_and_single_valid_shapes():
+    """scipy squeezes the k axis at kk == 1; knn_np must restore (n, k)
+    on the correct side (a bare atleast_2d silently TRANSPOSED the k=1
+    fast path, and the degenerate fill loop IndexError'd with exactly
+    one valid point — both caught by review, r5)."""
+    rng = np.random.default_rng(11)
+    pts = rng.normal(size=(6, 3)).astype(np.float32)
+    # k=1 without self-exclusion: nearest neighbor IS the point itself
+    idx, d2 = knnlib.knn_np(pts, None, 1, exclude_self=False)
+    assert idx.shape == (6, 1) and d2.shape == (6, 1)
+    np.testing.assert_array_equal(idx[:, 0], np.arange(6))
+    np.testing.assert_allclose(d2[:, 0], 0.0, atol=1e-10)
+    # one valid point among six: every row's only candidate is that point
+    valid = np.zeros(6, bool)
+    valid[2] = True
+    idx, d2 = knnlib.knn_np(pts, valid, 3)
+    assert idx.shape == (6, 3) and d2.shape == (6, 3)
+    # invalid rows' only candidate is the one valid point (repeated fill);
+    # the valid row excludes itself leaving nothing -> inf distances
+    assert (idx[~valid] == 2).all()
+    assert np.isinf(d2[2]).all()
