@@ -179,18 +179,23 @@ def main() -> None:
                jnp.stack([x.valid for x in dsts]),
                jnp.stack([x.features for x in dsts]),
                jnp.stack([x.normals for x in dsts]))
-    for trials, icp_iters in ((4096, 30), (2048, 30), (1024, 30), (2048, 10),
-                              (1024, 15)):
+    # fb16=None is the auto policy (bf16 features on accelerators); the
+    # explicit False point isolates the bf16-feature wiring's effect at
+    # the bench's production setting (r5: the knob was newly wired)
+    for trials, icp_iters, fb16 in ((4096, 30, None), (2048, 30, None),
+                                    (1024, 30, None), (2048, 10, None),
+                                    (1024, 15, None), (1024, 30, False)):
         t = np.inf
         for _ in range(2):
             t0 = time.perf_counter()
             T, gfit, ifit, _ = reg.register_pairs(
                 *stacked, max_dist=voxel * 1.5,
                 icp_max_dist=voxel * float(cfg.icp_dist_ratio),
-                trials=trials, icp_iters=icp_iters)
+                trials=trials, icp_iters=icp_iters, feat_bf16=fb16)
             jax.block_until_ready(T)
             t = min(t, time.perf_counter() - t0)
         print(f"register trials={trials} icp_iters={icp_iters} "
+              f"feat_bf16={fb16} "
               f"steady={t:.3f}s gfit={float(np.mean(np.asarray(gfit))):.3f} "
               f"ifit={float(np.mean(np.asarray(ifit))):.3f}")
 
