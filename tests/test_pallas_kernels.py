@@ -147,6 +147,40 @@ def test_scan_fused_row_mode0_and_downsample(rng):
     assert err.max() < 1e-2, err.max()
 
 
+def test_forward_views_use_fused_override_parity(monkeypatch):
+    """The scanner-level use_fused override (the A/B lever bench and the
+    session profilers rely on, and the surface SLSCAN_PALLAS=1 routes
+    through) must run BOTH lowerings and agree — plumbing parity on top
+    of the kernel-level test above."""
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.models.scanner import SLScanner
+    from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+
+    monkeypatch.setattr(pk, "scan_fused_ok", lambda: True)  # interpret on CPU
+    cam = (256, 64)
+    rig = syn.default_rig(cam_size=cam, proj_size=cam)
+    frames, _ = syn.render_scene(rig, syn.sphere_on_background())
+    stack = jnp.asarray(frames)[None]
+    sc = SLScanner(rig.calibration(), cam, cam, row_mode=1,
+                   plane_eval="quadratic")
+    r_jnp = sc.forward_views(stack, thresh_mode="manual", use_fused=False)
+    r_fused = sc.forward_views(stack, thresh_mode="manual", use_fused=True)
+    v1 = np.asarray(r_jnp.valid[0])
+    v2 = np.asarray(r_fused.valid[0])
+    assert (v1 != v2).mean() < 2e-3
+    both = v1 & v2
+    assert both.sum() > 1000
+    err = np.abs(np.asarray(r_fused.points[0])[both]
+                 - np.asarray(r_jnp.points[0])[both])
+    assert err.max() < 1e-2, err.max()
+    # auto dispatch without the opt-in env is the jnp lowering
+    monkeypatch.delenv("SLSCAN_PALLAS", raising=False)
+    r_auto = sc.forward_views(stack, thresh_mode="manual")
+    np.testing.assert_array_equal(np.asarray(r_auto.points[0]),
+                                  np.asarray(r_jnp.points[0]))
+
+
 def test_scanner_fuse_gate_rejects_truncated_and_misaligned(monkeypatch, rng):
     """The fused-kernel gate must route truncated stacks and non-tile-aligned
     widths to the jnp path even when the kernel is available (the jnp path
