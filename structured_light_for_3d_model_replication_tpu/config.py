@@ -162,10 +162,15 @@ class ParallelConfig:
     data_axis: int = 0      # shards turntable views; 0 = use all available devices
     model_axis: int = 1     # shards pixel rows / point blocks within a view
     backend: str = "jax"    # 'jax' | 'numpy' (bit-exact CPU reference path)
-    # bf16 FPFH feature-distance matmuls with f32 accumulation (one MXU
-    # pass vs HIGHEST's three) on accelerator backends; geometry stays f32.
-    # true = auto (bf16 on accelerators, f32 on hosts); false = f32 everywhere
-    use_bf16_features: bool = True
+    # OPT-IN bf16 FPFH feature-distance matmuls with f32 accumulation (one
+    # MXU pass vs HIGHEST's three); geometry stays f32. Default off: the r5
+    # on-chip sweep measured bf16 matching at equal speed but global
+    # fitness 0.818 -> 0.608 (33-bin FPFH histograms don't survive 8-bit
+    # mantissas in the correspondence matmul). The pre-r5 knob
+    # ``use_bf16_features`` ("auto") is accepted in config files with a
+    # deprecation warning and maps to the auto policy (f32) — never to
+    # forcing bf16
+    force_bf16_features: bool = False
     # run the 360 merge over a device mesh (register_pairs_sharded + slab-
     # sharded postprocess; for method='posegraph' the edge registrations
     # shard and only the small host-side pose-graph solve stays local)
@@ -196,8 +201,27 @@ class Config:
             json.dump(self.to_dict(), f, indent=2)
 
 
+# (section class name, legacy key) -> warning; the key is dropped, keeping
+# the section's defaults (which preserve the legacy key's old behavior)
+_LEGACY_KEYS = {
+    ("ParallelConfig", "use_bf16_features"):
+        "parallel.use_bf16_features ('auto') is deprecated and ignored — "
+        "the auto policy resolves to f32 features since the r5 on-chip "
+        "quality sweep; use parallel.force_bf16_features=true to force "
+        "the bf16 arm",
+}
+
+
 def _from_dict(cls: type, data: dict[str, Any]) -> Any:
     import typing
+
+    for key in [k for k in data
+                if (cls.__name__, k) in _LEGACY_KEYS]:
+        import sys
+
+        print(f"[config] WARNING: {_LEGACY_KEYS[(cls.__name__, key)]}",
+              file=sys.stderr)
+        data = {k: v for k, v in data.items() if k != key}
 
     hints = typing.get_type_hints(cls)
     known = {f.name for f in dataclasses.fields(cls)}

@@ -219,7 +219,7 @@ def _require_host_backend(op: str) -> None:
             f"runtime at merge-cloud shapes (worker fault, not an "
             f"exception — see ops/grid.py module notes). On the "
             f"'{backend}' backend use ops.knn.knn / knn_dense_approx, the "
-            f"Pallas nn1 kernel, or the voxelized ring probe instead.")
+            f"Pallas nn1 kernel, or the slab-window engine instead.")
 
 
 def grid_radius_count(grid: HashGrid, radius, exclude_self: bool = True,

@@ -254,8 +254,13 @@ def nn1(queries, base, base_valid=None, block_q: int = 1024,
     nb_pad = -(-nb // block_b) * block_b
     q8 = _pad8(queries, jnp.ones(nq, bool), nq_pad)
     b8 = _pad8(base, base_valid, nb_pad)
-    d2, idx = _nn1_call(q8, b8, block_q, block_b, _interpret())
-    return idx[:nq, 0], d2[:nq, 0]
+    _, idx = _nn1_call(q8, b8, block_q, block_b, _interpret())
+    idx = idx[:nq, 0]
+    # exact-distance recompute against the same parked coordinates the
+    # kernel saw (b8: invalid/padded rows sit at _FAR) — see knn.exact_d2
+    # for why the kernel's expansion d2 must not be reported
+    from structured_light_for_3d_model_replication_tpu.ops.knn import exact_d2
+    return idx, exact_d2(queries, b8[:, :3], idx)
 
 
 # ---------------------------------------------------------------------------

@@ -69,24 +69,21 @@ def statistical_outlier_mask(points, valid, nb_neighbors: int = 20,
     Exact at every size BY DEFAULT — Open3D's KDTree statistics are exact,
     so the reference-parity contract is that the TPU and NumPy backends
     remove the identical outlier set. Large accelerator clouds route
-    through the voxelized ring probe (certified rows exact, the rest get a
-    chunked dense pass); ``approximate=True`` opts a large-N accelerator
-    call into the ~3x-faster approx_min_k selection instead (recall 0.99
+    through the sorted-axis slab-window engine (certified rows exact, the
+    rest get a chunked dense pass); ``approximate=True`` opts a large-N
+    accelerator call into the approx_min_k selection instead (recall 0.99
     per row, one-sided error — mask agreement vs exact measured at 99.7%
     on the bench's 171k merged cloud).
 
     ``voxelized_cell``: pass the voxel size when ``points`` just came out of
-    voxel_downsample(cell) — cells then hold one point (at most two after
-    f32 re-gridding shifts) and the kNN collapses to a 9^3-cell
-    neighborhood probe over sorted packed keys (no N^2 distance rows; much
-    faster at merged-cloud scale), plus an exact dense pass over the rows
-    the probe cannot certify. Results match the generic path exactly
-    (same Open3D statistics). Without the hint, large accelerator clouds
-    estimate an equivalent cell from the median nearest-neighbor spacing.
-    Ignored on host backends — concrete host calls above 32768 points
-    delegate to the cKDTree twin instead (same statistics, ~13x faster
-    than the host grid kNN) — and when the grid would not fit 1024
-    cells/axis."""
+    voxel_downsample(cell) — it sets the slab engine's certification
+    radius (4*cell covers the 20th neighbor of a voxelized cloud), and
+    rows it cannot certify get an exact dense pass. Results match the
+    generic path exactly (same Open3D statistics). Without the hint,
+    large accelerator clouds estimate an equivalent cell from the median
+    nearest-neighbor spacing. Ignored on host backends — concrete host
+    calls above 32768 points delegate to the cKDTree twin instead (same
+    statistics, ~13x faster than the host grid kNN)."""
     concrete = not (isinstance(points, jax.core.Tracer)
                     or isinstance(valid, jax.core.Tracer))
     accel = concrete and jax.default_backend() != "cpu"
@@ -101,31 +98,20 @@ def statistical_outlier_mask(points, valid, nb_neighbors: int = 20,
         return jnp.asarray(statistical_outlier_mask_np(
             np.asarray(points), np.asarray(valid), nb_neighbors, std_ratio))
     if accel and not (approximate and voxelized_cell is None):
-        # accelerators only: on hosts the 729-offset searchsorted probe is
-        # ~2x slower than the grid-hash kNN (measured 69 s vs 29 s on the
-        # CPU bench fallback), so the hint is ignored there
+        # accelerators only: the host fast path is the cKDTree twin above
         cell = voxelized_cell
         if cell is None and n > knnlib._BRUTE_MAX:
-            # exact accelerator default for unhinted large clouds: probe at
-            # 0.75x the median NN spacing — the 4-ring certification radius
-            # (3x spacing) still covers the k-th neighbor for k<=30 on both
-            # surface (r20 ~ 2.5x) and volumetric (r20 ~ 1.7x) clouds, while
-            # denser-than-median regions keep cell occupancy <= 2 instead of
-            # mass-falling back to the dense pass
+            # exact accelerator default for unhinted large clouds: a
+            # certification radius of 4 * (0.75 * median NN spacing) =
+            # 3x spacing covers the k-th neighbor for k<=30 on both
+            # surface (r20 ~ 2.5x) and volumetric (r20 ~ 1.7x) clouds
             cell = 0.75 * _estimate_spacing(points, valid)
         if cell is not None:
-            lo, hi = _masked_extent_jit(points, valid)
-            ext = np.maximum(np.asarray(hi) - np.asarray(lo), 0.0)
-            if np.all(np.floor(ext / np.float32(cell)) < 1023):
-                return _stat_outlier_voxelized(points, valid, nb_neighbors,
-                                               std_ratio, cell)
-            if n > knnlib._BRUTE_MAX and not approximate:
-                # grid too fine for the 30-bit pack: exact still wins by
-                # contract — pay the tiled-brute O(N^2) price
-                _, d2 = knnlib.knn(points, valid, nb_neighbors, exact=True)
-                mean_d = jnp.sqrt(jnp.maximum(d2, 0.0)).mean(axis=1)
-                return _stat_outlier_from_knn(mean_d, valid,
-                                              jnp.float32(std_ratio), jnp)
+            # the slab-window engine has no grid-resolution or occupancy
+            # limits (the old ring probe's 1023-cells-per-axis pack gate
+            # and its exact-brute escape are gone with it)
+            return _stat_outlier_voxelized(points, valid, nb_neighbors,
+                                           std_ratio, cell)
     _, d2 = knnlib.knn(points, valid, nb_neighbors)
     mean_d = jnp.sqrt(jnp.maximum(d2, 0.0)).mean(axis=1)
     return _stat_outlier_from_knn(mean_d, valid, jnp.float32(std_ratio), jnp)
@@ -135,7 +121,7 @@ def _estimate_spacing(points, valid) -> float:
     """Median nearest-neighbor distance from a subsample: 2048 probe rows
     against a <=32768-point base, one tiny [2048, 32768] dense launch. A
     missed true NN (base is a stride of the cloud) only OVERestimates a
-    row's spacing — and the ring probe stays exact at any cell choice, the
+    row's spacing — and the slab engine stays exact at any cell choice, the
     estimate only tunes how much work lands on its dense fallback."""
     idx = np.flatnonzero(np.asarray(valid))
     if len(idx) < 2:
@@ -143,25 +129,33 @@ def _estimate_spacing(points, valid) -> float:
     q = idx[:: max(1, len(idx) // 2048)][:2048]
     b = idx[:: max(1, len(idx) // 32768)][:32768]
     d2 = np.asarray(_spacing_d2_jit(jnp.asarray(points)[q],
-                                    jnp.asarray(points)[b]))
+                                    jnp.asarray(points)[b],
+                                    jnp.asarray(q), jnp.asarray(b)))
     med = float(np.median(np.sqrt(np.maximum(d2, 0.0))))
     return max(med, 1e-6)
 
 
 @jax.jit
-def _spacing_d2_jit(q, b):
+def _spacing_d2_jit(q, b, qi, bi):
     d2 = ((q * q).sum(-1)[:, None] + (b * b).sum(-1)[None, :]
           - 2.0 * jnp.matmul(q, b.T, precision=jax.lax.Precision.HIGHEST))
-    return jnp.where(d2 <= 1e-12, jnp.inf, d2).min(axis=1)
+    # self-exclusion by global index (the query stride is frequently a
+    # multiple of the base stride, so most probe rows ARE in the base) —
+    # an epsilon test on the expansion d2 would drown in its ~0.04 mm^2
+    # cancellation noise and drag the median toward zero; the reported
+    # minimum is recomputed exactly for the same reason
+    d2 = jnp.where(qi[:, None] == bi[None, :], jnp.inf, d2)
+    j = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    return knnlib.exact_d2(q, b, j)
 
 
 def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
-    """Ring-probe + exact-fallback outlier mask for voxelized clouds (the
-    accelerator arm of statistical_outlier_mask; backend-agnostic in
+    """Slab-window + exact-fallback outlier mask for quasi-uniform clouds
+    (the accelerator arm of statistical_outlier_mask; backend-agnostic in
     itself, which is what the CPU parity test exercises)."""
     mean_d = np.array(_voxelized_knn_mean_dist(
         points, valid, jnp.float32(cell), nb_neighbors))
-    # rows the ring probe could not certify (k-th neighbor beyond 4 cells:
+    # rows the slab window could not certify (k-th neighbor beyond 4*cell:
     # cloud-boundary points and true outliers) get an exact dense pass —
     # Open3D's statistics include the huge mean distances of far outliers,
     # which inflate sigma, so censoring them as inf would systematically
@@ -175,15 +169,19 @@ def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
         # rows keep the block at ~1.4 GB for that cloud; worst case
         # (everything uncertified) degrades to tiled-brute COST, never to
         # an allocation failure.
-        sub = np.asarray(points)[bad]
+        bad_idx = np.flatnonzero(bad)
+        sub = np.asarray(points)[bad_idx]
         chunk = 2048
         m_pad = -(-len(sub) // chunk) * chunk
         subp = np.full((m_pad, 3), 1e9, np.float32)
         subp[:len(sub)] = sub
+        subi = np.full(m_pad, -1, np.int32)  # padded rows match no index
+        subi[:len(sub)] = bad_idx
         pts_dev = jnp.asarray(points)
         md_parts = [
             np.sqrt(np.maximum(np.asarray(
                 _dense_knn_d2_subset(jnp.asarray(subp[s:s + chunk]),
+                                     jnp.asarray(subi[s:s + chunk]),
                                      pts_dev, valid, nb_neighbors)), 0.0)
                     ).mean(1)
             for s in range(0, m_pad, chunk)
@@ -194,82 +192,111 @@ def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
-def _dense_knn_d2_subset(queries, points, valid, k: int):
+def _dense_knn_d2_subset(queries, qidx, points, valid, k: int):
     """Exact k smallest squared distances from each query row to the valid
-    points (self-matches excluded by the d2 > 0 guard: queries ARE cloud
-    points, and distinct voxel centroids cannot coincide)."""
+    points. ``qidx`` [m] i32: each query's global row index in ``points``
+    (-1 for padded rows) — self-matches are excluded by identity."""
     pts = jnp.where(valid[:, None], points, 1e9)
     b2 = (pts * pts).sum(-1)
     q2 = (queries * queries).sum(-1)[:, None]
     cross = jnp.matmul(queries, pts.T, precision=jax.lax.Precision.HIGHEST)
     d2 = q2 + b2[None, :] - 2.0 * cross
-    d2 = jnp.where(d2 <= 1e-9, jnp.inf, d2)  # self
-    negk, _ = jax.lax.top_k(-d2, k)
-    return -negk
+    # self-exclusion by global INDEX identity (qidx), never by a distance
+    # threshold: the expansion's f32 cancellation noise (~0.04 mm^2 at
+    # decimeter coordinates) blows past any epsilon test, and an exact
+    # zero-distance test would also eat genuine duplicate neighbors, which
+    # the cKDTree twin keeps at distance 0
+    d2 = jnp.where(jnp.arange(pts.shape[0], dtype=jnp.int32)[None, :]
+                   == qidx[:, None], jnp.inf, d2)
+    _, idx = jax.lax.top_k(-d2, k)
+    return knnlib.exact_d2(queries, pts, idx)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def _voxelized_knn_mean_dist(points, valid, cell, k: int):
-    """Mean distance to the k nearest neighbors for a near-one-point-per-cell
-    cloud: probe the 9^3 cells within 4 rings via binary search on the
-    sorted 30-bit packed keys, taking up to TWO occupants per cell (f32
-    re-gridding can push a centroid across a face into its neighbor's
-    cell). Soundness gate: a row is certified (finite) only when its k-th
-    candidate distance is <= 4*cell — every point within Euclidean 4*cell
-    lies inside the probed Chebyshev block — AND no probed cell held a
-    third, unseen occupant. Uncertified rows return inf for the caller's
-    exact dense fallback."""
+_SLAB_FAR = 3e9
+
+
+def _voxelized_knn_mean_dist(points, valid, cell, k: int,
+                             tile: int = 4096, window: int = 16384):
+    """Mean distance to the k nearest neighbors of a quasi-uniform (e.g.
+    voxel-downsampled) cloud, certified-exact, via sorted-axis slab
+    windows: sort along the cloud's widest axis, give each ``tile`` of
+    consecutive sorted queries ONE contiguous ``window`` of sorted
+    candidates, and run a dense MXU distance block + small top_k per
+    tile. A row is certified (finite) only when its k-th candidate
+    distance is <= r = 4*cell (the same coverage radius the old 4-ring
+    probe used: r20 ~ 2.5x spacing on surface clouds, ~1.7x volumetric)
+    AND its window actually spans [x_q - r, x_q + r]; uncertified rows
+    return inf for the caller's exact dense fallback.
+
+    Replaces the 729-offset searchsorted ring probe, whose serial
+    binary-search gather chains cost 26.3 s of a 27.8 s TPU merge
+    (BENCH_NOTES round-5 first on-chip line) — one dynamic_slice per
+    tile keeps this path matmul-shaped instead. Unlike the ring probe
+    it has no cell-occupancy or 1023-cells-per-axis limits."""
+    pts = jnp.asarray(points, jnp.float32)
+    val = jnp.asarray(valid, bool)
+    # widest-axis pick via the on-device extent reduction (transfer 24
+    # bytes, not the cloud); any axis is CORRECT — certification covers a
+    # bad pick — the widest just minimizes dense-fallback work
+    lo, hi = _masked_extent_jit(pts, val)
+    ax = int(np.argmax(np.nan_to_num(np.asarray(hi) - np.asarray(lo))))
+    perm = (ax, (ax + 1) % 3, (ax + 2) % 3)
+    return _slab_knn_mean_dist_jit(pts[:, jnp.asarray(perm)], val,
+                                   jnp.float32(4.0 * float(cell)), k,
+                                   tile, window)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "tile", "window"))
+def _slab_knn_mean_dist_jit(points, valid, r, k: int, tile: int,
+                            window: int):
     n = points.shape[0]
-    origin = jnp.where(valid[:, None], points, jnp.inf).min(axis=0)
-    origin = jnp.where(jnp.isfinite(origin), origin, 0.0)
-    ijk = jnp.clip(jnp.floor((points - origin) / cell).astype(jnp.int32),
-                   0, 1023)
-    key = (ijk[:, 0] << 20) | (ijk[:, 1] << 10) | ijk[:, 2]
-    key = jnp.where(valid, key, jnp.int32(1 << 30))
-    order = jnp.argsort(key)
-    key_s = key[order]
-    pts_s = points[order]
+    L = max(-(-n // tile) * tile, window)
+    x = jnp.where(valid, points[:, 0], jnp.inf)
+    order = jnp.argsort(x)
+    pts_s = jnp.where(valid[order][:, None], points[order],
+                      jnp.float32(_SLAB_FAR))
+    if L > n:
+        pts_s = jnp.concatenate(
+            [pts_s, jnp.full((L - n, 3), _SLAB_FAR, jnp.float32)])
+    x_s = pts_s[:, 0]           # ascending: real xs, then the _SLAB_FAR block
+    n_tiles = L // tile
+    first_x = x_s[jnp.arange(n_tiles, dtype=jnp.int32) * tile]
+    starts = jnp.clip(jnp.searchsorted(x_s, first_x - r), 0, L - window)
 
-    r = tuple(range(-4, 5))
-    # arithmetic, NOT bitwise-OR packing: negative components sign-extend
-    # under | and corrupt the table (480/728 entries collapsed before this
-    # was caught). Addition composes with the query key exactly.
-    offs = jnp.asarray([dx * (1 << 20) + dy * (1 << 10) + dz
-                        for dx in r for dy in r for dz in r],
-                       jnp.int32)                        # [729], incl. 0
-
-    def chunk(args):
-        qk, qp = args
-        cand = qk[:, None] + offs[None, :]               # [C, 729]
-        pos = jnp.searchsorted(key_s, cand)              # [C, 729]
-
-        def occupant(p):
-            p = jnp.minimum(p, n - 1)
-            hit = key_s[p] == cand
-            d = pts_s[p] - qp[:, None, :]
-            d2 = (d * d).sum(-1)
-            # self-match: the query is one of the occupants (d2 ~ 0)
-            d2 = jnp.where(hit & (d2 > 1e-12), d2, jnp.inf)
-            return d2
-
-        d2 = jnp.concatenate([occupant(pos), occupant(pos + 1)], axis=1)
-        third = (key_s[jnp.minimum(pos + 2, n - 1)] == cand).any(axis=1)
-        negk, _ = jax.lax.top_k(-d2, k)
-        kd2 = jnp.maximum(-negk, 0.0)                    # descending -> asc
+    def per_tile(args):
+        t, start = args
+        q = jax.lax.dynamic_slice(pts_s, (t * tile, 0), (tile, 3))
+        cand = jax.lax.dynamic_slice(pts_s, (start, 0), (window, 3))
+        # selection rides the MXU expansion (its f32 cancellation only
+        # risks picking among near-ties); self-exclusion is by global
+        # sorted INDEX, not a distance threshold the noise could defeat
+        q2 = (q * q).sum(-1)[:, None]
+        b2 = (cand * cand).sum(-1)[None, :]
+        cross = jax.lax.dot_general(q, cand, (((1,), (1,)), ((), ())),
+                                    precision=jax.lax.Precision.HIGHEST)
+        d2 = q2 + b2 - 2.0 * cross
+        qg = t * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, window), 0)
+        cg = start + jax.lax.broadcasted_iota(jnp.int32, (tile, window), 1)
+        d2 = jnp.where(qg == cg, jnp.inf, d2)
+        _, jidx = jax.lax.top_k(-d2, k)                  # [tile, k]
+        # exact distances for the winners (knn.exact_d2: the expansion's
+        # cancellation floor would otherwise leak into the outlier
+        # statistic and the certification test)
+        kd2 = knnlib.exact_d2(q, cand, jidx)
         md = jnp.sqrt(kd2).mean(axis=1)
-        certified = (kd2[:, -1] <= (4.0 * cell) ** 2) & ~third
+        qx = q[:, 0]
+        # left coverage holds by construction: searchsorted guarantees
+        # x_s[start-1] < first_x - r <= qx - r for every query in the tile,
+        # and the downward clip only widens the window. Only the right edge
+        # can truncate coverage.
+        right_ok = (start + window >= L) | (x_s[start + window - 1] >= qx + r)
+        certified = (kd2.max(axis=1) <= r * r) & right_ok & (qx < _SLAB_FAR)
         return jnp.where(certified, md, jnp.inf)
 
-    chunk_q = 4096
-    n_pad = -(-n // chunk_q) * chunk_q
-    kq = jnp.concatenate([key, jnp.full(n_pad - n, 1 << 30, jnp.int32)]) \
-        if n_pad > n else key
-    pq = jnp.concatenate([points, jnp.full((n_pad - n, 3), 1e9,
-                                           points.dtype)]) if n_pad > n \
-        else points
-    md = jax.lax.map(chunk, (kq.reshape(-1, chunk_q),
-                             pq.reshape(-1, chunk_q, 3)))
-    return md.reshape(-1)[:n]
+    md_s = jax.lax.map(per_tile,
+                       (jnp.arange(n_tiles, dtype=jnp.int32), starts))
+    return jnp.full(n, jnp.inf, jnp.float32).at[order].set(
+        md_s.reshape(-1)[:n])
 
 
 def statistical_outlier_mask_np(points, valid, nb_neighbors: int = 20,

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from structured_light_for_3d_model_replication_tpu.ops import grid as gridlib
+from structured_light_for_3d_model_replication_tpu.ops import knn as knnlib
 
 __all__ = ["RegistrationResult", "icp_point_to_plane", "fpfh_features",
            "ransac_global_registration", "register_pairs",
@@ -180,7 +181,11 @@ def _nn1_brute_jnp(cur, dst_pts, dst_valid, block_q: int | None = None):
         d2 = ((q * q).sum(-1, keepdims=True) + d2_dst[None, :] - 2.0 * cross)
         d2 = jnp.where(dst_valid[None, :], d2, jnp.inf)
         j = jnp.argmin(d2, axis=1).astype(jnp.int32)
-        return j, jnp.take_along_axis(d2, j[:, None], axis=1)[:, 0]
+        # selection rides the MXU expansion; the returned distance is
+        # recomputed exactly (knn.exact_d2), inf when no valid dst exists
+        d2j = jnp.where(dst_valid[j], knnlib.exact_d2(q, dst_pts, j),
+                        jnp.inf)
+        return j, d2j
 
     if n * m <= (4 << 20):
         return chunk_nn(cur)
@@ -205,8 +210,12 @@ def _nn1_dispatch(cur, dst_pts, dst_valid, nn_mode: str, block: int = 1024):
         dst8 = pk._pad8(dst_pts, dst_valid, nb_pad)
         nq_pad = -(-n // block) * block
         q8 = jnp.zeros((nq_pad, 8), jnp.float32).at[:n, :3].set(cur)
-        d2c, idxc = pk._nn1_call(q8, dst8, block, block, False)
-        return idxc[:n, 0], d2c[:n, 0]
+        _, idxc = pk._nn1_call(q8, dst8, block, block, False)
+        idxc = idxc[:n, 0]
+        # same exact-distance recompute as pk.nn1 / the brute arm: ICP's
+        # fitness, rmse, and max_correspondence gating must not inherit the
+        # kernel expansion's f32 cancellation floor
+        return idxc, knnlib.exact_d2(cur, dst8[:, :3], idxc)
     return _nn1_brute_jnp(cur, dst_pts, dst_valid)
 
 
@@ -415,7 +424,7 @@ def _feature_correspondences(sf, df, sv, dv, mutual: bool,
     one-directional set is kept (round-2 verdict weak #3: one-directional
     argmin matches were the main cause of near-threshold global fitness).
 
-    ``feat_bf16`` (parallel.use_bf16_features): run the feature cross
+    ``feat_bf16`` (parallel.force_bf16_features): run the feature cross
     product in bf16 with f32 accumulation — one MXU pass instead of
     HIGHEST's three. FPFH distances only pick argmin matches (geometry
     stays f32 downstream), and RANSAC's checkers + refine absorb the
@@ -594,10 +603,14 @@ def _ransac_jit(src, dst, sf, df, sv, dv, max_dist, edge_sim, key, *,
 
 
 def _resolve_feat_bf16(feat_bf16: bool | None) -> bool:
-    """None = auto: bf16 feature matmuls on accelerators (one MXU pass),
-    f32 on hosts (XLA:CPU emulates bf16 — slower AND less accurate)."""
+    """None = auto: f32 everywhere. bf16 feature matmuls were measured
+    on-chip (r5 register sweep, BENCH_NOTES.md) to cost nothing in time
+    (0.356 vs 0.371 s steady at 1024 trials) but drop global fitness
+    0.818 -> 0.608 — the 33-bin FPFH histograms are too quantized to
+    survive 8-bit mantissas in the correspondence matmul. Explicit
+    ``True`` keeps the one-MXU-pass path for callers who want it."""
     if feat_bf16 is None:
-        return jax.default_backend() != "cpu"
+        return False
     return bool(feat_bf16)
 
 

@@ -318,9 +318,10 @@ def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
         if mesh is not None:
             log(f"[merge] sharding the chain over "
                 f"{mesh.devices.size} devices (parallel.merge_mesh)")
-    # parallel.use_bf16_features=true keeps the auto policy (bf16 feature
-    # matmuls on accelerators only); false forces f32 everywhere
-    fb16 = None if cfg.parallel.use_bf16_features else False
+    # parallel.force_bf16_features=true FORCES the opt-in bf16 feature
+    # matmuls; false (default) leaves the auto policy (f32 everywhere
+    # since the r5 on-chip quality sweep; see _resolve_feat_bf16)
+    fb16 = True if cfg.parallel.force_bf16_features else None
     with prof.trace():
         if cfg.merge.method == "posegraph":
             points, colors, transforms = recon.merge_360_posegraph(

@@ -256,7 +256,7 @@ def test_estimate_spacing_recovers_grid_pitch():
 
 def test_exact_outlier_default_auto_cell_on_accelerator(rng, monkeypatch):
     # the accelerator large-N DEFAULT (approximate=False, no voxel hint):
-    # auto-estimated probe cell -> exact ring probe + chunked fallback —
+    # auto-estimated cell -> exact slab-window engine + chunked fallback —
     # must remove the same outlier set as the cKDTree reference. Simulated
     # accel dispatch: backend name patched, gate shrunk so 12k counts as
     # "large" (the real gate needs 65k+ points, too slow for CPU CI).
@@ -277,20 +277,20 @@ def test_exact_outlier_default_auto_cell_on_accelerator(rng, monkeypatch):
 
 
 def test_voxelized_outlier_chunked_fallback_all_uncertified(rng):
-    # a probe cell many times the true spacing packs 3+ occupants into every
-    # cell -> zero rows certify -> the WHOLE cloud goes through the chunked
-    # dense fallback (3 chunks at 5000 rows). Statistics must still exactly
-    # match the generic path — the fallback is a cost degradation, never a
-    # semantic one (ADVICE r3 medium: the unchunked version OOMed instead).
+    # a certification radius (4*cell) far below the true point spacing means
+    # no row's 20th neighbor can certify -> the WHOLE cloud goes through the
+    # chunked dense fallback (3 chunks at 5000 rows). Statistics must still
+    # exactly match the generic path — the fallback is a cost degradation,
+    # never a semantic one (ADVICE r3 medium: the unchunked version OOMed).
     pts = rng.uniform(0, 40, (5000, 3)).astype(np.float32)
     out = rng.uniform(150, 200, (30, 3)).astype(np.float32)
     cloud = np.concatenate([pts, out]).astype(np.float32)
     valid = np.ones(len(cloud), bool)
     md = np.asarray(pc._voxelized_knn_mean_dist(
-        jnp.asarray(cloud), jnp.asarray(valid), jnp.float32(10.0), 20))
+        jnp.asarray(cloud), jnp.asarray(valid), jnp.float32(0.05), 20))
     assert not np.isfinite(md).any()  # the premise: nothing certifies
     m_fast = np.asarray(pc._stat_outlier_voxelized(
-        jnp.asarray(cloud), jnp.asarray(valid), 20, 2.0, 10.0))
+        jnp.asarray(cloud), jnp.asarray(valid), 20, 2.0, 0.05))
     m_np = pc.statistical_outlier_mask_np(cloud, valid, 20, 2.0)
     assert (m_fast != m_np).sum() <= 2  # f32-vs-f64 threshold ties only
 
