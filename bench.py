@@ -258,10 +258,11 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
         f"(={res['mpix_per_s']} Mpix/s, {n_valid0} valid pts in view 0)")
     save()
 
-    # A/B the lowering auto-dispatch did NOT choose (r4 decision: the jnp
-    # path is now the default — on-chip it measured 0.1045 s vs the fused
-    # kernel's 0.1747 s — and the fused kernel sits behind SLSCAN_PALLAS=1;
-    # keep recording both so the decision stays evidence-backed)
+    # A/B the lowering auto-dispatch did NOT choose (r5 decision: the fused
+    # scan kernel is the accelerator default — both r5 in-session A/Bs
+    # measured it faster (0.1154 vs 0.1489, 0.1091 vs 0.1486) after the r4
+    # normalization + tile fixes; SLSCAN_PALLAS=0 flips back to jnp. Keep
+    # recording both so the decision stays evidence-backed)
     if fuse_capable and backend != "cpu":
         alt_fused = not auto_fused
 

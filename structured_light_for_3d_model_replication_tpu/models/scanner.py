@@ -121,12 +121,10 @@ class SLScanner:
                 and h % 8 == 0 and w % 128 == 0)
 
     def _can_fuse(self, frames_v) -> bool:
-        """Auto-dispatch policy: capability AND the explicit opt-in. The
-        on-chip A/B (r4 window: fused 0.1747 s vs jnp 0.1045 s at 24 views
-        @1080p, BENCH_NOTES.md) measured the hand-written kernel SLOWER
-        than XLA's own lowering of the same arithmetic, so jnp is the
-        default and the fused kernel stays behind ``SLSCAN_PALLAS=1``
-        until a measurement says otherwise."""
+        """Auto-dispatch policy: capability AND the measured-winner policy
+        (pallas_kernels.scan_fused_requested — fused by default where
+        Mosaic compiles since both r5 in-session on-chip A/Bs measured it
+        faster than the jnp lowering; SLSCAN_PALLAS=0 disables)."""
         from structured_light_for_3d_model_replication_tpu.ops import (
             pallas_kernels as pk,
         )

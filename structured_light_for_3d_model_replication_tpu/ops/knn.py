@@ -267,6 +267,8 @@ def radius_count(points: jax.Array, valid: jax.Array, radius,
     hosts.
     """
     n = points.shape[0]
+    if n == 0:
+        return jnp.zeros(0, jnp.int32)
     if n <= _BRUTE_MAX:
         from structured_light_for_3d_model_replication_tpu.ops import (
             pallas_kernels as pk,
@@ -341,6 +343,41 @@ def _radius_blocks(points, valid, radius, block_q: int, block_b: int,
 # NumPy / scipy reference twins
 # ---------------------------------------------------------------------------
 
+def kdtree_distances_rows(points: np.ndarray, valid: np.ndarray,
+                          rows: np.ndarray, k: int) -> np.ndarray:
+    """Euclidean distances [len(rows), k] from the given cloud rows to their
+    k nearest OTHER valid points, with knn_np's exact semantics (cKDTree,
+    self dropped by global index, duplicates kept at 0, and knn_np's
+    degenerate fill: rows with fewer than k real neighbors repeat their
+    last real distance, so only rows with ZERO other valid points carry
+    inf). Shared by the slab-window outlier engine's host fallback so the
+    twin contract lives here once."""
+    from scipy.spatial import cKDTree
+
+    rows = np.asarray(rows)
+    pts = np.asarray(points, np.float32)
+    vi = np.flatnonzero(np.asarray(valid))
+    if len(vi) == 0:
+        return np.full((len(rows), k), np.inf, np.float32)
+    tree = cKDTree(pts[vi])
+    kk = min(k + 1, len(vi))
+    d, j = tree.query(pts[rows], k=kk, workers=-1)
+    d = np.asarray(d).reshape(len(rows), kk)
+    j = np.asarray(j).reshape(len(rows), kk)
+    dd = np.where(vi[j] == rows[:, None], np.inf, d)
+    order = np.argsort(dd, axis=1, kind="stable")[:, :k]
+    out = np.full((len(rows), k), np.inf, np.float32)
+    m = order.shape[1]
+    out[:, :m] = np.take_along_axis(dd, order, axis=1)
+    # finite entries are a prefix (stable ascending sort, inf last):
+    # repeat the last real distance into the suffix, as knn_np does
+    fin = np.isfinite(out).sum(axis=1)
+    has = fin > 0
+    last = out[np.arange(out.shape[0]), np.maximum(fin - 1, 0)]
+    fill = (np.arange(k)[None, :] >= fin[:, None]) & has[:, None]
+    return np.where(fill, last[:, None], out)
+
+
 def knn_np(points: np.ndarray, valid: np.ndarray | None, k: int,
            exclude_self: bool = True):
     """cKDTree reference. Same contract as knn() (unpadded N allowed).
@@ -355,6 +392,9 @@ def knn_np(points: np.ndarray, valid: np.ndarray | None, k: int,
     if valid is None:
         valid = np.ones(n, bool)
     vi = np.where(valid)[0]
+    if len(vi) == 0:
+        return (np.zeros((n, k), np.int32),
+                np.full((n, k), np.inf, np.float32))
     tree = cKDTree(points[vi])
     kk = k + 1 if exclude_self else k
     kk = min(kk, len(vi))
@@ -402,6 +442,8 @@ def radius_count_np(points: np.ndarray, valid: np.ndarray | None, radius: float,
     if valid is None:
         valid = np.ones(n, bool)
     vi = np.where(valid)[0]
+    if len(vi) == 0:
+        return np.zeros(n, np.int32)
     tree = cKDTree(points[vi])
     counts = np.asarray(tree.query_ball_point(points, radius,
                                               return_length=True), np.int32)

@@ -145,17 +145,24 @@ def use_pallas() -> bool:
 
 
 def scan_fused_requested() -> bool:
-    """Explicit opt-in for the single-pass fused SCAN Mosaic kernel
+    """Dispatch policy for the single-pass fused SCAN Mosaic kernel
     (scan_points_fused_views: decode + triangulate in one kernel).
 
-    The on-chip A/B (r4: fused scan 0.1747 s vs the jnp lowering's
-    0.1045 s, 24 views @1080p) measured this kernel slower, so it is no
-    longer the auto-dispatch default: ``SLSCAN_PALLAS=1`` (or
-    ``force``/``fused``) requests it. Every other Mosaic kernel —
-    including decode_maps_fused, which ran INSIDE the winning "jnp" arm,
-    plus nn1 and radius_count — stays auto whenever ``use_pallas()``."""
-    return os.environ.get("SLSCAN_PALLAS", "").strip().lower() in (
-        "1", "on", "true", "force", "fused")
+    Default ON where Mosaic compiles: both r5 in-session on-chip A/Bs
+    measured the fused kernel FASTER than the jnp lowering (0.1154 vs
+    0.1489 s and 0.1091 vs 0.1486 s, 24 views @1080p — BENCH_NOTES.md).
+    The r4 window had measured the pre-fix kernel slower (0.1747 vs
+    0.1045 s); after the plane-normalization fix and the 8x128 tile
+    clamp the sign flipped, consistently, within single sessions where
+    tunnel variance cancels. ``SLSCAN_PALLAS=0`` (the same kill switch
+    that forces interpret mode) disables it; ``1``/``force`` requests it
+    explicitly (bench uses the override arg instead to A/B both)."""
+    env = os.environ.get("SLSCAN_PALLAS", "").strip().lower()
+    if env in ("0", "off", "false", "interpret"):
+        return False
+    if env in ("1", "on", "true", "force", "fused"):
+        return True
+    return use_pallas()
 
 
 def _interpret() -> bool:

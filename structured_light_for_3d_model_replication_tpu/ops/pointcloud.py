@@ -88,6 +88,8 @@ def statistical_outlier_mask(points, valid, nb_neighbors: int = 20,
                     or isinstance(valid, jax.core.Tracer))
     accel = concrete and jax.default_backend() != "cpu"
     n = points.shape[0]
+    if n == 0:  # empty clouds flow through the clean chain gracefully
+        return jnp.zeros(0, bool)
     if concrete and not accel and n > 32768:
         # host backend at production scale: the cKDTree twin computes the
         # identical Open3D statistics ~13x faster than the host grid kNN
@@ -162,61 +164,29 @@ def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
     # tighten the threshold
     bad = np.asarray(valid) & ~np.isfinite(mean_d)
     if bad.any():
-        # fixed-size chunks, ONE reused executable: an unchunked [m_bad, N]
-        # dense block scales as N^2 f32 when certification degrades (probe
-        # cell misaligned with the true spacing -> m_bad -> N), which OOMed
-        # in review modeling at ~117 GB for the bench's 171k cloud. 2048
-        # rows keep the block at ~1.4 GB for that cloud; worst case
-        # (everything uncertified) degrades to tiled-brute COST, never to
-        # an allocation failure.
+        # exact complement on the HOST: uncertified rows (cloud boundary +
+        # true outliers, typically a few % of the cloud) go through the
+        # twin's own cKDTree semantics (knnlib.kdtree_distances_rows) —
+        # identical statistics by construction, including inf means for
+        # degenerate clouds with < k other points; an N log N build +
+        # m log N query beats the old chunked [2048, N] dense device
+        # passes, whose per-row lax.top_k over the full cloud lowers to
+        # sorts (~1 s of the r5 on-chip outlier stage at 324k points)
         bad_idx = np.flatnonzero(bad)
-        sub = np.asarray(points)[bad_idx]
-        chunk = 2048
-        m_pad = -(-len(sub) // chunk) * chunk
-        subp = np.full((m_pad, 3), 1e9, np.float32)
-        subp[:len(sub)] = sub
-        subi = np.full(m_pad, -1, np.int32)  # padded rows match no index
-        subi[:len(sub)] = bad_idx
-        pts_dev = jnp.asarray(points)
-        md_parts = [
-            np.sqrt(np.maximum(np.asarray(
-                _dense_knn_d2_subset(jnp.asarray(subp[s:s + chunk]),
-                                     jnp.asarray(subi[s:s + chunk]),
-                                     pts_dev, valid, nb_neighbors)), 0.0)
-                    ).mean(1)
-            for s in range(0, m_pad, chunk)
-        ]
-        mean_d[bad] = np.concatenate(md_parts)[:len(sub)]
+        dsel = knnlib.kdtree_distances_rows(np.asarray(points, np.float32),
+                                            np.asarray(valid), bad_idx,
+                                            nb_neighbors)
+        mean_d[bad] = dsel.mean(axis=1)
     return np.asarray(_stat_outlier_from_knn(
         jnp.asarray(mean_d), valid, jnp.float32(std_ratio), jnp))
-
-
-@functools.partial(jax.jit, static_argnames=("k",))
-def _dense_knn_d2_subset(queries, qidx, points, valid, k: int):
-    """Exact k smallest squared distances from each query row to the valid
-    points. ``qidx`` [m] i32: each query's global row index in ``points``
-    (-1 for padded rows) — self-matches are excluded by identity."""
-    pts = jnp.where(valid[:, None], points, 1e9)
-    b2 = (pts * pts).sum(-1)
-    q2 = (queries * queries).sum(-1)[:, None]
-    cross = jnp.matmul(queries, pts.T, precision=jax.lax.Precision.HIGHEST)
-    d2 = q2 + b2[None, :] - 2.0 * cross
-    # self-exclusion by global INDEX identity (qidx), never by a distance
-    # threshold: the expansion's f32 cancellation noise (~0.04 mm^2 at
-    # decimeter coordinates) blows past any epsilon test, and an exact
-    # zero-distance test would also eat genuine duplicate neighbors, which
-    # the cKDTree twin keeps at distance 0
-    d2 = jnp.where(jnp.arange(pts.shape[0], dtype=jnp.int32)[None, :]
-                   == qidx[:, None], jnp.inf, d2)
-    _, idx = jax.lax.top_k(-d2, k)
-    return knnlib.exact_d2(queries, pts, idx)
 
 
 _SLAB_FAR = 3e9
 
 
 def _voxelized_knn_mean_dist(points, valid, cell, k: int,
-                             tile: int = 4096, window: int = 16384):
+                             tile: int = 4096, window: int = 16384,
+                             selector: str = "topk", map_batch: int = 8):
     """Mean distance to the k nearest neighbors of a quasi-uniform (e.g.
     voxel-downsampled) cloud, certified-exact, via sorted-axis slab
     windows: sort along the cloud's widest axis, give each ``tile`` of
@@ -243,12 +213,15 @@ def _voxelized_knn_mean_dist(points, valid, cell, k: int,
     perm = (ax, (ax + 1) % 3, (ax + 2) % 3)
     return _slab_knn_mean_dist_jit(pts[:, jnp.asarray(perm)], val,
                                    jnp.float32(4.0 * float(cell)), k,
-                                   tile, window)
+                                   tile, window, selector, map_batch)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "tile", "window"))
+@functools.partial(jax.jit,
+                   static_argnames=("k", "tile", "window", "selector",
+                                    "map_batch"))
 def _slab_knn_mean_dist_jit(points, valid, r, k: int, tile: int,
-                            window: int):
+                            window: int, selector: str = "topk",
+                            map_batch: int = 8):
     n = points.shape[0]
     L = max(-(-n // tile) * tile, window)
     x = jnp.where(valid, points[:, 0], jnp.inf)
@@ -278,7 +251,13 @@ def _slab_knn_mean_dist_jit(points, valid, r, k: int, tile: int,
         qg = t * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, window), 0)
         cg = start + jax.lax.broadcasted_iota(jnp.int32, (tile, window), 1)
         d2 = jnp.where(qg == cg, jnp.inf, d2)
-        _, jidx = jax.lax.top_k(-d2, k)                  # [tile, k]
+        if selector == "approx1":
+            # measured SLOWER on-chip (r5 tune_outlier sweep: ~4x vs
+            # lax.top_k, and not bit-identical at recall_target=1.0 on
+            # TPU) — kept only as an A/B arm, never the default
+            _, jidx = jax.lax.approx_min_k(d2, k, recall_target=1.0)
+        else:
+            _, jidx = jax.lax.top_k(-d2, k)              # [tile, k]
         # exact distances for the winners (knn.exact_d2: the expansion's
         # cancellation floor would otherwise leak into the outlier
         # statistic and the certification test)
@@ -293,8 +272,13 @@ def _slab_knn_mean_dist_jit(points, valid, r, k: int, tile: int,
         certified = (kd2.max(axis=1) <= r * r) & right_ok & (qx < _SLAB_FAR)
         return jnp.where(certified, md, jnp.inf)
 
+    # vmapping map_batch tiles per loop step trades HBM for loop overhead
+    # (the r5 on-chip sweep measured a ~0.5 s per-launch floor nearly flat
+    # in window size — sequential-step overhead, not top_k): 8 x
+    # [4096, 16384] f32 d2 blocks ~ 2 GB live, well inside HBM
     md_s = jax.lax.map(per_tile,
-                       (jnp.arange(n_tiles, dtype=jnp.int32), starts))
+                       (jnp.arange(n_tiles, dtype=jnp.int32), starts),
+                       batch_size=min(map_batch, n_tiles))
     return jnp.full(n, jnp.inf, jnp.float32).at[order].set(
         md_s.reshape(-1)[:n])
 
@@ -351,6 +335,8 @@ def segment_plane(points, valid, distance_threshold=2.0,
     if key is None:
         key = jax.random.PRNGKey(0)
     n = points.shape[0]
+    if n == 0:  # empty clouds flow through the clean chain gracefully
+        return jnp.zeros(4, jnp.float32), jnp.zeros(0, bool)
     pts = points.astype(jnp.float32)
     # sample triples among valid points: draw from the valid-weighted categorical
     probs = valid.astype(jnp.float32)
@@ -410,6 +396,8 @@ def cluster_labels(points, valid, eps=5.0, min_points: int = 200,
     approximation of the eps-graph (k defaults to 16; raise for dense clouds).
     """
     n = points.shape[0]
+    if n == 0:  # empty clouds flow through the clean chain gracefully
+        return jnp.zeros(0, jnp.int32)
     idx, d2 = knnlib.knn(points, valid, k)
     eps2 = jnp.float32(eps) ** 2
     counts = knnlib.radius_count(points, valid, eps)
@@ -447,8 +435,10 @@ def cluster_labels(points, valid, eps=5.0, min_points: int = 200,
 def largest_cluster_mask(points, valid, eps=5.0, min_points: int = 200,
                          k: int = 16):
     """Keep-mask of the most populated cluster (processing.py:400-420)."""
-    labels = cluster_labels(points, valid, eps, min_points, k)
     n = points.shape[0]
+    if n == 0:  # argmax over zero clusters is undefined
+        return jnp.zeros(0, bool)
+    labels = cluster_labels(points, valid, eps, min_points, k)
     safe = jnp.where(labels >= 0, labels, 0)
     counts = jnp.zeros((n,), jnp.int32).at[safe].add(
         (labels >= 0).astype(jnp.int32))
@@ -464,6 +454,8 @@ def cluster_labels_np(points, valid, eps=5.0, min_points: int = 200):
     if valid is None:
         valid = np.ones(n, bool)
     vi = np.where(valid)[0]
+    if len(vi) == 0:
+        return np.full(n, -1, np.int64)
     tree = cKDTree(points[vi])
     neigh = tree.query_ball_point(points[vi], eps)
     counts = np.array([len(x) - 1 for x in neigh])

@@ -295,6 +295,28 @@ def test_voxelized_outlier_chunked_fallback_all_uncertified(rng):
     assert (m_fast != m_np).sum() <= 2  # f32-vs-f64 threshold ties only
 
 
+def test_clean_ops_accept_empty_clouds():
+    # an aggressive early clean step can empty the cloud; every downstream
+    # op must return an empty mask instead of IndexError (caught live in
+    # the r5 CLI drive: `sl3d clean` with cluster eps below the point
+    # spacing emptied the cloud, then the radius step crashed)
+    pts = jnp.zeros((0, 3), jnp.float32)
+    val = jnp.zeros(0, bool)
+    assert np.asarray(pc.statistical_outlier_mask(pts, val, 20, 2.0)).shape \
+        == (0,)
+    assert np.asarray(pc.radius_outlier_mask(pts, val, 5.0, 100)).shape \
+        == (0,)
+    assert np.asarray(pc.largest_cluster_mask(pts, val, 5.0, 200)).shape \
+        == (0,)
+    plane, inl = pc.segment_plane(pts, val)
+    assert np.asarray(inl).shape == (0,)
+    e = np.zeros((0, 3), np.float32)
+    ev = np.zeros(0, bool)
+    assert pc.statistical_outlier_mask_np(e, ev, 20, 2.0).shape == (0,)
+    assert pc.radius_outlier_mask_np(e, ev, 5.0, 100).shape == (0,)
+    assert pc.largest_cluster_mask_np(e, ev, 5.0, 200).shape == (0,)
+
+
 def test_statistical_outlier_voxelized_fast_path(rng):
     # one-point-per-cell cloud (voxel_downsample output) + far outliers: the
     # cell-probe path must agree with the exact numpy twin on the bulk and

@@ -1,0 +1,219 @@
+"""On-chip sweep of the slab-window outlier engine's knobs (tile, window,
+selector) plus the feature-prep kNN arms, on a merged-cloud-scale input.
+
+The r5 first on-chip line showed the outlier stage dominating the merge
+(ring probe 26.3 s of 27.8 s); the slab rewrite landed at 1.69 s and this
+script picks its fastest exact configuration. A synthetic quasi-voxelized
+surface cloud at the bench's scale (~190k points, 0.5 mm cells, decimeter
+scene offsets) reproduces the real stage's shape without paying a full
+merge first.
+
+The script self-terminates; do NOT wrap it in a kill timer near its
+expected runtime — SIGTERM mid-TPU-claim wedges the device tunnel for
+hours (see BENCH_NOTES.md).
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _make_cloud(n_target: int, cell: float, seed: int = 0):
+    """Quasi-uniform voxelized surface cloud like the post-final-voxel merge
+    output: sphere surface + backdrop plane, voxel-downsampled at ``cell``."""
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pointcloud as pc,
+    )
+
+    rng = np.random.default_rng(seed)
+    n_raw = int(n_target * 2.5)
+    u = rng.normal(size=(n_raw * 2 // 3, 3))
+    sphere = 70.0 * u / np.linalg.norm(u, axis=1, keepdims=True)
+    sphere += np.array([0.0, 0.0, 420.0])
+    plane = np.stack([rng.uniform(-150, 150, n_raw // 3),
+                      rng.uniform(-110, 110, n_raw // 3),
+                      np.full(n_raw // 3, 560.0)
+                      + rng.normal(0, 0.2, n_raw // 3)], axis=1)
+    cloud = np.concatenate([sphere, plane]).astype(np.float32)
+    cols = np.zeros((len(cloud), 3), np.uint8)
+    p, c, v = pc.voxel_downsample(jnp.asarray(cloud), jnp.asarray(cols),
+                                  jnp.asarray(np.ones(len(cloud), bool)),
+                                  cell)
+    keep = np.asarray(v)
+    pts = np.asarray(p)[keep]
+    out = rng.uniform(250, 400, (60, 3)).astype(np.float32)
+    return np.concatenate([pts, out]).astype(np.float32)
+
+
+def _bench_cloud(cell: float):
+    """The REAL outlier-stage input: the bench scene's registered merged
+    cloud, final-voxeled — rebuilt exactly as profile_merge's A/B does."""
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.config import (
+        MergeConfig,
+    )
+    from structured_light_for_3d_model_replication_tpu.models import (
+        reconstruction as rec,
+    )
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pointcloud as pc,
+    )
+
+    cache = os.path.join(ROOT, ".bench_cache.npz")
+    if not os.path.exists(cache):
+        sys.exit("no .bench_cache.npz — run `python bench.py` once first")
+    z = np.load(cache)
+    off = z["merge_off"]
+    clouds = [(z["merge_pts"][off[i]:off[i + 1]],
+               z["merge_cols"][off[i]:off[i + 1]])
+              for i in range(len(off) - 1)]
+    mcfg = MergeConfig(ransac_trials=1024)
+    pre = rec._preprocess_views(clouds, float(mcfg.voxel_size), 0)
+    T_all, *_ = rec._register_chain_batched(pre, mcfg,
+                                            float(mcfg.voxel_size),
+                                            loop_closure=False)
+    acc = np.eye(4, dtype=np.float32)
+    parts = [np.asarray(clouds[0][0], np.float32)]
+    for i in range(1, len(clouds)):
+        acc = (acc @ T_all[i - 1]).astype(np.float32)
+        parts.append(np.asarray(clouds[i][0], np.float32)
+                     @ acc[:3, :3].T + acc[:3, 3])
+    merged = np.concatenate(parts).astype(np.float32)
+    cols = np.concatenate([c for _, c in clouds]).astype(np.uint8)
+    p_v, _, v_v = pc.voxel_downsample(merged, cols,
+                                      np.ones(len(merged), bool), cell)
+    keep = np.asarray(v_v)
+    return np.asarray(p_v)[keep]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=190_000)
+    ap.add_argument("--cell", type=float, default=0.5)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--skip-feat", action="store_true")
+    ap.add_argument("--bench-cloud", action="store_true",
+                    help="sweep on the real bench merged cloud instead of "
+                         "the synthetic (needs .bench_cache.npz)")
+    args = ap.parse_args()
+
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        preflight,
+        tpulock,
+    )
+
+    status, detail = preflight.accelerator_preflight(timeout=180)
+    if status != "ok":
+        print(f"preflight: {status} ({detail}) — aborting")
+        sys.exit(1)
+    lock = tpulock.acquire_tpu_lock(ROOT, timeout=60)  # noqa: F841
+
+    import jax
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pointcloud as pc,
+    )
+
+    print(f"backend={jax.default_backend()}")
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    cloud = (_bench_cloud(args.cell) if args.bench_cloud
+             else _make_cloud(args.n, args.cell))
+    n = len(cloud)
+    valid = jnp.asarray(np.ones(n, bool))
+    pts = jnp.asarray(cloud)
+    print(f"cloud: {n} pts (cell {args.cell})")
+
+    ref_md = None
+    # narrow tiles need only narrow windows (window covers tile x-span +
+    # 2r), and the per-row top_k cost scales with window width — the r5
+    # tuner's first pass measured top_k as the engine's dominant cost
+    # (approx_min_k at recall 1.0: 4x SLOWER and not bit-identical on TPU)
+    for tile, window, sel, mb in [(4096, 16384, "topk", 1),
+                                  (4096, 16384, "topk", 4),
+                                  (4096, 16384, "topk", 8),
+                                  (2048, 8192, "topk", 8),
+                                  (2048, 8192, "topk", 16),
+                                  (1024, 8192, "topk", 16),
+                                  (2048, 16384, "topk", 8)]:
+        try:
+            t0 = time.perf_counter()
+            md = np.array(pc._voxelized_knn_mean_dist(
+                pts, valid, jnp.float32(args.cell), 20,
+                tile=tile, window=window, selector=sel, map_batch=mb))
+            first = time.perf_counter() - t0
+            best = np.inf
+            for _ in range(args.runs):
+                t0 = time.perf_counter()
+                md = np.array(pc._voxelized_knn_mean_dist(
+                    pts, valid, jnp.float32(args.cell), 20,
+                    tile=tile, window=window, selector=sel, map_batch=mb))
+                best = min(best, time.perf_counter() - t0)
+            cert = float(np.isfinite(md).mean())
+            if ref_md is None:
+                ref_md = md
+                agree = 1.0
+            else:
+                both = np.isfinite(ref_md) & np.isfinite(md)
+                agree = float(np.max(np.abs(ref_md[both] - md[both]))) \
+                    if both.any() else -1.0
+            print(f"slab tile={tile} window={window} sel={sel} mb={mb}: "
+                  f"best {best:.3f}s (first {first:.1f}s) "
+                  f"certified {cert:.4f} max|md-ref| {agree:.2e}")
+        except Exception as e:
+            print(f"slab tile={tile} window={window} sel={sel} mb={mb}: "
+                  f"FAILED {type(e).__name__}: {e}"[:160])
+
+    # full stage wall (engine + fallback + threshold) at the default knobs
+    t0 = time.perf_counter()
+    m = np.asarray(pc._stat_outlier_voxelized(pts, valid, 20, 2.0,
+                                              args.cell))
+    stage_first = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    m = np.asarray(pc._stat_outlier_voxelized(pts, valid, 20, 2.0,
+                                              args.cell))
+    stage_steady = time.perf_counter() - t0
+    print(f"stage[_stat_outlier_voxelized]: steady {stage_steady:.3f}s "
+          f"(first {stage_first:.1f}s) kept {int(m.sum())}/{n}")
+
+    t0 = time.perf_counter()
+    m_np = pc.statistical_outlier_mask_np(cloud, np.ones(n, bool), 20, 2.0)
+    print(f"stage[np twin cKDTree]: {time.perf_counter() - t0:.3f}s "
+          f"agree {float((m == m_np).mean()):.6f}")
+
+    if not args.skip_feat:
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            knn as knnlib,
+        )
+
+        view = cloud[:: max(1, n // 16384)][:16384]
+        vp = jnp.asarray(view)
+        vv = jnp.asarray(np.ones(len(view), bool))
+        for label, fn in (
+                ("knn_brute k=48", lambda: knnlib.knn_brute(vp, vv, 48)),
+                ("knn_dense_approx k=48",
+                 lambda: knnlib.knn_dense_approx(vp, vv, 48)),
+        ):
+            idx, d2 = fn()
+            jax.block_until_ready(d2)
+            best = np.inf
+            for _ in range(args.runs):
+                t0 = time.perf_counter()
+                idx, d2 = fn()
+                jax.block_until_ready(d2)
+                best = min(best, time.perf_counter() - t0)
+            print(f"featknn[{label}] @{len(view)} pts: best {best:.3f}s")
+
+
+if __name__ == "__main__":
+    main()
