@@ -81,15 +81,12 @@ def _pad_prep(p_c: np.ndarray, pad_to: int | None):
 def _prep_features_jit(p, v, feat_radius):
     # one kNN (k=48, ascending) feeds both stages: the neighbor search is
     # the dominant cost of feature prep, and normals only need the nearest
-    # 30 of the 48 FPFH neighbors. On accelerators select with
-    # approx_min_k (recall 0.99, exact distances) — FPFH/normal
-    # neighborhoods are statistical, and the brute path's lax.top_k
-    # lowers to full sorts (the knn() docstring's measured ~20x gap);
-    # exactness only matters to the outlier contract, not here
-    if jax.default_backend() != "cpu":
-        idx, d2 = knnlib.knn_dense_approx(p, v, 48)
-    else:
-        idx, d2 = knnlib.knn(p, v, 48)
+    # 30 of the 48 FPFH neighbors. Stays on knn()'s brute dispatch: an r5
+    # on-chip session that routed accelerators through knn_dense_approx
+    # here measured register_s 0.94 -> 1.35 s (the 8192-bucket padding and
+    # chunking hurt at per-view ~16k sizes even though the same approx
+    # path wins at merge-cloud scale)
+    idx, d2 = knnlib.knn(p, v, 48)
     nr = nrmlib.estimate_normals(p, v, k=30, idx_d2=(idx, d2))
     feat = reg.fpfh_features(p, nr, v, radius=feat_radius, k=48,
                              idx_d2=(idx, d2))

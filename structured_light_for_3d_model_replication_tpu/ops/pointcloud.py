@@ -185,8 +185,8 @@ _SLAB_FAR = 3e9
 
 
 def _voxelized_knn_mean_dist(points, valid, cell, k: int,
-                             tile: int = 4096, window: int = 16384,
-                             selector: str = "topk", map_batch: int = 8):
+                             tile: int = 2048, window: int = 16384,
+                             selector: str = "topk"):
     """Mean distance to the k nearest neighbors of a quasi-uniform (e.g.
     voxel-downsampled) cloud, certified-exact, via sorted-axis slab
     windows: sort along the cloud's widest axis, give each ``tile`` of
@@ -213,15 +213,13 @@ def _voxelized_knn_mean_dist(points, valid, cell, k: int,
     perm = (ax, (ax + 1) % 3, (ax + 2) % 3)
     return _slab_knn_mean_dist_jit(pts[:, jnp.asarray(perm)], val,
                                    jnp.float32(4.0 * float(cell)), k,
-                                   tile, window, selector, map_batch)
+                                   tile, window, selector)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "tile", "window", "selector",
-                                    "map_batch"))
+                   static_argnames=("k", "tile", "window", "selector"))
 def _slab_knn_mean_dist_jit(points, valid, r, k: int, tile: int,
-                            window: int, selector: str = "topk",
-                            map_batch: int = 8):
+                            window: int, selector: str = "topk"):
     n = points.shape[0]
     L = max(-(-n // tile) * tile, window)
     x = jnp.where(valid, points[:, 0], jnp.inf)
@@ -272,13 +270,14 @@ def _slab_knn_mean_dist_jit(points, valid, r, k: int, tile: int,
         certified = (kd2.max(axis=1) <= r * r) & right_ok & (qx < _SLAB_FAR)
         return jnp.where(certified, md, jnp.inf)
 
-    # vmapping map_batch tiles per loop step trades HBM for loop overhead
-    # (the r5 on-chip sweep measured a ~0.5 s per-launch floor nearly flat
-    # in window size — sequential-step overhead, not top_k): 8 x
-    # [4096, 16384] f32 d2 blocks ~ 2 GB live, well inside HBM
+    # PLAIN sequential lax.map — do NOT add batch_size: vmapping per_tile
+    # turns its dynamic_slice windows (different start per tile) into
+    # full gathers, measured 4x slower on-chip (r5 tune_outlier run 4:
+    # 2.77 s vs 0.69 s for the identical config; the regression was first
+    # misread as tunnel variance until the batched code was the only
+    # difference)
     md_s = jax.lax.map(per_tile,
-                       (jnp.arange(n_tiles, dtype=jnp.int32), starts),
-                       batch_size=min(map_batch, n_tiles))
+                       (jnp.arange(n_tiles, dtype=jnp.int32), starts))
     return jnp.full(n, jnp.inf, jnp.float32).at[order].set(
         md_s.reshape(-1)[:n])
 

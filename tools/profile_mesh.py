@@ -1,0 +1,125 @@
+"""Stage-split profile of the meshing tail (bench phase D measured 202-274 s
+on TPU in r5 — informational for the headline but far off the reference's
+desktop Open3D Poisson, so find where it goes: normals / poisson CG /
+surface-nets extraction / density trim).
+
+The script self-terminates; do NOT wrap it in a kill timer near its
+expected runtime — SIGTERM mid-TPU-claim wedges the device tunnel for
+hours (see BENCH_NOTES.md).
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=0,
+                    help="0 = MeshConfig default incl. density cap")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if not args.cpu:
+        from structured_light_for_3d_model_replication_tpu.utils import (
+            preflight,
+            tpulock,
+        )
+
+        status, detail = preflight.accelerator_preflight(timeout=180)
+        if status != "ok":
+            print(f"preflight: {status} ({detail}) — aborting")
+            sys.exit(1)
+        lock = tpulock.acquire_tpu_lock(ROOT, timeout=60)  # noqa: F841
+        if lock is None:  # held for process lifetime; fd close releases
+            sys.exit("another TPU client holds .tpu_lock — not opening a "
+                     "concurrent claim (the lock dies with its holder)")
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from structured_light_for_3d_model_replication_tpu.config import (
+        MeshConfig,
+    )
+    from structured_light_for_3d_model_replication_tpu.models.meshing import (
+        _poisson_dispatch,
+    )
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        meshproc,
+        normals as nrmlib,
+        surface_nets,
+    )
+    from structured_light_for_3d_model_replication_tpu.ops.poisson import (
+        trilinear_sample,
+    )
+    from tools.tune_outlier import _bench_cloud
+
+    cfg = MeshConfig()
+    if args.depth:
+        cfg.depth = args.depth
+    pts_np = _bench_cloud(0.5)
+    print(f"backend={jax.default_backend()} cloud={len(pts_np)} "
+          f"depth_req={cfg.depth}", flush=True)
+    pts = jnp.asarray(pts_np)
+    v = jnp.ones(len(pts_np), bool)
+
+    def timed(label, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out) if hasattr(out, "block_until_ready") \
+            else None
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out) if hasattr(out, "block_until_ready") \
+            else None
+        steady = time.perf_counter() - t0
+        print(f"{label}: steady {steady:.2f}s (first {first:.2f}s)",
+              flush=True)
+        return out
+
+    nr = timed("normals.estimate", lambda: nrmlib.estimate_normals(
+        pts, v, k=cfg.normal_max_nn, radius=cfg.normal_radius or None))
+    nr = timed("normals.orient", lambda: nrmlib.orient_normals(
+        pts, nr, v, mode="radial"))
+
+    res = timed("poisson", lambda: _poisson_dispatch(
+        pts, nr, v, cfg.depth, lambda m: print("  " + m, flush=True),
+        density_cap=cfg.density_cap))
+
+    verts = faces = None
+
+    def extract():
+        nonlocal verts, faces
+        verts, faces = surface_nets.extract_surface(
+            res.chi, float(res.iso), origin=np.asarray(res.origin),
+            cell=float(res.cell))
+        return verts
+
+    timed("surface_nets", extract)
+    print(f"  mesh: {len(verts)} verts {len(faces)} faces", flush=True)
+
+    def trim():
+        coords = (jnp.asarray(verts) - res.origin) / res.cell
+        dens = np.asarray(trilinear_sample(res.density, coords))
+        thresh = np.quantile(dens, cfg.density_trim_quantile)
+        return meshproc.filter_faces_by_vertex_mask(
+            verts, faces, dens >= thresh)
+
+    timed("density_trim", trim)
+
+
+if __name__ == "__main__":
+    main()

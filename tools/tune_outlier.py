@@ -136,28 +136,26 @@ def main() -> None:
 
     ref_md = None
     # narrow tiles need only narrow windows (window covers tile x-span +
-    # 2r), and the per-row top_k cost scales with window width — the r5
-    # tuner's first pass measured top_k as the engine's dominant cost
-    # (approx_min_k at recall 1.0: 4x SLOWER and not bit-identical on TPU)
-    for tile, window, sel, mb in [(4096, 16384, "topk", 1),
-                                  (4096, 16384, "topk", 4),
-                                  (4096, 16384, "topk", 8),
-                                  (2048, 8192, "topk", 8),
-                                  (2048, 8192, "topk", 16),
-                                  (1024, 8192, "topk", 16),
-                                  (2048, 16384, "topk", 8)]:
+    # 2r). Notes from the r5 sweeps: approx_min_k at recall 1.0 is 4x
+    # SLOWER than top_k and not bit-identical on TPU; lax.map(batch_size=)
+    # around the tile loop turns the dynamic_slice windows into gathers
+    # and cost 4x — both dead ends are kept out of the engine
+    for tile, window, sel in [(4096, 16384, "topk"),
+                              (2048, 16384, "topk"),
+                              (2048, 8192, "topk"),
+                              (1024, 8192, "topk")]:
         try:
             t0 = time.perf_counter()
             md = np.array(pc._voxelized_knn_mean_dist(
                 pts, valid, jnp.float32(args.cell), 20,
-                tile=tile, window=window, selector=sel, map_batch=mb))
+                tile=tile, window=window, selector=sel))
             first = time.perf_counter() - t0
             best = np.inf
             for _ in range(args.runs):
                 t0 = time.perf_counter()
                 md = np.array(pc._voxelized_knn_mean_dist(
                     pts, valid, jnp.float32(args.cell), 20,
-                    tile=tile, window=window, selector=sel, map_batch=mb))
+                    tile=tile, window=window, selector=sel))
                 best = min(best, time.perf_counter() - t0)
             cert = float(np.isfinite(md).mean())
             if ref_md is None:
@@ -167,11 +165,11 @@ def main() -> None:
                 both = np.isfinite(ref_md) & np.isfinite(md)
                 agree = float(np.max(np.abs(ref_md[both] - md[both]))) \
                     if both.any() else -1.0
-            print(f"slab tile={tile} window={window} sel={sel} mb={mb}: "
+            print(f"slab tile={tile} window={window} sel={sel}: "
                   f"best {best:.3f}s (first {first:.1f}s) "
                   f"certified {cert:.4f} max|md-ref| {agree:.2e}")
         except Exception as e:
-            print(f"slab tile={tile} window={window} sel={sel} mb={mb}: "
+            print(f"slab tile={tile} window={window} sel={sel}: "
                   f"FAILED {type(e).__name__}: {e}"[:160])
 
     # full stage wall (engine + fallback + threshold) at the default knobs
