@@ -40,6 +40,14 @@ class _Prep:
     features: jnp.ndarray
 
 
+# feature-prep configuration, shared with tools/profile_merge's attribution
+# arms so the profiler can never drift from the production values
+FEAT_K = 48            # shared kNN depth (FPFH neighborhood)
+NORMALS_K = 30         # normals use the nearest 30 of the 48
+FEAT_RADIUS_SCALE = 5.0  # FPFH radius = 5 * voxel (reference's preprocess)
+FEATURE_CHUNK = 8      # views batched per vmap launch (memory bound)
+
+
 def preprocess_for_registration(points, colors, valid, voxel_size: float,
                                 pad_to: int | None = None) -> _Prep:
     """Voxel downsample -> normals (r=2*voxel) -> FPFH (r=5*voxel): the
@@ -52,7 +60,7 @@ def preprocess_for_registration(points, colors, valid, voxel_size: float,
     export-boundary pattern as ops/triangulate.compact_cloud."""
     p_c = _downsample_compact(points, colors, valid, voxel_size)
     p, v = _pad_prep(p_c, pad_to)
-    nr, feat = _prep_features_jit(p, v, jnp.float32(5.0 * voxel_size))
+    nr, feat = _prep_features_jit(p, v, jnp.float32(FEAT_RADIUS_SCALE * voxel_size))
     return _Prep(p, v, nr, feat)
 
 
@@ -86,9 +94,9 @@ def _prep_features_jit(p, v, feat_radius):
     # here measured register_s 0.94 -> 1.35 s (the 8192-bucket padding and
     # chunking hurt at per-view ~16k sizes even though the same approx
     # path wins at merge-cloud scale)
-    idx, d2 = knnlib.knn(p, v, 48)
-    nr = nrmlib.estimate_normals(p, v, k=30, idx_d2=(idx, d2))
-    feat = reg.fpfh_features(p, nr, v, radius=feat_radius, k=48,
+    idx, d2 = knnlib.knn(p, v, FEAT_K)
+    nr = nrmlib.estimate_normals(p, v, k=NORMALS_K, idx_d2=(idx, d2))
+    feat = reg.fpfh_features(p, nr, v, radius=feat_radius, k=FEAT_K,
                              idx_d2=(idx, d2))
     return nr, feat
 
@@ -113,7 +121,7 @@ def _features_views_jit(pts_v, valid_v, feat_radius):
     # peak memory scale with the view count (~50-100 MB of kNN transients
     # per view), so the batching is bounded at 8 views at a time
     n_views = pts_v.shape[0]
-    chunk = min(8, n_views)
+    chunk = min(FEATURE_CHUNK, n_views)
     outs = [jax.vmap(lambda p, v: _prep_features_jit(p, v, feat_radius))(
                 pts_v[s:s + chunk], valid_v[s:s + chunk])
             for s in range(0, n_views, chunk)]
@@ -134,6 +142,23 @@ def _preprocess_views(clouds, voxel: float, sample_before: int,
     reuses them so the transformed merged cloud never round-trips the
     host (only meaningful when sample_before <= 1, i.e. sampled == full).
     Returns preps, or (preps, (raw_pts, raw_valid)) with keep_raw."""
+    p_stack, v_stack, raw = _voxel_pack_views(clouds, voxel, sample_before,
+                                              keep_raw)
+    nr_all, feat_all = _features_views_jit(p_stack, v_stack,
+                                           jnp.float32(FEAT_RADIUS_SCALE * voxel))
+    preps = [_Prep(p_stack[i], v_stack[i], nr_all[i], feat_all[i])
+             for i in range(p_stack.shape[0])]
+    if keep_raw:
+        return preps, raw
+    return preps
+
+
+def _voxel_pack_views(clouds, voxel: float, sample_before: int,
+                      keep_raw: bool = False):
+    """The voxel+pack half of _preprocess_views: per-view downsample, host
+    compaction, one-bucket padding. Returns (p_stack [V,n_pad,3],
+    v_stack [V,n_pad], raw_or_None) — split out so the profiler can time
+    it apart from the feature stage."""
     sampled = []
     for p_full, c_full in clouds:
         sampled.append(_sample_every(np.asarray(p_full, np.float32),
@@ -174,9 +199,9 @@ def _preprocess_views(clouds, voxel: float, sample_before: int,
         bucket = -(-max(int(cnts.max()), 1) // 2048) * 2048
         views_p.extend(p_all[k, :bucket] for k in range(len(part)))
 
-    # pad every view up to ONE size on device and batch normals+FPFH;
-    # invalid slots hold zeros, which every downstream op masks via
-    # `valid` (knn parks them at _FAR itself)
+    # pad every view up to ONE size on device; invalid slots hold zeros,
+    # which every downstream op masks via `valid` (knn parks them at _FAR
+    # itself)
     n_pad = -(-max(max(counts), 1) // 2048) * 2048
     views_p = [vp if vp.shape[0] == n_pad else
                jnp.concatenate([vp, jnp.zeros((n_pad - vp.shape[0], 3),
@@ -185,15 +210,11 @@ def _preprocess_views(clouds, voxel: float, sample_before: int,
     p_stack = jnp.stack(views_p)
     v_stack = (jnp.asarray(counts, jnp.int32)[:, None]
                > jnp.arange(n_pad, dtype=jnp.int32)[None, :])
-    nr_all, feat_all = _features_views_jit(p_stack, v_stack,
-                                           jnp.float32(5.0 * voxel))
-    preps = [_Prep(p_stack[i], v_stack[i], nr_all[i], feat_all[i])
-             for i in range(n_views)]
+    raw = None
     if keep_raw:
-        raw_p = jnp.concatenate([p[:k] for p, _, k in raw_chunks])
-        raw_v = jnp.concatenate([v[:k] for _, v, k in raw_chunks])
-        return preps, (raw_p, raw_v)
-    return preps
+        raw = (jnp.concatenate([p[:k] for p, _, k in raw_chunks]),
+               jnp.concatenate([v[:k] for _, v, k in raw_chunks]))
+    return p_stack, v_stack, raw
 
 
 def _register_chain_batched(preps, cfg: MergeConfig, voxel: float,

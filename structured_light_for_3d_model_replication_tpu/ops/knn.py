@@ -343,23 +343,36 @@ def _radius_blocks(points, valid, radius, block_q: int, block_b: int,
 # NumPy / scipy reference twins
 # ---------------------------------------------------------------------------
 
+def kdtree_build(points: np.ndarray, valid: np.ndarray):
+    """(cKDTree over the valid rows, their global indices) for
+    kdtree_distances_rows — split out so callers can overlap the
+    O(N log N) host build with concurrent device work (the slab-window
+    outlier engine runs ~0.7 s on-chip while the host sits idle)."""
+    from scipy.spatial import cKDTree
+
+    pts = np.asarray(points, np.float32)
+    vi = np.flatnonzero(np.asarray(valid))
+    return (cKDTree(pts[vi]) if len(vi) else None), vi
+
+
 def kdtree_distances_rows(points: np.ndarray, valid: np.ndarray,
-                          rows: np.ndarray, k: int) -> np.ndarray:
+                          rows: np.ndarray, k: int,
+                          tree_vi=None) -> np.ndarray:
     """Euclidean distances [len(rows), k] from the given cloud rows to their
     k nearest OTHER valid points, with knn_np's exact semantics (cKDTree,
     self dropped by global index, duplicates kept at 0, and knn_np's
     degenerate fill: rows with fewer than k real neighbors repeat their
     last real distance, so only rows with ZERO other valid points carry
     inf). Shared by the slab-window outlier engine's host fallback so the
-    twin contract lives here once."""
-    from scipy.spatial import cKDTree
+    twin contract lives here once.
 
+    ``tree_vi``: optional prebuilt ``kdtree_build(points, valid)`` result
+    (must be over the same cloud/mask)."""
     rows = np.asarray(rows)
     pts = np.asarray(points, np.float32)
-    vi = np.flatnonzero(np.asarray(valid))
-    if len(vi) == 0:
+    tree, vi = tree_vi if tree_vi is not None else kdtree_build(points, valid)
+    if tree is None:
         return np.full((len(rows), k), np.inf, np.float32)
-    tree = cKDTree(pts[vi])
     kk = min(k + 1, len(vi))
     d, j = tree.query(pts[rows], k=kk, workers=-1)
     d = np.asarray(d).reshape(len(rows), kk)

@@ -155,14 +155,24 @@ def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
     """Slab-window + exact-fallback outlier mask for quasi-uniform clouds
     (the accelerator arm of statistical_outlier_mask; backend-agnostic in
     itself, which is what the CPU parity test exercises)."""
-    mean_d = np.array(_voxelized_knn_mean_dist(
-        points, valid, jnp.float32(cell), nb_neighbors))
+    md_dev = _voxelized_knn_mean_dist(points, valid, jnp.float32(cell),
+                                      nb_neighbors)
+    # overlap the host complement's cKDTree BUILD with the device slab pass
+    # (async dispatch above): the build is pure host work, the engine pure
+    # device work, and the complement below almost always fires (cloud
+    # boundaries). Host backends skip the prebuild — there the "device"
+    # work occupies the same core, so nothing overlaps
+    pts_np = np.asarray(points, np.float32)
+    val_np = np.asarray(valid)
+    tree_vi = (knnlib.kdtree_build(pts_np, val_np)
+               if jax.default_backend() != "cpu" else None)
+    mean_d = np.array(md_dev)
     # rows the slab window could not certify (k-th neighbor beyond 4*cell:
     # cloud-boundary points and true outliers) get an exact dense pass —
     # Open3D's statistics include the huge mean distances of far outliers,
     # which inflate sigma, so censoring them as inf would systematically
     # tighten the threshold
-    bad = np.asarray(valid) & ~np.isfinite(mean_d)
+    bad = val_np & ~np.isfinite(mean_d)
     if bad.any():
         # exact complement on the HOST: uncertified rows (cloud boundary +
         # true outliers, typically a few % of the cloud) go through the
@@ -173,9 +183,8 @@ def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
         # passes, whose per-row lax.top_k over the full cloud lowers to
         # sorts (~1 s of the r5 on-chip outlier stage at 324k points)
         bad_idx = np.flatnonzero(bad)
-        dsel = knnlib.kdtree_distances_rows(np.asarray(points, np.float32),
-                                            np.asarray(valid), bad_idx,
-                                            nb_neighbors)
+        dsel = knnlib.kdtree_distances_rows(pts_np, val_np, bad_idx,
+                                            nb_neighbors, tree_vi=tree_vi)
         mean_d[bad] = dsel.mean(axis=1)
     return np.asarray(_stat_outlier_from_knn(
         jnp.asarray(mean_d), valid, jnp.float32(std_ratio), jnp))

@@ -35,6 +35,11 @@ def main() -> None:
                          "unhinted exact, and the opt-in approx_min_k "
                          "route — times + mask agreement, so the exactness "
                          "default's cost is a number, not a guess")
+    ap.add_argument("--features-ab", action="store_true",
+                    help="attribute the per-view feature-prep device time "
+                         "(kNN vs normals vs FPFH) and sweep the kNN "
+                         "query-block size — feature prep is ~0.57 s of "
+                         "the r5 on-chip register_s wait")
     ap.add_argument("--runs", type=int, default=3)
     ap.add_argument("--trials", type=int, default=2048,
                     help="ransac_trials for the merge runs (bench uses 2048; "
@@ -166,6 +171,68 @@ def main() -> None:
             print(f"outlier[{label}]: best {best:.3f}s kept {int(m.sum())}"
                   f"/{len(m)} agree_vs_hinted={agree:.4f}", flush=True)
 
+    if args.features_ab:
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            knn as knnlib,
+            normals as nrmlib,
+        )
+
+        voxel = float(mcfg.voxel_size)
+        t0 = time.perf_counter()
+        p_stack, v_stack, _ = rec._voxel_pack_views(clouds, voxel, 0)
+        jax.block_until_ready(v_stack)
+        print(f"features: voxel+pack {time.perf_counter() - t0:.3f}s "
+              f"stack={tuple(p_stack.shape)}", flush=True)
+        fr = jnp.float32(rec.FEAT_RADIUS_SCALE * voxel)
+        feat_k, nrm_k = rec.FEAT_K, rec.NORMALS_K
+
+        def timed(label, fn):
+            out, best = None, np.inf
+            for _ in range(max(args.runs, 2)):
+                t0 = time.perf_counter()
+                out = fn()
+                jax.block_until_ready(out)
+                best = min(best, time.perf_counter() - t0)
+            print(f"features[{label}]: best {best:.3f}s", flush=True)
+            return out
+
+        def chunked(fn):
+            # same 8-view batching as _features_views_jit, so per-arm times
+            # sum to the full stage's launch structure
+            n_views = p_stack.shape[0]
+            ch = min(rec.FEATURE_CHUNK, n_views)
+            return [fn(s, s + ch) for s in range(0, n_views, ch)]
+
+        timed("full(knn+normals+fpfh)",
+              lambda: rec._features_views_jit(p_stack, v_stack, fr))
+        idx_d2 = None
+        for bq in (512, 1024, 2048):
+            knn_fn = jax.jit(jax.vmap(
+                lambda p, v: knnlib.knn_brute(p, v, feat_k, block_q=bq)))
+            out = timed(f"knn bq={bq}",
+                        lambda: chunked(
+                            lambda s, e: knn_fn(p_stack[s:e], v_stack[s:e])))
+            if bq == 512:
+                idx_d2 = (jnp.concatenate([o[0] for o in out]),
+                          jnp.concatenate([o[1] for o in out]))
+        idx_all, d2_all = idx_d2
+        nrm_fn = jax.jit(jax.vmap(
+            lambda p, v, i, dd: nrmlib.estimate_normals(
+                p, v, k=nrm_k, idx_d2=(i, dd))))
+        nr_out = timed("normals(given knn)",
+                       lambda: chunked(
+                           lambda s, e: nrm_fn(p_stack[s:e], v_stack[s:e],
+                                               idx_all[s:e], d2_all[s:e])))
+        nr_all = jnp.concatenate(nr_out)
+        fpfh_fn = jax.jit(jax.vmap(
+            lambda p, nr, v, i, dd: reg.fpfh_features(
+                p, nr, v, radius=float(fr), k=feat_k, idx_d2=(i, dd))))
+        timed("fpfh(given knn+normals)",
+              lambda: chunked(
+                  lambda s, e: fpfh_fn(p_stack[s:e], nr_all[s:e],
+                                       v_stack[s:e], idx_all[s:e],
+                                       d2_all[s:e])))
+
     if not args.register:
         return
     cfg = MergeConfig()
@@ -179,12 +246,13 @@ def main() -> None:
                jnp.stack([x.valid for x in dsts]),
                jnp.stack([x.features for x in dsts]),
                jnp.stack([x.normals for x in dsts]))
-    # fb16=None is the auto policy (bf16 features on accelerators); the
-    # explicit False point isolates the bf16-feature wiring's effect at
-    # the bench's production setting (r5: the knob was newly wired)
+    # fb16=None resolves to f32 (the r5 on-chip sweep measured bf16
+    # features saving nothing while dropping gfit 0.818 -> 0.608, see
+    # _resolve_feat_bf16); the explicit True arm keeps the bf16 path
+    # measurable in case a later FPFH change revives it
     for trials, icp_iters, fb16 in ((4096, 30, None), (2048, 30, None),
                                     (1024, 30, None), (2048, 10, None),
-                                    (1024, 15, None), (1024, 30, False)):
+                                    (1024, 15, None), (1024, 30, True)):
         t = np.inf
         for _ in range(2):
             t0 = time.perf_counter()
