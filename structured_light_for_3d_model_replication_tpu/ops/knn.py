@@ -91,7 +91,7 @@ _BRUTE_MAX = 65536  # above this, dispatch to the grid-hash engine
 def knn(points: jax.Array, valid: jax.Array, k: int,
         block_q: int = 512, block_b: int = 8192,
         exclude_self: bool = True, exact: bool = False,
-        recall_target: float = 0.99):
+        recall_target: float = 0.99, selector: str = "topk"):
     """k nearest neighbors among valid points, for every point.
 
     points [N,3] float32 (any N), valid [N] bool. Returns (idx [N,k] int32,
@@ -111,10 +111,13 @@ def knn(points: jax.Array, valid: jax.Array, k: int,
     approximations — O(N^2) FLOPs, so expect seconds at merge-cloud scale).
     ``recall_target`` tunes the accelerator approx_min_k selection (per-row
     recall; misses only ever overestimate the k-th neighbor distance).
+    ``selector`` is forwarded to the brute path (see knn_brute; the
+    large-N accelerator path already selects via approx_min_k).
     """
     n = points.shape[0]
     if n <= _BRUTE_MAX or exact:
-        return knn_brute(points, valid, k, block_q, block_b, exclude_self)
+        return knn_brute(points, valid, k, block_q, block_b, exclude_self,
+                         selector)
     if jax.default_backend() != "cpu":
         # accelerators: dense distance rows + the hardware-partial-reduce
         # top-k (lax.approx_min_k). The grid-hash path below is built for
@@ -196,19 +199,31 @@ def _knn_dense_jit(points, valid, k: int, bq: int, exclude_self: bool,
 
 def knn_brute(points: jax.Array, valid: jax.Array, k: int,
               block_q: int = 512, block_b: int = 8192,
-              exclude_self: bool = True):
-    """Tiled brute-force kNN (exact; O(N^2) distances on the MXU)."""
+              exclude_self: bool = True, selector: str = "topk"):
+    """Tiled brute-force kNN (O(N^2) distances on the MXU).
+
+    ``selector``: ``"topk"`` (exact selection, the default) or
+    ``"approx:<recall>"`` (``lax.approx_min_k`` PartialReduce at that
+    recall — the full sort behind lax.top_k is the dominant cost of
+    feature-prep kNN on TPU, and a missed neighbor only swaps in a
+    slightly-farther one). The approx selection runs at EVERY base-block
+    scan step, so effective per-row recall compounds to ~recall^nb for
+    nb = N/block_b base blocks — at the per-view feature-prep sizes this
+    serves (nb <= 2) that is the advertised ballpark; callers at larger
+    N should size recall for the compounding or keep "topk". Both
+    selectors report exact re-computed distances, ascending."""
     n = points.shape[0]
     block_q, block_b, n_pad = _choose_blocks(n, block_q, block_b)
     points, valid = _pad_jax(points, valid, n_pad)
-    idx, d2 = _knn_blocks(points, valid, k, block_q, block_b, exclude_self)
+    idx, d2 = _knn_blocks(points, valid, k, block_q, block_b, exclude_self,
+                          selector)
     return idx[:n], d2[:n]
 
 
 @functools.partial(jax.jit, static_argnames=("k", "block_q", "block_b",
-                                             "exclude_self"))
+                                             "exclude_self", "selector"))
 def _knn_blocks(points, valid, k: int, block_q: int, block_b: int,
-                exclude_self: bool):
+                exclude_self: bool, selector: str = "topk"):
     n = points.shape[0]
     pts = _masked_coords(points.astype(jnp.float32), valid, jnp)
     nq = n // block_q
@@ -237,15 +252,29 @@ def _knn_blocks(points, valid, k: int, block_q: int, block_b: int,
             cat_d = jnp.concatenate([best_d, d2], axis=1)
             cat_i = jnp.concatenate(
                 [best_i, jnp.broadcast_to(base_idx, (block_q, block_b))], axis=1)
-            neg_d, sel = jax.lax.top_k(-cat_d, k)
-            return (-neg_d, jnp.take_along_axis(cat_i, sel, axis=1)), None
+            if selector == "topk":
+                neg_d, sel = jax.lax.top_k(-cat_d, k)
+                sel_d = -neg_d
+            else:
+                recall = float(selector.split(":", 1)[1])
+                sel_d, sel = jax.lax.approx_min_k(cat_d, k,
+                                                  recall_target=recall)
+            return (sel_d, jnp.take_along_axis(cat_i, sel, axis=1)), None
 
         (best_d, best_i), _ = jax.lax.scan(scan_base, init,
                                            jnp.arange(nb, dtype=jnp.int32))
         # exact d2 for the winners (exact_d2); unfilled slots (best_d
         # still inf) stay inf
-        d2e = exact_d2(qblk, pts, best_i)
-        return jnp.where(jnp.isinf(best_d), jnp.inf, d2e), best_i
+        d2e = jnp.where(jnp.isinf(best_d),
+                        jnp.inf, exact_d2(qblk, pts, best_i))
+        if selector != "topk":
+            # approx_min_k returns unsorted rows: restore the ascending
+            # contract (consumers slice the nearest-k' prefix) by the
+            # EXACT distances — a 48-wide sort, trivial next to the full
+            # candidate sort this selector replaced
+            neg_d, ordr = jax.lax.top_k(-d2e, k)
+            return -neg_d, jnp.take_along_axis(best_i, ordr, axis=1)
+        return d2e, best_i
 
     best_d, best_i = jax.lax.map(
         lambda args: per_query_block(*args),

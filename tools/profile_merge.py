@@ -203,35 +203,71 @@ def main() -> None:
             ch = min(rec.FEATURE_CHUNK, n_views)
             return [fn(s, s + ch) for s in range(0, n_views, ch)]
 
-        timed("full(knn+normals+fpfh)",
-              lambda: rec._features_views_jit(p_stack, v_stack, fr))
-        idx_d2 = None
-        for bq in (512, 1024, 2048):
-            knn_fn = jax.jit(jax.vmap(
-                lambda p, v: knnlib.knn_brute(p, v, feat_k, block_q=bq)))
-            out = timed(f"knn bq={bq}",
-                        lambda: chunked(
-                            lambda s, e: knn_fn(p_stack[s:e], v_stack[s:e])))
-            if bq == 512:
-                idx_d2 = (jnp.concatenate([o[0] for o in out]),
-                          jnp.concatenate([o[1] for o in out]))
-        idx_all, d2_all = idx_d2
+        def reg_quality(label, nr_s, ft_s):
+            # the approx selector's only acceptance gate: registration
+            # quality from these features must match the exact arm
+            T, gfit, ifit, _ = reg.register_pairs(
+                p_stack[1:], v_stack[1:], ft_s[1:],
+                p_stack[:-1], v_stack[:-1], ft_s[:-1], nr_s[:-1],
+                max_dist=voxel * 1.5,
+                icp_max_dist=voxel * float(mcfg.icp_dist_ratio),
+                trials=1024, icp_iters=30)
+            jax.block_until_ready(T)
+            print(f"features[{label}] -> register@1024: "
+                  f"gfit={float(np.mean(np.asarray(gfit))):.3f} "
+                  f"ifit={float(np.mean(np.asarray(ifit))):.3f}", flush=True)
+
+        full_out = timed("full(knn+normals+fpfh)",
+                         lambda: rec._features_views_jit(p_stack, v_stack,
+                                                         fr))
+        # the full stage runs the PRODUCTION selector (approx on TPU) —
+        # the exact-baseline quality line is built from the bq=512 exact
+        # idx_d2 after the arms loop, so the gate stays approx-vs-exact
+        reg_quality("production(selector=auto)", *full_out)
         nrm_fn = jax.jit(jax.vmap(
             lambda p, v, i, dd: nrmlib.estimate_normals(
                 p, v, k=nrm_k, idx_d2=(i, dd))))
+        fpfh_fn = jax.jit(jax.vmap(
+            lambda p, nr, v, i, dd: reg.fpfh_features(
+                p, nr, v, radius=float(fr), k=feat_k, idx_d2=(i, dd))))
+        idx_d2 = None
+        for arm in ("bq=512", "bq=1024", "bq=2048",
+                    "approx:0.99", "approx:0.95"):
+            if arm.startswith("bq="):
+                kw = dict(block_q=int(arm[3:]))
+            else:
+                kw = dict(selector=arm)
+            knn_fn = jax.jit(jax.vmap(
+                lambda p, v: knnlib.knn_brute(p, v, feat_k, **kw)))
+            out = timed(f"knn {arm}",
+                        lambda: chunked(
+                            lambda s, e: knn_fn(p_stack[s:e], v_stack[s:e])))
+            cat = (jnp.concatenate([o[0] for o in out]),
+                   jnp.concatenate([o[1] for o in out]))
+            if arm == "bq=512":
+                idx_d2 = cat
+            elif arm.startswith("approx"):
+                nr_a = jnp.concatenate(chunked(
+                    lambda s, e: nrm_fn(p_stack[s:e], v_stack[s:e],
+                                        cat[0][s:e], cat[1][s:e])))
+                ft_a = jnp.concatenate(chunked(
+                    lambda s, e: fpfh_fn(p_stack[s:e], nr_a[s:e],
+                                         v_stack[s:e], cat[0][s:e],
+                                         cat[1][s:e])))
+                reg_quality(arm, nr_a, ft_a)
+        idx_all, d2_all = idx_d2
         nr_out = timed("normals(given knn)",
                        lambda: chunked(
                            lambda s, e: nrm_fn(p_stack[s:e], v_stack[s:e],
                                                idx_all[s:e], d2_all[s:e])))
         nr_all = jnp.concatenate(nr_out)
-        fpfh_fn = jax.jit(jax.vmap(
-            lambda p, nr, v, i, dd: reg.fpfh_features(
-                p, nr, v, radius=float(fr), k=feat_k, idx_d2=(i, dd))))
-        timed("fpfh(given knn+normals)",
-              lambda: chunked(
-                  lambda s, e: fpfh_fn(p_stack[s:e], nr_all[s:e],
-                                       v_stack[s:e], idx_all[s:e],
-                                       d2_all[s:e])))
+        ft_out = timed("fpfh(given knn+normals)",
+                       lambda: chunked(
+                           lambda s, e: fpfh_fn(p_stack[s:e], nr_all[s:e],
+                                                v_stack[s:e], idx_all[s:e],
+                                                d2_all[s:e])))
+        # exact-selection baseline for the quality gate (bq=512 exact idx)
+        reg_quality("exact-topk", nr_all, jnp.concatenate(ft_out))
 
     if not args.register:
         return

@@ -142,8 +142,12 @@ def main() -> None:
     # and cost 4x — both dead ends are kept out of the engine
     for tile, window, sel in [(4096, 16384, "topk"),
                               (2048, 16384, "topk"),
-                              (2048, 8192, "topk"),
-                              (1024, 8192, "topk")]:
+                              (2048, 16384, "tournament"),
+                              (4096, 16384, "tournament"),
+                              (2048, 8192, "tournament"),
+                              (1024, 8192, "topk"),
+                              (1024, 4096, "topk"),
+                              (512, 4096, "topk")]:
         try:
             t0 = time.perf_counter()
             md = np.array(pc._voxelized_knn_mean_dist(
