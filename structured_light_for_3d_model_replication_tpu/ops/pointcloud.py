@@ -25,6 +25,7 @@ reference semantics via scipy/cKDTree.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -154,7 +155,14 @@ def _spacing_d2_jit(q, b, qi, bi):
 def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
     """Slab-window + exact-fallback outlier mask for quasi-uniform clouds
     (the accelerator arm of statistical_outlier_mask; backend-agnostic in
-    itself, which is what the CPU parity test exercises)."""
+    itself, which is what the CPU parity test exercises).
+
+    SLSCAN_TRACE_OUTLIER=1 prints sub-stage wall times (engine wait,
+    host complement, mask) for tunnel-overhead attribution."""
+    import time as _time
+
+    trace = os.environ.get("SLSCAN_TRACE_OUTLIER") == "1"
+    t0 = _time.perf_counter()
     md_dev = _voxelized_knn_mean_dist(points, valid, jnp.float32(cell),
                                       nb_neighbors)
     # overlap the host complement's cKDTree BUILD with the device slab pass
@@ -164,9 +172,18 @@ def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
     # work occupies the same core, so nothing overlaps
     pts_np = np.asarray(points, np.float32)
     val_np = np.asarray(valid)
+    if trace:
+        print(f"[outlier-trace] dispatch+pts_D2H {_time.perf_counter()-t0:.3f}s",
+              flush=True)
     tree_vi = (knnlib.kdtree_build(pts_np, val_np)
                if jax.default_backend() != "cpu" else None)
+    if trace:
+        print(f"[outlier-trace] +tree_build {_time.perf_counter()-t0:.3f}s",
+              flush=True)
     mean_d = np.array(md_dev)
+    if trace:
+        print(f"[outlier-trace] +engine_wait {_time.perf_counter()-t0:.3f}s",
+              flush=True)
     # rows the slab window could not certify (k-th neighbor beyond 4*cell:
     # cloud-boundary points and true outliers) get an exact dense pass —
     # Open3D's statistics include the huge mean distances of far outliers,
@@ -186,8 +203,15 @@ def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
         dsel = knnlib.kdtree_distances_rows(pts_np, val_np, bad_idx,
                                             nb_neighbors, tree_vi=tree_vi)
         mean_d[bad] = dsel.mean(axis=1)
-    return np.asarray(_stat_outlier_from_knn(
+    if trace:
+        print(f"[outlier-trace] +complement({int(bad.sum())} rows) "
+              f"{_time.perf_counter()-t0:.3f}s", flush=True)
+    out = np.asarray(_stat_outlier_from_knn(
         jnp.asarray(mean_d), valid, jnp.float32(std_ratio), jnp))
+    if trace:
+        print(f"[outlier-trace] +mask {_time.perf_counter()-t0:.3f}s",
+              flush=True)
+    return out
 
 
 _SLAB_FAR = 3e9
