@@ -30,7 +30,7 @@ from structured_light_for_3d_model_replication_tpu.ops import (
 )
 
 __all__ = ["merge_360", "merge_360_posegraph", "preprocess_for_registration",
-           "chamfer_distance"]
+           "chamfer_distance", "DeviceClouds", "compact_views_device"]
 
 
 @dataclass
@@ -41,10 +41,76 @@ class _Prep:
     features: jnp.ndarray
 
 
+@dataclass
+class DeviceClouds:
+    """Device-resident per-view clouds: the fused decode -> merge handoff.
+
+    ``points`` [V,S,3] f32 / ``valid`` [V,S] bool / ``colors`` [V,S,3] u8,
+    one shared padded slot count S per view (compact_views_device). On an
+    accelerator, merge_360 consumes this WITHOUT the per-view host pack +
+    ~12 MB re-upload the host-cloud list pays — the clouds a device decode
+    just produced never round-trip the tunnel. The reference's equivalent
+    boundary is the .ply-per-view file contract between scan and merge
+    (server/processing.py:489-515); the TPU-first boundary is HBM."""
+    points: jnp.ndarray
+    valid: jnp.ndarray
+    colors: jnp.ndarray
+    # per-view survivor counts (host array) — compact_views_device fills
+    # this so merge_360's occupancy gate needs no extra device sync
+    counts: np.ndarray | None = None
+
+    def to_host_list(self):
+        """Materialize as the host (points, colors) list merge_360 and
+        every tool/test accepts — the compatibility boundary."""
+        p = np.asarray(self.points, np.float32)
+        v = np.asarray(self.valid, bool)
+        c = np.asarray(self.colors, np.uint8)
+        return [(p[i][v[i]], c[i][v[i]]) for i in range(p.shape[0])]
+
+
+def _bucket_pad(max_count: int, slots: int | None = None,
+                multiple: int = 2048) -> int:
+    """Round a survivor count up to the shared per-view bucket size,
+    clamped to the available slot count — the one idiom behind every
+    fixed-shape view stack in this module."""
+    b = -(-max(max_count, 1) // multiple) * multiple
+    return b if slots is None else min(b, slots)
+
+
+@jax.jit
+def _compact_views_jit(pts, valid, cols):
+    # stable valid-first ordering puts each view's survivors in a slot
+    # prefix (same export-boundary pattern as triangulate.compact_cloud,
+    # but batched over views and staying on device)
+    order = jnp.argsort(~valid, axis=1, stable=True)
+    return (jnp.take_along_axis(pts, order[..., None], axis=1),
+            jnp.take_along_axis(valid, order, axis=1),
+            jnp.take_along_axis(cols, order[..., None], axis=1))
+
+
+def compact_views_device(points, valid, colors) -> DeviceClouds:
+    """Compact a decoded view stack ([V, H*W] slots, ~15-25% valid) to one
+    shared 2048-bucket so downstream per-view launches scale with real
+    point counts — the only host traffic is the [V] survivor counts."""
+    p, v, c = _compact_views_jit(jnp.asarray(points),
+                                 jnp.asarray(valid),
+                                 jnp.asarray(colors))
+    cnts = np.asarray(v.sum(axis=1)).astype(int)          # one small sync
+    bucket = _bucket_pad(int(cnts.max()), p.shape[1])
+    return DeviceClouds(p[:, :bucket], v[:, :bucket], c[:, :bucket], cnts)
+
+
 # feature-prep configuration, shared with tools/profile_merge's attribution
-# arms so the profiler can never drift from the production values
-FEAT_K = 48            # shared kNN depth (FPFH neighborhood)
-NORMALS_K = 30         # normals use the nearest 30 of the 48
+# arms so the profiler can never drift from the production values.
+# FEAT_K: the reference's Open3D preprocess uses max_nn=100
+# (processing.py:455-466); 48 was the original perf departure and the r5
+# on-chip sweep measured 32 equal-or-better (gfit 0.863 vs 0.856@48 vs
+# 0.828@exact-48, ifit 0.940 all; kNN 0.273 vs 0.328 s, FPFH 0.183 vs
+# 0.212 s across 24 views) — FPFH's 11-bin histograms saturate well
+# before 48 neighbors. Registration fitness is the acceptance gate for
+# this knob; features carry no bit-exactness contract.
+FEAT_K = 32            # shared kNN depth (FPFH neighborhood)
+NORMALS_K = 30         # normals use the nearest 30 of FEAT_K
 FEAT_RADIUS_SCALE = 5.0  # FPFH radius = 5 * voxel (reference's preprocess)
 FEATURE_CHUNK = 8      # views batched per vmap launch (memory bound)
 
@@ -219,13 +285,13 @@ def _voxel_pack_views(clouds, voxel: float, sample_before: int,
         # residency bound this loop exists for
         cnts = np.asarray(v_all.sum(axis=1))[:len(part)].astype(int)
         counts.extend(int(x) for x in cnts)
-        bucket = -(-max(int(cnts.max()), 1) // 2048) * 2048
+        bucket = _bucket_pad(int(cnts.max()))
         views_p.extend(p_all[k, :bucket] for k in range(len(part)))
 
     # pad every view up to ONE size on device; invalid slots hold zeros,
     # which every downstream op masks via `valid` (knn parks them at _FAR
     # itself)
-    n_pad = -(-max(max(counts), 1) // 2048) * 2048
+    n_pad = _bucket_pad(max(counts))
     views_p = [vp if vp.shape[0] == n_pad else
                jnp.concatenate([vp, jnp.zeros((n_pad - vp.shape[0], 3),
                                               jnp.float32)])
@@ -238,6 +304,23 @@ def _voxel_pack_views(clouds, voxel: float, sample_before: int,
         raw = (jnp.concatenate([p[:k] for p, _, k in raw_chunks]),
                jnp.concatenate([v[:k] for _, v, k in raw_chunks]))
     return p_stack, v_stack, raw
+
+
+def _preprocess_views_device(dc: DeviceClouds, voxel: float):
+    """_preprocess_views for a DeviceClouds stack: no host pack, no
+    re-upload — voxel downsample the resident stack, one survivor-count
+    sync, features on the shared bucket. Returns (preps, raw)."""
+    p_all, v_all = _voxel_views_jit(dc.points, dc.valid, jnp.float32(voxel))
+    cnts = np.asarray(v_all.sum(axis=1)).astype(int)      # one small sync
+    n_pad = _bucket_pad(int(cnts.max()), p_all.shape[1])
+    p_stack = p_all[:, :n_pad]
+    v_stack = (jnp.asarray(cnts, jnp.int32)[:, None]
+               > jnp.arange(n_pad, dtype=jnp.int32)[None, :])
+    nr_all, feat_all = _features_views_jit(
+        p_stack, v_stack, jnp.float32(FEAT_RADIUS_SCALE * voxel))
+    preps = [_Prep(p_stack[i], v_stack[i], nr_all[i], feat_all[i])
+             for i in range(p_stack.shape[0])]
+    return preps, (dc.points, dc.valid)
 
 
 def _register_chain_batched(preps, cfg: MergeConfig, voxel: float,
@@ -300,36 +383,64 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
 
     cfg = cfg or MergeConfig()
     voxel = float(cfg.voxel_size)
-    n = len(clouds)
-    merged_p = [np.asarray(clouds[0][0], np.float32)]
-    merged_c = [np.asarray(clouds[0][1], np.uint8)]
-    transforms = [np.eye(4, dtype=np.float32)]
     tm = timings if timings is not None else {}
+    # DeviceClouds input: the fused decode->merge handoff. The resident
+    # fast path needs the accelerator + the full postprocess chain (it is
+    # the device-accumulate path with the upload already elided); any
+    # other configuration falls back through the host-list boundary.
+    dc = clouds if isinstance(clouds, DeviceClouds) else None
+    if dc is not None:
+        v_cnt, slots = dc.points.shape[0], dc.points.shape[1]
+        cnts = (dc.counts if dc.counts is not None
+                else np.asarray(dc.valid.sum(axis=1)).astype(int))
+        fast = (mesh is None and step_callback is None
+                and jax.default_backend() != "cpu" and v_cnt > 1
+                and (not cfg.sample_before or cfg.sample_before <= 1)
+                and _full_postprocess(cfg)
+                and v_cnt * slots * 12 <= (1 << 30)
+                # same occupancy guard as the host device-accumulate gate:
+                # one huge view pads every view's slots, ballooning the
+                # postprocess sort with mostly-invalid rows
+                and int(cnts.sum()) >= 0.5 * v_cnt * slots)
+        if not fast:
+            clouds = dc.to_host_list()
+            dc = None
+    n = dc.points.shape[0] if dc is not None else len(clouds)
+    if dc is None:
+        merged_p = [np.asarray(clouds[0][0], np.float32)]
+        merged_c = [np.asarray(clouds[0][1], np.uint8)]
+    transforms = [np.eye(4, dtype=np.float32)]
     if n == 1:
         points, colors = _postprocess_merged(merged_p[0], merged_c[0], cfg)
         return points, colors, transforms
 
-    # device accumulate: when nothing needs the per-step host clouds (no
-    # preview callback) and the full postprocess chain follows on this
-    # device, the raw per-view uploads from preprocess are reused — the
-    # transformed merged cloud never round-trips the host (~12 MB of f32
-    # saved per merge on a tunneled chip)
-    n_raw_est = -(-max(len(p) for p, _ in clouds) // 8192) * 8192
-    n_actual = sum(len(p) for p, _ in clouds)
-    device_acc = (mesh is None and step_callback is None
-                  and jax.default_backend() != "cpu"
-                  and (not cfg.sample_before or cfg.sample_before <= 1)
-                  and _full_postprocess(cfg)
-                  # HBM bound: the retained raw stack (+ its transformed
-                  # copy) must stay small next to device memory, and the
-                  # padded slot count must not balloon the postprocess
-                  # sort when view sizes are uneven
-                  and n * n_raw_est * 12 <= (1 << 30)
-                  and n_actual >= 0.5 * n * n_raw_est)
+    if dc is not None:
+        device_acc = True
+    else:
+        # device accumulate: when nothing needs the per-step host clouds
+        # (no preview callback) and the full postprocess chain follows on
+        # this device, the raw per-view uploads from preprocess are
+        # reused — the transformed merged cloud never round-trips the
+        # host (~12 MB of f32 saved per merge on a tunneled chip)
+        n_raw_est = -(-max(len(p) for p, _ in clouds) // 8192) * 8192
+        n_actual = sum(len(p) for p, _ in clouds)
+        device_acc = (mesh is None and step_callback is None
+                      and jax.default_backend() != "cpu"
+                      and (not cfg.sample_before or cfg.sample_before <= 1)
+                      and _full_postprocess(cfg)
+                      # HBM bound: the retained raw stack (+ its
+                      # transformed copy) must stay small next to device
+                      # memory, and the padded slot count must not balloon
+                      # the postprocess sort when view sizes are uneven
+                      and n * n_raw_est * 12 <= (1 << 30)
+                      and n_actual >= 0.5 * n * n_raw_est)
     t0 = _time.perf_counter()
-    pre = _preprocess_views(clouds, voxel, cfg.sample_before,
-                            keep_raw=device_acc)
-    preps, raw = pre if device_acc else (pre, None)
+    if dc is not None:
+        preps, raw = _preprocess_views_device(dc, voxel)
+    else:
+        pre = _preprocess_views(clouds, voxel, cfg.sample_before,
+                                keep_raw=device_acc)
+        preps, raw = pre if device_acc else (pre, None)
     tm["preprocess_s"] = round(_time.perf_counter() - t0, 3)
     t0 = _time.perf_counter()
     T_all, gfit_all, ifit_all, irmse_all = _register_chain_batched(
@@ -367,10 +478,13 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
         moved = _accumulate_views_jit(raw_p, Ts)        # one launch
         points = moved.reshape(-1, 3)
         valid_flat = raw_v.reshape(-1)
-        cols = np.zeros((n, raw_p.shape[1], 3), np.uint8)
-        for i, (_, c_full) in enumerate(clouds):
-            cols[i, :len(c_full)] = np.asarray(c_full, np.uint8)
-        colors = jnp.asarray(cols).reshape(-1, 3)
+        if dc is not None:
+            colors = dc.colors.reshape(-1, 3)           # already resident
+        else:
+            cols = np.zeros((n, raw_p.shape[1], 3), np.uint8)
+            for i, (_, c_full) in enumerate(clouds):
+                cols[i, :len(c_full)] = np.asarray(c_full, np.uint8)
+            colors = jnp.asarray(cols).reshape(-1, 3)
     tm["accumulate_s"] = round(_time.perf_counter() - t0, 3)
 
     t0 = _time.perf_counter()

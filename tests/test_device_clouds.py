@@ -1,0 +1,95 @@
+"""DeviceClouds: the fused decode->merge handoff (device-resident views).
+
+On the CPU test backend the merge_360 fast path is gated off, so these
+tests pin (a) the compaction contract, (b) fallback equivalence through
+to_host_list, and (c) that _preprocess_views_device produces bit-identical
+preps to the host-list preprocess — the property that makes the resident
+path a pure transfer optimization, not a numerics change.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.models import (
+    reconstruction as rec,
+)
+
+
+def _padded_views(rng, n_views=4, slots=3000, valid_frac=0.3):
+    pts = np.full((n_views, slots, 3), 1e9, np.float32)
+    cols = np.zeros((n_views, slots, 3), np.uint8)
+    valid = np.zeros((n_views, slots), bool)
+    host = []
+    for i in range(n_views):
+        n = int(slots * valid_frac) + rng.integers(0, 200)
+        sel = np.sort(rng.choice(slots, n, replace=False))
+        u = rng.normal(size=(n, 3))
+        u /= np.linalg.norm(u, axis=1, keepdims=True)
+        p = (40.0 * u + rng.normal(0, 0.05, (n, 3))).astype(np.float32)
+        th = np.deg2rad(12.0 * i)
+        R = np.array([[np.cos(th), 0, np.sin(th)], [0, 1, 0],
+                      [-np.sin(th), 0, np.cos(th)]], np.float32)
+        p = (p @ R.T).astype(np.float32)
+        c = rng.integers(0, 255, (n, 3)).astype(np.uint8)
+        pts[i, sel] = p
+        cols[i, sel] = c
+        valid[i, sel] = True
+        host.append((p, c))
+    return pts, valid, cols, host
+
+
+def test_compact_views_device_prefix_and_content():
+    rng = np.random.default_rng(7)
+    pts, valid, cols, host = _padded_views(rng)
+    dc = rec.compact_views_device(pts, valid, cols)
+    v = np.asarray(dc.valid)
+    # survivors form a prefix and counts match
+    assert (v.cumsum(axis=1) == np.arange(1, v.shape[1] + 1)).sum(axis=1).all()
+    for i, (p_h, c_h) in enumerate(host):
+        n = len(p_h)
+        assert v[i, :n].all() and not v[i, n:].any()
+        # stable compaction preserves the original relative order
+        np.testing.assert_array_equal(np.asarray(dc.points)[i, :n], p_h)
+        np.testing.assert_array_equal(np.asarray(dc.colors)[i, :n], c_h)
+
+
+def test_to_host_list_roundtrip():
+    rng = np.random.default_rng(8)
+    pts, valid, cols, host = _padded_views(rng)
+    dc = rec.compact_views_device(pts, valid, cols)
+    back = dc.to_host_list()
+    assert len(back) == len(host)
+    for (p_b, c_b), (p_h, c_h) in zip(back, host):
+        np.testing.assert_array_equal(p_b, p_h)
+        np.testing.assert_array_equal(c_b, c_h)
+
+
+def test_merge_360_device_clouds_matches_host_list():
+    # CPU backend: DeviceClouds falls back through to_host_list, so the
+    # outputs must be IDENTICAL to passing the host list directly
+    rng = np.random.default_rng(9)
+    pts, valid, cols, host = _padded_views(rng)
+    dc = rec.compact_views_device(pts, valid, cols)
+    p1, c1, T1 = rec.merge_360(host, log=lambda m: None)
+    p2, c2, T2 = rec.merge_360(dc, log=lambda m: None)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    np.testing.assert_array_equal(np.asarray(T1), np.asarray(T2))
+
+
+def test_preprocess_views_device_matches_host():
+    # the resident preprocess must be a pure transfer optimization:
+    # bit-identical preps vs the host-list path at the same voxel
+    rng = np.random.default_rng(10)
+    pts, valid, cols, host = _padded_views(rng)
+    dc = rec.compact_views_device(pts, valid, cols)
+    preps_h = rec._preprocess_views(host, 3.0, 0)
+    preps_d, raw = rec._preprocess_views_device(dc, 3.0)
+    assert raw[0].shape == dc.points.shape
+    assert len(preps_h) == len(preps_d)
+    for a, b in zip(preps_h, preps_d):
+        np.testing.assert_array_equal(np.asarray(a.valid), np.asarray(b.valid))
+        np.testing.assert_array_equal(np.asarray(a.points)[np.asarray(a.valid)],
+                                      np.asarray(b.points)[np.asarray(b.valid)])
+        np.testing.assert_allclose(
+            np.asarray(a.features)[np.asarray(a.valid)],
+            np.asarray(b.features)[np.asarray(b.valid)], atol=1e-5)

@@ -140,14 +140,12 @@ def main() -> None:
     # SLOWER than top_k and not bit-identical on TPU; lax.map(batch_size=)
     # around the tile loop turns the dynamic_slice windows into gathers
     # and cost 4x — both dead ends are kept out of the engine
-    for tile, window, sel in [(4096, 16384, "topk"),
-                              (2048, 16384, "topk"),
-                              (2048, 16384, "tournament"),
-                              (4096, 16384, "tournament"),
-                              (2048, 8192, "tournament"),
-                              (1024, 8192, "topk"),
-                              (1024, 4096, "topk"),
-                              (512, 4096, "topk")]:
+    for tile, window, sel in [(2048, 16384, "topk"),
+                              (8192, 16384, "topk"),
+                              (2048, 16384, "nosel"),
+                              (2048, 16384, "iter"),
+                              (4096, 16384, "iter"),
+                              (1024, 8192, "topk")]:
         try:
             t0 = time.perf_counter()
             md = np.array(pc._voxelized_knn_mean_dist(
