@@ -88,16 +88,49 @@ def _compact_views_jit(pts, valid, cols):
             jnp.take_along_axis(cols, order[..., None], axis=1))
 
 
+# slot index packs into the low bits of one u32 sort key (validity in the
+# bit above), so the compaction order is ONE single-array sort instead of
+# a (key, index-payload) pair sort — and the gathers below touch only the
+# bucket prefix instead of every slot (12x less gather traffic at decode
+# occupancy). 2^21 slots covers 1080p stacks (2,073,600).
+_COMPACT_IOTA_BITS = 21
+
+
+@jax.jit
+def _compact_order_counts_jit(valid):
+    iota = jax.lax.broadcasted_iota(jnp.uint32, valid.shape, 1)
+    key = jnp.where(valid, iota, iota + jnp.uint32(1 << _COMPACT_IOTA_BITS))
+    skey = jnp.sort(key, axis=1)
+    order = (skey & jnp.uint32((1 << _COMPACT_IOTA_BITS) - 1)).astype(
+        jnp.int32)
+    return order, valid.sum(axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def _compact_gather_jit(pts, valid, cols, order, bucket: int):
+    o = order[:, :bucket]
+    return (jnp.take_along_axis(pts, o[..., None], axis=1),
+            jnp.take_along_axis(valid, o, axis=1),
+            jnp.take_along_axis(cols, o[..., None], axis=1))
+
+
 def compact_views_device(points, valid, colors) -> DeviceClouds:
     """Compact a decoded view stack ([V, H*W] slots, ~15-25% valid) to one
     shared 2048-bucket so downstream per-view launches scale with real
     point counts — the only host traffic is the [V] survivor counts."""
-    p, v, c = _compact_views_jit(jnp.asarray(points),
-                                 jnp.asarray(valid),
-                                 jnp.asarray(colors))
-    cnts = np.asarray(v.sum(axis=1)).astype(int)          # one small sync
+    pts = jnp.asarray(points)
+    v = jnp.asarray(valid)
+    c = jnp.asarray(colors)
+    if pts.shape[1] <= (1 << _COMPACT_IOTA_BITS):
+        order, cnts_dev = _compact_order_counts_jit(v)
+        cnts = np.asarray(cnts_dev).astype(int)           # one small sync
+        bucket = _bucket_pad(int(cnts.max()), pts.shape[1])
+        p2, v2, c2 = _compact_gather_jit(pts, v, c, order, bucket)
+        return DeviceClouds(p2, v2, c2, cnts)
+    p, v2, c2 = _compact_views_jit(pts, v, c)             # giant stacks
+    cnts = np.asarray(v2.sum(axis=1)).astype(int)
     bucket = _bucket_pad(int(cnts.max()), p.shape[1])
-    return DeviceClouds(p[:, :bucket], v[:, :bucket], c[:, :bucket], cnts)
+    return DeviceClouds(p[:, :bucket], v2[:, :bucket], c2[:, :bucket], cnts)
 
 
 # feature-prep configuration, shared with tools/profile_merge's attribution
@@ -175,9 +208,10 @@ def _pad_prep(p_c: np.ndarray, pad_to: int | None):
 
 @functools.partial(jax.jit, static_argnames=())
 def _prep_features_jit(p, v, feat_radius):
-    # one kNN (k=48, ascending) feeds both stages: the neighbor search is
-    # the dominant cost of feature prep, and normals only need the nearest
-    # 30 of the 48 FPFH neighbors. Stays on knn()'s brute dispatch — an r5
+    # one kNN (k=FEAT_K, ascending) feeds both stages: the neighbor search
+    # is the dominant cost of feature prep, and normals only need the
+    # nearest NORMALS_K of the FEAT_K neighbors. Stays on knn()'s brute
+    # dispatch — an r5
     # on-chip session that routed accelerators through knn_dense_approx
     # here measured register_s 0.94 -> 1.35 s (the 8192-bucket padding and
     # chunking hurt at per-view sizes) — but swaps the SELECTOR inside the
@@ -306,6 +340,22 @@ def _voxel_pack_views(clouds, voxel: float, sample_before: int,
     return p_stack, v_stack, raw
 
 
+def _device_accumulate_ok(cfg: MergeConfig, mesh, step_callback,
+                          n_views: int, slots: int, n_actual: int) -> bool:
+    """The ONE gate for both device-resident accumulate paths (host-list
+    keep_raw and DeviceClouds): accelerator backend, full postprocess
+    chain on this device, nothing needing per-step host clouds, an HBM
+    bound on the retained raw stack (+ its transformed copy), and slot
+    occupancy — one huge view must not pad every view's slots and
+    balloon the postprocess sort with mostly-invalid rows."""
+    return (mesh is None and step_callback is None
+            and jax.default_backend() != "cpu"
+            and (not cfg.sample_before or cfg.sample_before <= 1)
+            and _full_postprocess(cfg)
+            and n_views * slots * 12 <= (1 << 30)
+            and n_actual >= 0.5 * n_views * slots)
+
+
 def _preprocess_views_device(dc: DeviceClouds, voxel: float):
     """_preprocess_views for a DeviceClouds stack: no host pack, no
     re-upload — voxel downsample the resident stack, one survivor-count
@@ -393,15 +443,8 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
         v_cnt, slots = dc.points.shape[0], dc.points.shape[1]
         cnts = (dc.counts if dc.counts is not None
                 else np.asarray(dc.valid.sum(axis=1)).astype(int))
-        fast = (mesh is None and step_callback is None
-                and jax.default_backend() != "cpu" and v_cnt > 1
-                and (not cfg.sample_before or cfg.sample_before <= 1)
-                and _full_postprocess(cfg)
-                and v_cnt * slots * 12 <= (1 << 30)
-                # same occupancy guard as the host device-accumulate gate:
-                # one huge view pads every view's slots, ballooning the
-                # postprocess sort with mostly-invalid rows
-                and int(cnts.sum()) >= 0.5 * v_cnt * slots)
+        fast = v_cnt > 1 and _device_accumulate_ok(
+            cfg, mesh, step_callback, v_cnt, slots, int(cnts.sum()))
         if not fast:
             clouds = dc.to_host_list()
             dc = None
@@ -424,16 +467,8 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
         # host (~12 MB of f32 saved per merge on a tunneled chip)
         n_raw_est = -(-max(len(p) for p, _ in clouds) // 8192) * 8192
         n_actual = sum(len(p) for p, _ in clouds)
-        device_acc = (mesh is None and step_callback is None
-                      and jax.default_backend() != "cpu"
-                      and (not cfg.sample_before or cfg.sample_before <= 1)
-                      and _full_postprocess(cfg)
-                      # HBM bound: the retained raw stack (+ its
-                      # transformed copy) must stay small next to device
-                      # memory, and the padded slot count must not balloon
-                      # the postprocess sort when view sizes are uneven
-                      and n * n_raw_est * 12 <= (1 << 30)
-                      and n_actual >= 0.5 * n * n_raw_est)
+        device_acc = _device_accumulate_ok(cfg, mesh, step_callback, n,
+                                           n_raw_est, n_actual)
     t0 = _time.perf_counter()
     if dc is not None:
         preps, raw = _preprocess_views_device(dc, voxel)

@@ -6,7 +6,6 @@ to_host_list, and (c) that _preprocess_views_device produces bit-identical
 preps to the host-list preprocess — the property that makes the resident
 path a pure transfer optimization, not a numerics change.
 """
-import jax.numpy as jnp
 import numpy as np
 
 from structured_light_for_3d_model_replication_tpu.models import (
@@ -42,8 +41,8 @@ def test_compact_views_device_prefix_and_content():
     pts, valid, cols, host = _padded_views(rng)
     dc = rec.compact_views_device(pts, valid, cols)
     v = np.asarray(dc.valid)
-    # survivors form a prefix and counts match
-    assert (v.cumsum(axis=1) == np.arange(1, v.shape[1] + 1)).sum(axis=1).all()
+    # survivors form a prefix (valid is non-increasing along slots)
+    assert (v[:, 1:] <= v[:, :-1]).all()
     for i, (p_h, c_h) in enumerate(host):
         n = len(p_h)
         assert v[i, :n].all() and not v[i, n:].any()

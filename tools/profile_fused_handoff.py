@@ -1,0 +1,118 @@
+"""A/B the fused decode->merge handoff's pieces on the ambient backend:
+the view-stack decode (jnp vs width-padded Mosaic) and the device
+compaction (argsort-gather vs cumsum-scatter). Needs .bench_cache.npz
+with merge_frames (run `python bench.py` once).
+
+Self-terminating; do NOT wrap in a kill timer (see BENCH_NOTES.md).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> None:
+    from structured_light_for_3d_model_replication_tpu.utils import tpulock
+
+    lock = tpulock.acquire_tpu_lock(ROOT, timeout=60)  # noqa: F841
+    if lock is None:
+        sys.exit("another TPU client holds .tpu_lock")
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    import bench
+    from structured_light_for_3d_model_replication_tpu.models import (
+        reconstruction as rec,
+    )
+    from structured_light_for_3d_model_replication_tpu.models.scanner import (
+        SLScanner,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        synthetic as syn,
+    )
+
+    z = np.load(os.path.join(ROOT, ".bench_cache.npz"))
+    if "merge_frames" not in z:
+        sys.exit("cache lacks merge_frames — run `python bench.py` once")
+    frames = z["merge_frames"]
+    print(f"backend={jax.default_backend()} frames={frames.shape}")
+    mrig = syn.default_rig(cam_size=bench.MERGE_CAM,
+                           proj_size=bench.MERGE_PROJ)
+    sc = SLScanner(mrig.calibration(), bench.MERGE_CAM, bench.MERGE_PROJ,
+                   row_mode=1, plane_eval="quadratic")
+    fr_dev = jax.block_until_ready(jnp.asarray(frames))
+
+    def timed(label, fn, runs=3):
+        out = fn()
+        jax.block_until_ready(jax.tree.leaves(out))
+        best = np.inf
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(jax.tree.leaves(out))
+            best = min(best, time.perf_counter() - t0)
+        print(f"[{label}] best {best:.4f}s", flush=True)
+        return out
+
+    out = timed("decode jnp 480w", lambda: sc.forward_views(
+        fr_dev, thresh_mode="manual", shadow_val=40.0, contrast_val=10.0))
+
+    # width-padded variant: zero columns decode invalid; the Mosaic fused
+    # kernel needs w % 128 == 0
+    wpad = (-frames.shape[-1]) % 128
+    if wpad:
+        fr_p = np.pad(frames, ((0, 0), (0, 0), (0, 0), (0, wpad)))
+        cam_p = (bench.MERGE_CAM[0] + wpad, bench.MERGE_CAM[1])
+        # the padded columns have no calibration; rays for them come from
+        # the same rig evaluated at the padded width — only validity
+        # matters (shadow threshold kills black columns)
+        rig_p = syn.default_rig(cam_size=cam_p, proj_size=bench.MERGE_PROJ)
+        sc_p = SLScanner(rig_p.calibration(), cam_p, bench.MERGE_PROJ,
+                         row_mode=1, plane_eval="quadratic")
+        fr_p_dev = jax.block_until_ready(jnp.asarray(fr_p))
+        print(f"fuse_capable(padded)={sc_p._fuse_capable(fr_p_dev)}")
+        out_p = timed("decode padded 512w", lambda: sc_p.forward_views(
+            fr_p_dev, thresh_mode="manual", shadow_val=40.0,
+            contrast_val=10.0))
+        v0 = int(np.asarray(out.valid[0]).sum())
+        v0p = int(np.asarray(out_p.valid[0]).sum())
+        print(f"valid view0: 480w={v0} padded={v0p}")
+
+    timed("compact argsort", lambda: rec._compact_views_jit(
+        out.points, out.valid, out.colors))
+    timed("compact+counts (full compact_views_device)",
+          lambda: rec.compact_views_device(out.points, out.valid,
+                                           out.colors).points)
+
+    @jax.jit
+    def compact_scatter(pts, valid, cols):
+        S = pts.shape[1]
+        pos = jnp.where(valid, jnp.cumsum(valid, axis=1) - 1, S - 1)
+        vi = jnp.broadcast_to(
+            jnp.arange(pts.shape[0], dtype=jnp.int32)[:, None], pos.shape)
+        p = jnp.zeros_like(pts).at[vi, pos].set(pts)
+        c = jnp.zeros_like(cols).at[vi, pos].set(cols)
+        v = jnp.zeros_like(valid).at[vi, pos].set(valid)
+        return p, v, c
+
+    o2 = timed("compact cumsum-scatter", lambda: compact_scatter(
+        out.points, out.valid, out.colors))
+    # correctness: same survivor prefix content
+    a = rec._compact_views_jit(out.points, out.valid, out.colors)
+    n0 = int(np.asarray(a[1][0]).sum())
+    same = np.array_equal(np.asarray(a[0][0, :n0]),
+                          np.asarray(o2[0][0, :n0]))
+    print(f"scatter prefix matches argsort: {same} ({n0} pts)")
+
+
+if __name__ == "__main__":
+    main()
