@@ -686,3 +686,153 @@ def decode_maps_fused(frames, shadow, contrast, *, n_bits_col: int,
     call = _decode_caller(n_bits_col, n_bits_row, n_use_col, n_use_row,
                           tile_h, tile_w, itp)
     return call(frames, thr)
+
+
+# ---------------------------------------------------------------------------
+# slab_mean_knn: fused slab-window mean-of-k-NN for the outlier engine
+# ---------------------------------------------------------------------------
+
+def _slab_bisect_kernel(s_ref, q_ref, c0_ref, c1_ref, m_ref, n_ref, *,
+                        k: int, r2_bits: int, tile: int, wblk: int,
+                        n_iters: int):
+    """Mean distance to the k nearest candidates, exactly, without a sort.
+
+    One program = ``tile`` consecutive sorted queries vs a 2*wblk-wide
+    aligned candidate window (two half-window refs picked by the
+    prefetched per-tile block index ``s_ref``). Distances are computed by
+    coordinate DIFFERENCES (the package's exact_d2 policy — no MXU
+    expansion, no cancellation) and stay in VMEM; the k-th order
+    statistic comes from integer bisection on the f32 bit pattern
+    (monotone for non-negative floats), which is EXACT in <= 31 passes;
+    the mean is then one masked sum plus the tie-count correction
+    (k - #strictly-smaller) * sqrt(t) — identical to a top_k selection's
+    mean under any tie-breaking, because tied values are equal.
+
+    q_ref [tile, 8] f32; c0/c1_ref [1, 8, wblk] f32 (coords in sublanes;
+    the leading block axis walks wblk-aligned window blocks);
+    outputs: m_ref [tile, 1] f32 mean, n_ref [tile, 1] i32 count(<= r^2).
+    """
+    pid = pl.program_id(0)
+    sblk = s_ref[pid]
+    q = q_ref[...]
+
+    def half_d2i(c_ref, blk_idx):
+        d2 = jnp.zeros((tile, wblk), jnp.float32)
+        for d in range(3):
+            qd = q[:, d][:, None]                    # [tile, 1]
+            cd = c_ref[0, d, :][None, :]             # [1, wblk]
+            diff = qd - cd
+            d2 = d2 + diff * diff
+        d2i = jax.lax.bitcast_convert_type(jnp.maximum(d2, 0.0), jnp.int32)
+        # self-exclusion by GLOBAL sorted index, not a distance test
+        qg = pid * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
+        cg = (blk_idx * wblk
+              + jax.lax.broadcasted_iota(jnp.int32, (1, wblk), 1))
+        return jnp.where(cg == qg, jnp.int32(2**31 - 2), d2i)
+
+    a = half_d2i(c0_ref, sblk)
+    b = half_d2i(c1_ref, sblk + 1)
+    r2b = jnp.int32(r2_bits)
+    cnt_ok = ((a <= r2b).astype(jnp.int32).sum(axis=1, keepdims=True)
+              + (b <= r2b).astype(jnp.int32).sum(axis=1, keepdims=True))
+
+    def body(_, c):
+        lo, hi = c
+        mid = lo + (hi - lo) // 2
+        cnt = ((a <= mid).astype(jnp.int32).sum(axis=1, keepdims=True)
+               + (b <= mid).astype(jnp.int32).sum(axis=1, keepdims=True))
+        ge = cnt >= k
+        return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
+
+    lo = jnp.zeros((tile, 1), jnp.int32)
+    hi = jnp.full((tile, 1), r2b + 1, jnp.int32)
+    lo, hi = jax.lax.fori_loop(0, n_iters, body, (lo, hi))
+    t = hi                                           # k-th smallest bits
+
+    def half_sum(d2i):
+        lt = d2i < t
+        dist = jnp.sqrt(jax.lax.bitcast_convert_type(d2i, jnp.float32))
+        s = jnp.where(lt, dist, 0.0).sum(axis=1, keepdims=True)
+        return s, lt.astype(jnp.int32).sum(axis=1, keepdims=True)
+
+    sa, ca = half_sum(a)
+    sb, cb = half_sum(b)
+    tf = jax.lax.bitcast_convert_type(t, jnp.float32)
+    mean = (sa + sb + (k - ca - cb).astype(jnp.float32)
+            * jnp.sqrt(tf)) / jnp.float32(k)
+    m_ref[...] = mean
+    n_ref[...] = cnt_ok
+
+
+@functools.partial(jax.jit, static_argnames=("k", "r2_bits", "tile", "wblk",
+                                             "interpret"))
+def _slab_bisect_call(q8, ptsW, starts_blk, k: int, r2_bits: int, tile: int,
+                      wblk: int, interpret: bool):
+    L = q8.shape[0]
+    grid = (L // tile,)
+    nblk = ptsW.shape[0]
+    spec_c = lambda off: pl.BlockSpec(
+        (1, 8, wblk), lambda i, s: (jnp.minimum(s[i] + off, nblk - 1), 0, 0),
+        memory_space=pltpu.VMEM)
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, 8), lambda i, s: (i, 0),
+                         memory_space=pltpu.VMEM),
+            spec_c(0),
+            spec_c(1),
+        ],
+        out_specs=(
+            pl.BlockSpec((tile, 1), lambda i, s: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i, s: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+    )
+    mean, cnt = pl.pallas_call(
+        functools.partial(_slab_bisect_kernel, k=k, r2_bits=r2_bits,
+                          tile=tile, wblk=wblk, n_iters=31),
+        grid_spec=gs,
+        out_shape=(jax.ShapeDtypeStruct((L, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((L, 1), jnp.int32)),
+        interpret=interpret,
+    )(starts_blk, q8, ptsW, ptsW)
+    return mean[:, 0], cnt[:, 0]
+
+
+def slab_mean_knn(pts_sorted, r: float, k: int, tile: int = 128,
+                  wblk: int = 8192, interpret: bool | None = None):
+    """Slab-window mean-of-k-NN over an x-sorted padded cloud [L, 3]
+    (invalid rows parked at the far sentinel, L a multiple of ``tile``
+    and of ``wblk``). Returns (mean_d [L] f32, cnt_ok [L] i32,
+    win_end [L] i32): rows are certified by the CALLER as
+    cnt_ok >= k (k-th neighbor within r) plus its window-coverage test
+    using win_end (exclusive end slot of the aligned candidate window).
+
+    The engine behind statistical_outlier_mask's accelerator arm when
+    Mosaic is available: it replaces the [tile, window] HBM distance
+    blocks + lax.top_k sort of the jnp slab engine with VMEM-resident
+    bisection (see _slab_bisect_kernel)."""
+    if wblk % tile:
+        raise ValueError(
+            f"tile ({tile}) must divide wblk ({wblk}): the grid walks "
+            f"L//tile query tiles and L pads to wblk multiples — a "
+            f"non-dividing tile leaves trailing query rows unwritten")
+    L = pts_sorted.shape[0]
+    x = pts_sorted[:, 0]
+    r32 = np.float32(r)
+    r2_bits = int(np.float32(r32 * r32).view(np.int32))
+    nblk = L // wblk
+    first_x = x[jnp.arange(L // tile, dtype=jnp.int32) * tile]
+    a = jnp.searchsorted(x, first_x - r32).astype(jnp.int32)
+    starts_blk = jnp.minimum(a // wblk, max(nblk - 2, 0)).astype(jnp.int32)
+    q8 = jnp.zeros((L, 8), jnp.float32).at[:, :3].set(pts_sorted)
+    # [nblk, 8, wblk]: Mosaic needs the BLOCK's last two dims (8, wblk)
+    # tile-aligned; the leading axis walks wblk-aligned window blocks
+    ptsW = jnp.transpose(q8, (1, 0)).reshape(8, nblk, wblk).transpose(1, 0, 2)
+    itp = _interpret() if interpret is None else interpret
+    mean, cnt = _slab_bisect_call(q8, ptsW, starts_blk, k, r2_bits, tile,
+                                  wblk, itp)
+    win_end = jnp.repeat((starts_blk + 2) * wblk, tile)
+    return mean, cnt, win_end

@@ -218,8 +218,9 @@ _SLAB_FAR = 3e9
 
 
 def _voxelized_knn_mean_dist(points, valid, cell, k: int,
-                             tile: int = 1024, window: int = 8192,
-                             selector: str = "topk"):
+                             tile: int | None = None,
+                             window: int | None = None,
+                             selector: str = "auto"):
     """Mean distance to the k nearest neighbors of a quasi-uniform (e.g.
     voxel-downsampled) cloud, certified-exact, via sorted-axis slab
     windows: sort along the cloud's widest axis, give each ``tile`` of
@@ -251,9 +252,75 @@ def _voxelized_knn_mean_dist(points, valid, cell, k: int,
     lo, hi = _masked_extent_jit(pts, val)
     ax = int(np.argmax(np.nan_to_num(np.asarray(hi) - np.asarray(lo))))
     perm = (ax, (ax + 1) % 3, (ax + 2) % 3)
+    if selector == "auto":
+        # where Mosaic compiles, the bisection kernel IS the engine: the
+        # r5 on-chip sweep measured 0.360-0.397 s vs lax.top_k's 0.684 s
+        # at the same 94.7% certification on the 175k bench cloud — and
+        # its selection is EXACT (in-VMEM difference distances; the jnp
+        # engine selects on the MXU expansion, whose f32 cancellation can
+        # swap near-tied neighbors). Hosts, non-Mosaic accelerators, and
+        # callers who tuned explicit (tile, window) — those values are
+        # topk-engine geometry; e.g. tile 2048 overflows the kernel's
+        # VMEM budget — keep the top_k engine.
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            pallas_kernels as pk,
+        )
+
+        if pk.use_pallas() and tile is None and window is None:
+            selector, tile, window = "bisect", 64, 8192
+        else:
+            selector = "topk"
+    if tile is None:
+        tile = 64 if selector == "bisect" else 1024
+    if window is None:
+        window = 8192
+    if selector == "bisect":
+        # quantize r to a coarse log grid (~9% steps): its bit pattern is
+        # baked into the kernel as a static, and the UNHINTED path derives
+        # cell from per-cloud spacing — unquantized, every distinct cloud
+        # would retrace + re-run Mosaic. Any r is CORRECT (certification
+        # covers the choice); quantization only nudges how much work the
+        # host complement sees.
+        r = 4.0 * float(cell)
+        r_q = float(np.float32(2.0 ** (round(np.log2(max(r, 1e-9)) * 8)
+                                       / 8.0)))
+        return _slab_bisect_engine_jit(pts[:, jnp.asarray(perm)], val,
+                                       r_q, k, tile, window)
     return _slab_knn_mean_dist_jit(pts[:, jnp.asarray(perm)], val,
                                    jnp.float32(4.0 * float(cell)), k,
                                    tile, window, selector)
+
+
+@functools.partial(jax.jit, static_argnames=("r", "k", "tile", "wblk"))
+def _slab_bisect_engine_jit(points, valid, r: float, k: int, tile: int,
+                            wblk: int):
+    """Slab engine on the Pallas bisection kernel (pallas_kernels.
+    slab_mean_knn): same sort/certify/scatter frame as the jnp engine,
+    but the per-tile distance block stays in VMEM and the k-th order
+    statistic comes from exact f32-bit bisection instead of a top_k
+    sort. ``r`` is static (its bit pattern is baked into the kernel)."""
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pallas_kernels as pk,
+    )
+
+    n = points.shape[0]
+    L = max(-(-n // wblk) * wblk, 2 * wblk)
+    x = jnp.where(valid, points[:, 0], jnp.inf)
+    order = jnp.argsort(x)
+    pts_s = jnp.where(valid[order][:, None], points[order],
+                      jnp.float32(_SLAB_FAR))
+    if L > n:
+        pts_s = jnp.concatenate(
+            [pts_s, jnp.full((L - n, 3), _SLAB_FAR, jnp.float32)])
+    md, cnt, win_end = pk.slab_mean_knn(pts_s, r, k, tile=tile, wblk=wblk)
+    x_s = pts_s[:, 0]
+    # left coverage holds by construction (window start block-aligns DOWN
+    # from the searchsorted slab start); only the right edge can truncate
+    right_ok = ((win_end >= L)
+                | (x_s[jnp.minimum(win_end, L) - 1] >= x_s + r))
+    cert = (cnt >= k) & right_ok & (x_s < _SLAB_FAR)
+    md = jnp.where(cert, md, jnp.inf)
+    return jnp.full(n, jnp.inf, jnp.float32).at[order].set(md[:n])
 
 
 @functools.partial(jax.jit,

@@ -351,3 +351,35 @@ def test_statistical_outlier_voxelized_fast_path(rng):
     cert = np.isfinite(md_probe[samp])
     np.testing.assert_allclose(md_probe[samp][cert], md_ref[cert],
                                rtol=1e-4, atol=1e-4)
+
+
+def test_slab_bisect_engine_matches_topk_and_twin():
+    """The Pallas bisection engine (interpret mode here) must agree with
+    the lax.top_k slab engine on co-certified rows and with the cKDTree
+    twin on every row it certifies — it is the accelerator default
+    wherever Mosaic compiles."""
+    import jax.numpy as jnp
+
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        knn as knnlib,
+        pointcloud as pc,
+    )
+
+    rng = np.random.default_rng(12)
+    pts = rng.uniform(0, 30, (6000, 3)).astype(np.float32)
+    v = jnp.asarray(np.ones(len(pts), bool))
+    p = jnp.asarray(pts)
+    a = np.asarray(pc._voxelized_knn_mean_dist(
+        p, v, jnp.float32(1.5), 20, tile=128, window=2048, selector="topk"))
+    b = np.asarray(pc._voxelized_knn_mean_dist(
+        p, v, jnp.float32(1.5), 20, tile=128, window=2048,
+        selector="bisect"))
+    both = np.isfinite(a) & np.isfinite(b)
+    assert both.sum() > 1000
+    rel = np.abs(a[both] - b[both]) / np.maximum(a[both], 1e-9)
+    assert rel.max() < 1e-5
+    rows = np.flatnonzero(np.isfinite(b))
+    ref = knnlib.kdtree_distances_rows(pts, np.ones(len(pts), bool),
+                                       rows, 20).mean(axis=1)
+    rel_t = np.abs(b[rows] - ref) / np.maximum(ref, 1e-9)
+    assert rel_t.max() < 1e-5

@@ -140,12 +140,14 @@ def main() -> None:
     # SLOWER than top_k and not bit-identical on TPU; lax.map(batch_size=)
     # around the tile loop turns the dynamic_slice windows into gathers
     # and cost 4x — both dead ends are kept out of the engine
-    for tile, window, sel in [(2048, 16384, "topk"),
-                              (8192, 16384, "topk"),
-                              (2048, 16384, "nosel"),
-                              (2048, 16384, "iter"),
-                              (4096, 16384, "iter"),
-                              (1024, 8192, "topk")]:
+    # bisect rows: tile/window mean (tile, wblk) — the Pallas engine's
+    # effective window is 2*wblk (two aligned half-blocks)
+    for tile, window, sel in [(1024, 8192, "topk"),
+                              (2048, 16384, "topk"),
+                              (128, 8192, "bisect"),
+                              (256, 8192, "bisect"),
+                              (128, 4096, "bisect"),
+                              (64, 8192, "bisect")]:
         try:
             t0 = time.perf_counter()
             md = np.array(pc._voxelized_knn_mean_dist(
