@@ -206,9 +206,13 @@ def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
     if trace:
         print(f"[outlier-trace] +complement({int(bad.sum())} rows) "
               f"{_time.perf_counter()-t0:.3f}s", flush=True)
-    out = np.asarray(_stat_outlier_from_knn(
-        jnp.asarray(mean_d), valid, jnp.float32(std_ratio), jnp))
+    # returned DEVICE-backed (on accelerators): the fused merge boundary
+    # consumes the mask on device (keep-compaction) — materializing np
+    # here would add a mask D2H + re-upload round trip
+    out = _stat_outlier_from_knn(
+        jnp.asarray(mean_d), valid, jnp.float32(std_ratio), jnp)
     if trace:
+        out = jax.block_until_ready(out)
         print(f"[outlier-trace] +mask {_time.perf_counter()-t0:.3f}s",
               flush=True)
     return out
