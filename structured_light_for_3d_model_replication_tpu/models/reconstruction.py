@@ -635,29 +635,20 @@ def _postprocess_merged(points, colors, cfg: MergeConfig,
         # delegates to the cKDTree twin (degraded-mode fast path).
         cell = (float(cfg.final_voxel)
                 if cfg.final_voxel and cfg.final_voxel > 0 else None)
-        m = pc.statistical_outlier_mask(
+        m = np.asarray(pc.statistical_outlier_mask(
             jnp.asarray(points), jnp.asarray(valid),
-            cfg.outlier_nb, cfg.outlier_std, voxelized_cell=cell)
-        if fused and isinstance(points, jax.Array):
-            # export boundary of the fused path: compact the kept rows ON
-            # DEVICE and transfer only them — the full padded stack is
-            # ~2x the survivors, and over a tunneled chip the difference
-            # is wall time (r5: outlier_s 0.815 in-merge vs 0.48 for the
-            # stage alone — the gap was this D2H)
-            keep_dev = jnp.asarray(m) & jnp.asarray(valid)
-            order, cnt_dev = _compact_order_counts_jit(keep_dev[None, :])
-            n_keep = int(np.asarray(cnt_dev)[0])
-            bucket = _bucket_pad(n_keep, points.shape[0])
-            p_c, _, c_c = _compact_gather_jit(
-                points[None], keep_dev[None, :],
-                jnp.asarray(colors)[None], order, bucket)
-            points = np.asarray(p_c[0, :n_keep])
-            colors = np.asarray(c_c[0, :n_keep])
-        else:
-            m = np.asarray(m)
-            keep = np.asarray(valid) & m
-            points = np.asarray(points)[keep]
-            colors = np.asarray(colors)[keep]
+            cfg.outlier_nb, cfg.outlier_std, voxelized_cell=cell))
+        # export boundary: the full-stack D2H below deliberately does NOT
+        # wait for the mask — on device inputs np.asarray(points) starts
+        # transferring while the mask chain (complement + stats) is still
+        # in flight, and the host fancy-index runs once both land. A
+        # device-side keep-compaction (sort + count sync + gather) was
+        # measured SLOWER in-merge (outlier_s 0.815 -> 0.94, r5): it
+        # serializes the transfer behind the mask and adds a round trip,
+        # losing more than the ~2.8 MB of padding it saves.
+        keep = np.asarray(valid) & m
+        points = np.asarray(points)[keep]
+        colors = np.asarray(colors)[keep]
         tm["outlier_s"] = round(_time.perf_counter() - t0, 3)
     return np.asarray(points), np.asarray(colors)
 

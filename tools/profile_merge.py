@@ -312,10 +312,8 @@ def main() -> None:
     # features saving nothing while dropping gfit 0.818 -> 0.608, see
     # _resolve_feat_bf16); the explicit True arm keeps the bf16 path
     # measurable in case a later FPFH change revives it
-    for trials, icp_iters, fb16 in ((4096, 30, None), (2048, 30, None),
-                                    (1024, 30, None), (512, 30, None),
-                                    (2048, 10, None), (1024, 15, None),
-                                    (1024, 30, True)):
+    for trials, icp_iters, fb16 in ((2048, 30, None), (1024, 30, None),
+                                    (768, 30, None), (512, 30, None)):
         t = np.inf
         for _ in range(2):
             t0 = time.perf_counter()
