@@ -180,7 +180,11 @@ def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
     if trace:
         print(f"[outlier-trace] +tree_build {_time.perf_counter()-t0:.3f}s",
               flush=True)
-    mean_d = np.array(md_dev)
+    # only the FINITENESS of each row crosses to the host (bool, 1/4 the
+    # bytes of the mean vector) — the means themselves stay on device and
+    # the complement patches in by scatter, avoiding the md D2H + H2D
+    # round trip the first r5 engine paid
+    bad = np.asarray(_uncertified_rows_jit(md_dev, valid))
     if trace:
         print(f"[outlier-trace] +engine_wait {_time.perf_counter()-t0:.3f}s",
               flush=True)
@@ -189,7 +193,6 @@ def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
     # Open3D's statistics include the huge mean distances of far outliers,
     # which inflate sigma, so censoring them as inf would systematically
     # tighten the threshold
-    bad = val_np & ~np.isfinite(mean_d)
     if bad.any():
         # exact complement on the HOST: uncertified rows (cloud boundary +
         # true outliers, typically a few % of the cloud) go through the
@@ -202,20 +205,38 @@ def _stat_outlier_voxelized(points, valid, nb_neighbors, std_ratio, cell):
         bad_idx = np.flatnonzero(bad)
         dsel = knnlib.kdtree_distances_rows(pts_np, val_np, bad_idx,
                                             nb_neighbors, tree_vi=tree_vi)
-        mean_d[bad] = dsel.mean(axis=1)
+        vals = dsel.mean(axis=1).astype(np.float32)
+        # pad to a bucket so the scatter executable caches across clouds
+        # (duplicate writes of the same value are harmless)
+        m = len(bad_idx)
+        pad = -(-max(m, 1) // 2048) * 2048 - m
+        if pad:
+            bad_idx = np.concatenate([bad_idx, np.full(pad, bad_idx[0])])
+            vals = np.concatenate([vals, np.full(pad, vals[0], np.float32)])
+        md_dev = _patch_rows_jit(md_dev, jnp.asarray(bad_idx),
+                                 jnp.asarray(vals))
     if trace:
         print(f"[outlier-trace] +complement({int(bad.sum())} rows) "
               f"{_time.perf_counter()-t0:.3f}s", flush=True)
     # returned DEVICE-backed (on accelerators): the fused merge boundary
-    # consumes the mask on device (keep-compaction) — materializing np
-    # here would add a mask D2H + re-upload round trip
-    out = _stat_outlier_from_knn(
-        jnp.asarray(mean_d), valid, jnp.float32(std_ratio), jnp)
+    # consumes the mask on device — materializing np here would add a
+    # mask D2H + re-upload round trip
+    out = _stat_outlier_from_knn(md_dev, valid, jnp.float32(std_ratio), jnp)
     if trace:
         out = jax.block_until_ready(out)
         print(f"[outlier-trace] +mask {_time.perf_counter()-t0:.3f}s",
               flush=True)
     return out
+
+
+@jax.jit
+def _uncertified_rows_jit(md, valid):
+    return valid & ~jnp.isfinite(md)
+
+
+@jax.jit
+def _patch_rows_jit(md, idx, vals):
+    return md.at[idx].set(vals)
 
 
 _SLAB_FAR = 3e9
