@@ -68,16 +68,28 @@ def reconstruct_mesh(points, valid=None, normals=None,
     else:
         res = _poisson_dispatch(pts, nr, v, cfg.depth, log,
                                 density_cap=cfg.density_cap)
-        verts, faces = surface_nets.extract_surface(
-            res.chi, float(res.iso), origin=np.asarray(res.origin),
-            cell=float(res.cell))
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            poisson_bricks,
+        )
+
+        if isinstance(res, poisson_bricks.BrickPoissonResult):
+            verts, faces = poisson_bricks.extract_surface_bricks(res)
+            # the density field for the low-support trim comes from the
+            # coarse base solve (the bricks never materialize a fine one)
+            dens_field, dens_res = res.coarse.density, res.coarse
+        else:
+            verts, faces = surface_nets.extract_surface(
+                res.chi, float(res.iso), origin=np.asarray(res.origin),
+                cell=float(res.cell))
+            dens_field, dens_res = res.density, res
         log(f"[mesh] surface nets: {len(verts):,} verts, {len(faces):,} faces")
 
         if cfg.density_trim_quantile and cfg.density_trim_quantile > 0:
             # low-support crop (processing.py:707-709): sample the splat
             # density at mesh vertices, drop the lowest quantile
-            coords = (jnp.asarray(verts) - res.origin) / res.cell
-            dens = np.asarray(trilinear_sample(res.density, coords))
+            coords = ((jnp.asarray(verts) - np.asarray(dens_res.origin))
+                      / float(dens_res.cell))
+            dens = np.asarray(trilinear_sample(dens_field, coords))
             thresh = np.quantile(dens, cfg.density_trim_quantile)
             verts, faces = meshproc.filter_faces_by_vertex_mask(
                 verts, faces, dens >= thresh)
@@ -118,11 +130,12 @@ def reconstruct_mesh(points, valid=None, normals=None,
 
 
 def _poisson_dispatch(pts, nr, v, depth: int, log, density_cap: bool = True):
-    """Dense single-chip Poisson up to depth 9; depth 10+ runs the
-    slab-sharded solver across the device mesh (the reference's octree
-    default is depth 10, server/gui.py:118 / processing.py:697-709). With
-    too few devices for the requested grid the depth is stepped down with a
-    warning rather than failing the pipeline. Depth policy:
+    """Dense single-chip Poisson up to depth 9; depth 10 runs the exact
+    slab-sharded solver when a multi-device accelerator mesh exists; depth
+    11..16 — and depth 10 without a mesh — run the brick-refined cascadic
+    solver (ops/poisson_bricks), whose cost scales with active bricks
+    (surface area), covering the reference's full octree envelope
+    (server/gui.py:118 / processing.py:697-709) on one chip. Depth policy:
     docs/ARCHITECTURE.md "Poisson depth policy"."""
     import jax
 
@@ -153,6 +166,7 @@ def _poisson_dispatch(pts, nr, v, depth: int, log, density_cap: bool = True):
         return res
 
     from structured_light_for_3d_model_replication_tpu.ops import (
+        poisson_bricks,
         poisson_sharded,
     )
 
@@ -160,15 +174,21 @@ def _poisson_dispatch(pts, nr, v, depth: int, log, density_cap: bool = True):
     # virtual CPU devices share one host's RAM — slabbing buys no memory
     # there, so only real accelerator meshes raise the ceiling
     accel = jax.default_backend() != "cpu"
-    if accel and n_dev >= 2 and (1 << depth) % n_dev == 0:
+    if depth == 10 and accel and n_dev >= 2 and (1 << depth) % n_dev == 0:
         res = poisson_sharded.poisson_solve_sharded(pts, nr, v, depth=depth)
         log(f"[mesh] poisson depth={depth} sharded over {n_dev} devices "
             f"iso={float(res.iso):.4f}")
         return res
-    log(f"[mesh] WARNING: depth {depth} needs a multi-device accelerator "
-        f"mesh (have {n_dev} {jax.default_backend()}); stepping down to "
-        f"depth 9 dense")
-    return poisson.poisson_solve(pts, nr, v, depth=9)
+    # depth 11..16 (and depth 10 without a device mesh): brick-refined
+    # solve — cost scales with active bricks (surface area), reaching
+    # the reference's octree depth envelope on ONE chip. The coarse base
+    # never needs more resolution than the density cap supports.
+    res = poisson_bricks.poisson_solve_bricks(
+        pts, nr, v, depth=depth, base_depth=min(9, cap, depth - 1),
+        log=log)
+    log(f"[mesh] poisson depth={depth} brick-refined "
+        f"({res.n_bricks} bricks) iso={res.iso:.4f}")
+    return res
 
 
 def mesh_to_stl(path: str, vertices, faces) -> None:

@@ -140,11 +140,20 @@ def _edge_axis(field, iso, axis: int):
     return a0 != a1, a0  # flip: inside -> outside vs outside -> inside
 
 
-def extract_surface(field, iso, origin=None, cell=1.0):
+def extract_surface(field, iso, origin=None, cell=1.0,
+                    face_cells: bool = False):
     """Extract the iso-surface triangle mesh of a [G,G,G] scalar field.
 
     Returns (vertices [V,3] f32 world coords, faces [F,3] i32). Watertight on
     closed iso-surfaces away from the grid boundary.
+
+    ``face_cells``: also return, per face, the (i,j,k) grid coords of the
+    face's OWNER cell (the minimal-corner cell of its generating edge),
+    and per VERTEX the (i,j,k) coords of its surface cell — the
+    provenance the brick-stitched extraction uses to emit each face from
+    exactly one brick and to key vertices canonically
+    (ops/poisson_bricks.extract_surface_bricks). Return becomes
+    (verts, faces, face_owner_cells [F,3] i32, vert_cells [V,3] i32).
     """
     field = jnp.asarray(field, jnp.float32)
     g = field.shape[0]
@@ -156,6 +165,9 @@ def extract_surface(field, iso, origin=None, cell=1.0):
         verts = np.zeros((0, 3), np.float32)
         if origin is not None:
             verts = verts * np.float32(cell) + np.asarray(origin, np.float32)
+        if face_cells:
+            z = np.zeros((0, 3), np.int32)
+            return verts, z, z, z
         return verts, np.zeros((0, 3), np.int32)
 
     cell_flat, vert_cells = _compact_cells(field, jnp.float32(iso),
@@ -166,6 +178,7 @@ def extract_surface(field, iso, origin=None, cell=1.0):
     verts = vert_cells + np.stack([ai, aj, ak], axis=1)
 
     faces = []
+    owners = []
     for axis in range(3):
         n_e = int(counts[1 + axis])
         if n_e == 0:
@@ -221,6 +234,12 @@ def extract_surface(field, iso, origin=None, cell=1.0):
                       np.stack([c00, c01, c11], 1))
         faces.append(t1)
         faces.append(t2)
+        if face_cells:
+            own = pos[quad_ok].copy()
+            own[:, o1] -= 1
+            own[:, o2] -= 1
+            owners.append(own)
+            owners.append(own)
 
     faces_np = (np.concatenate(faces).astype(np.int32) if faces
                 else np.zeros((0, 3), np.int32))
@@ -228,4 +247,9 @@ def extract_surface(field, iso, origin=None, cell=1.0):
     if origin is not None:
         verts_world = verts_world * np.float32(cell) + np.asarray(origin,
                                                                   np.float32)
+    if face_cells:
+        own_np = (np.concatenate(owners).astype(np.int32) if owners
+                  else np.zeros((0, 3), np.int32))
+        vcell_np = np.stack([ai, aj, ak], axis=1).astype(np.int32)
+        return verts_world, faces_np, own_np, vcell_np
     return verts_world, faces_np
