@@ -110,9 +110,11 @@ class MeshConfig:
 
     mode: str = "watertight"     # 'watertight' (Poisson) | 'surface' (ball-pivot analog)
     # Poisson grid = 2^depth per axis; matches the reference default
-    # (server/gui.py:118). <=9 solves dense on one chip; 10+ dispatches to
-    # the slab-sharded multi-device solver (steps down to 9 with a warning
-    # when only one device is present)
+    # (server/gui.py:118), full envelope <= 16 as in the reference's
+    # guard. <=9 solves dense on one chip; 10 runs the exact slab-sharded
+    # solver on a multi-device mesh; 11..16 (and 10 without a mesh) run
+    # the brick-refined cascadic solver (ops/poisson_bricks — cost scales
+    # with surface bricks, single chip suffices)
     depth: int = 10
     # clamp depth to ~log2(sqrt(N))+1 (a denser grid than the sampling
     # density is pure cost on a DENSE grid — unlike the reference's octree,
