@@ -112,9 +112,10 @@ class MeshConfig:
     # Poisson grid = 2^depth per axis; matches the reference default
     # (server/gui.py:118), full envelope <= 16 as in the reference's
     # guard. <=9 solves dense on one chip; 10 runs the exact slab-sharded
-    # solver on a multi-device mesh; 11..16 (and 10 without a mesh) run
-    # the brick-refined cascadic solver (ops/poisson_bricks — cost scales
-    # with surface bricks, single chip suffices)
+    # solver on a multi-device mesh, the brick-refined solver on a single
+    # accelerator, and steps down to 9 on CPU unless density_cap=false
+    # forces it; 11..16 runs the brick-refined cascadic solver
+    # (ops/poisson_bricks — cost scales with surface bricks)
     depth: int = 10
     # clamp depth to ~log2(sqrt(N))+1 (a denser grid than the sampling
     # density is pure cost on a DENSE grid — unlike the reference's octree,

@@ -131,12 +131,14 @@ def reconstruct_mesh(points, valid=None, normals=None,
 
 def _poisson_dispatch(pts, nr, v, depth: int, log, density_cap: bool = True):
     """Dense single-chip Poisson up to depth 9; depth 10 runs the exact
-    slab-sharded solver when a multi-device accelerator mesh exists; depth
-    11..16 — and depth 10 without a mesh — run the brick-refined cascadic
-    solver (ops/poisson_bricks), whose cost scales with active bricks
+    slab-sharded solver on a multi-device accelerator mesh, the
+    brick-refined solver on a single accelerator, and steps down to dense
+    depth 9 on the CPU backend unless mesh.density_cap=false forces it;
+    depth 11..16 runs the brick-refined cascadic solver
+    (ops/poisson_bricks) on any backend — cost scales with active bricks
     (surface area), covering the reference's full octree envelope
-    (server/gui.py:118 / processing.py:697-709) on one chip. Depth policy:
-    docs/ARCHITECTURE.md "Poisson depth policy"."""
+    (server/gui.py:118 / processing.py:697-709) on one chip. Depth
+    policy: docs/ARCHITECTURE.md "Poisson depth policy"."""
     import jax
 
     # cap resolution by sampling density: a surface of N samples occupies
@@ -160,6 +162,19 @@ def _poisson_dispatch(pts, nr, v, depth: int, log, density_cap: bool = True):
                 f"{n} points (a {1 << depth}^3 dense grid; cap would have "
                 f"chosen {cap})")
 
+    accel = jax.default_backend() != "cpu"
+    if depth == 10 and not accel and density_cap:
+        # degraded mode: brick refinement on a host CPU costs minutes ON
+        # TOP of the depth-9 dense base, so the default steps down; the
+        # same mesh.density_cap=false knob that forces depth elsewhere
+        # forces the full brick solve here too (depth 11+ has no cheaper
+        # alternative and always runs bricks)
+        log(f"[mesh] WARNING: depth 10 on the CPU backend steps down to "
+            f"depth 9 dense (exact depth 10 needs an accelerator; set "
+            f"mesh.density_cap=false to force the brick-refined depth-10 "
+            f"solve here)")
+        depth = 9
+
     if depth <= 9:
         res = poisson.poisson_solve(pts, nr, v, depth=depth)
         log(f"[mesh] poisson depth={depth} iso={float(res.iso):.4f}")
@@ -173,16 +188,16 @@ def _poisson_dispatch(pts, nr, v, depth: int, log, density_cap: bool = True):
     n_dev = len(jax.devices())
     # virtual CPU devices share one host's RAM — slabbing buys no memory
     # there, so only real accelerator meshes raise the ceiling
-    accel = jax.default_backend() != "cpu"
     if depth == 10 and accel and n_dev >= 2 and (1 << depth) % n_dev == 0:
         res = poisson_sharded.poisson_solve_sharded(pts, nr, v, depth=depth)
         log(f"[mesh] poisson depth={depth} sharded over {n_dev} devices "
             f"iso={float(res.iso):.4f}")
         return res
-    # depth 11..16 (and depth 10 without a device mesh): brick-refined
-    # solve — cost scales with active bricks (surface area), reaching
-    # the reference's octree depth envelope on ONE chip. The coarse base
-    # never needs more resolution than the density cap supports.
+    # depth 11..16 (single-accelerator depth 10; CPU depth 10 only when
+    # forced): brick-refined solve — cost scales with active bricks
+    # (surface area), reaching the reference's octree depth envelope on
+    # ONE chip. The coarse base never needs more resolution than the
+    # density cap supports.
     res = poisson_bricks.poisson_solve_bricks(
         pts, nr, v, depth=depth, base_depth=min(9, cap, depth - 1),
         log=log)

@@ -191,26 +191,21 @@ def test_poisson_depth_cap_leaves_flagship_scale_alone(monkeypatch):
     # via the device-count branch, not the density cap)
     seen = {}
 
-    from structured_light_for_3d_model_replication_tpu.ops import (
-        poisson_bricks,
-    )
-
-    def fake_bricks(pts, nr, v, depth, base_depth=9, log=None, **kw):
+    def fake_solve(pts, nr, v, depth):
         seen["depth"] = depth
-        seen["base"] = base_depth
 
         class R:
             iso = 0.0
-            n_bricks = 1
         return R()
 
-    monkeypatch.setattr(poisson_bricks, "poisson_solve_bricks", fake_bricks)
+    monkeypatch.setattr(meshing.poisson, "poisson_solve", fake_solve)
     n = 171_330
     pts = np.zeros((n, 3), np.float32)
     logs = []
     meshing._poisson_dispatch(pts, pts, np.ones(n, bool), depth=10,
                               log=logs.append)
     assert not any("cannot fill" in m for m in logs), logs
-    # 1 CPU device: depth 10 now routes to the brick-refined solver at
-    # the FULL requested depth (the old behavior stepped down to dense 9)
-    assert seen["depth"] == 10 and seen["base"] == 9
+    # CPU backend keeps the cheap depth-9 step-down at depth 10 (degraded
+    # mode must not pay brick refinement on a host); depth 11+ and
+    # single-accelerator depth 10 route to bricks instead
+    assert seen["depth"] == 9
