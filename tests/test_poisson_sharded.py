@@ -143,7 +143,10 @@ def test_depth10_default_steps_down_on_cpu(rng, monkeypatch):
 
     def fake_solve(pts, nr, v, depth):
         seen["depth"] = depth
-        return "sentinel"
+
+        class R:
+            iso = 0.125
+        return R()
 
     monkeypatch.setattr(meshing.poisson, "poisson_solve", fake_solve)
     # >65,536 valid points so the density cap (~log2(sqrt(N))+1 >= 10)
@@ -154,5 +157,5 @@ def test_depth10_default_steps_down_on_cpu(rng, monkeypatch):
     res = meshing._poisson_dispatch(pts, nrm, np.ones(len(pts), bool),
                                     depth=10, log=logs.append)
     assert not any("cannot fill" in m for m in logs)  # cap stayed out
-    assert any("stepping down" in m for m in logs)
-    assert seen["depth"] == 9 and res == "sentinel"
+    assert any("steps down" in m for m in logs)
+    assert seen["depth"] == 9 and float(res.iso) == 0.125
