@@ -357,8 +357,6 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
                 pass
         log(f"child: {msg}")
 
-    # 2048 trials: measured equal fitness to the 4096 library default on
-    # this scene (well-overlapped 15-degree pairs) at half the scoring cost
     from structured_light_for_3d_model_replication_tpu.config import MergeConfig
 
     if backend == "cpu":
@@ -370,12 +368,15 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
         # and its outputs match the 2048-trial config exactly.
         mcfg = MergeConfig(ransac_trials=2048)
     else:
-        # 1024 trials measured the same global fitness as 4096 ON-CHIP
-        # (r3 optimization session: register steady 0.43 s @1024 vs
-        # 0.96 s @4096, fitness 0.870 vs 0.861) — the bench scene's
-        # 15-degree pairs are feature-rich; the library default stays
-        # 4096 for robustness headroom (ADVICE r3)
-        mcfg = MergeConfig(ransac_trials=1024)
+        # trial count is quality-flat on this scene across 512..4096 on
+        # BOTH backends (r5 sweeps: on-chip gfit 0.82-0.89 / ifit
+        # 0.93-0.94 unordered in trials; CPU ifit 0.916-0.942 likewise;
+        # r3 first established 1024 == 4096) — the bench scene's
+        # 15-degree pairs are feature-rich. 512 is the fastest MEASURED
+        # on-chip arm (register 0.284 s vs 0.359 @1024) and divides the
+        # scorer's chunk sizes; the library default stays 4096 for
+        # robustness headroom (ADVICE r3). Per-line fitness is the gate.
+        mcfg = MergeConfig(ransac_trials=512)
     res["merge_ransac_trials"] = mcfg.ransac_trials
     res["merge_icp_iters"] = mcfg.icp_iters
 

@@ -551,7 +551,13 @@ def _ransac_core(src, src_valid, dst, dst_valid, corr_j, corr_ok, max_dist,
 
     t_chunk = max(1, min(trials, (8 << 20) // max(ns, 1)))
     if trials % t_chunk:
-        t_chunk = trials  # static shapes: fall back to one chunk
+        # static shapes want equal chunks: instead of one giant chunk
+        # (which defeats the 8M-element [T,N] bound for any trial count
+        # the chunk size does not divide), shrink to the largest divisor
+        # of `trials` within the bound — worst case 1, which is just a
+        # longer lax.map, never a bigger intermediate
+        t_chunk = next(c for c in range(t_chunk, 0, -1)
+                       if trials % c == 0)
     counts = jax.lax.map(
         score_chunk,
         (R9.reshape(-1, t_chunk, 9), tt.reshape(-1, t_chunk, 3),

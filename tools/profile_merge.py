@@ -42,8 +42,8 @@ def main() -> None:
                          "the r5 on-chip register_s wait")
     ap.add_argument("--runs", type=int, default=3)
     ap.add_argument("--trials", type=int, default=2048,
-                    help="ransac_trials for the merge runs (bench uses 2048; "
-                         "the library default is 4096)")
+                    help="ransac_trials for the merge runs (bench: 512 "
+                         "on-chip / 2048 CPU; library default 4096)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the cpu platform (smoke/debug; the env var "
                          "alone loses to this box's sitecustomize)")

@@ -75,7 +75,7 @@ def _bench_cloud(cell: float):
     clouds = [(z["merge_pts"][off[i]:off[i + 1]],
                z["merge_cols"][off[i]:off[i + 1]])
               for i in range(len(off) - 1)]
-    mcfg = MergeConfig(ransac_trials=1024)
+    mcfg = MergeConfig(ransac_trials=512)  # mirror the bench's on-chip config
     pre = rec._preprocess_views(clouds, float(mcfg.voxel_size), 0)
     T_all, *_ = rec._register_chain_batched(pre, mcfg,
                                             float(mcfg.voxel_size),
