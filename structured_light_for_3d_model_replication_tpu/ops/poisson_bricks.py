@@ -31,6 +31,7 @@ depth <= 9) and is the only reachable path for depth >= 11.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import NamedTuple
 
@@ -285,6 +286,21 @@ def extract_surface_bricks(res: BrickPoissonResult):
     neighbors (no refined field to agree with — the surface rarely runs
     there, and meshproc.fill_holes closes stragglers).
     Returns (verts [V,3] f32 world, faces [F,3] i32)."""
+    # the per-brick surface-nets calls run small jitted kernels on HOST
+    # numpy fields: pin them to the CPU device — on a tunneled
+    # accelerator, thousands of per-brick upload/count/download round
+    # trips would otherwise dominate the whole extraction
+    try:
+        cpu_dev = jax.local_devices(backend="cpu")[0]
+    except Exception:  # no CPU platform registered: use the default
+        cpu_dev = None
+    ctx = (jax.default_device(cpu_dev) if cpu_dev is not None
+           else contextlib.nullcontext())
+    with ctx:
+        return _extract_stitched(res)
+
+
+def _extract_stitched(res: BrickPoissonResult):
     h, b = res.halo, res.brick
     bids = (res.brick_lo + h) // b                    # [B,3] brick grid ids
     idx_of = {tuple(k): i for i, k in enumerate(bids)}

@@ -550,18 +550,21 @@ def _ransac_core(src, src_valid, dst, dst_valid, corr_j, corr_ok, max_dist,
         return inl.sum(-1)
 
     t_chunk = max(1, min(trials, (8 << 20) // max(ns, 1)))
-    if trials % t_chunk:
-        # static shapes want equal chunks: instead of one giant chunk
-        # (which defeats the 8M-element [T,N] bound for any trial count
-        # the chunk size does not divide), shrink to the largest divisor
-        # of `trials` within the bound — worst case 1, which is just a
-        # longer lax.map, never a bigger intermediate
-        t_chunk = next(c for c in range(t_chunk, 0, -1)
-                       if trials % c == 0)
+    pad = (-trials) % t_chunk
+    if pad:
+        # static shapes want equal chunks: pad the hypothesis set to the
+        # next chunk multiple (padded rows score garbage that the slice
+        # below discards) — the 8M-element [T,N] bound holds for ANY
+        # trial count, with no giant-chunk or serialized fallback
+        R9 = jnp.concatenate([R9, jnp.zeros((pad, 9), R9.dtype)])
+        tt = jnp.concatenate([tt, jnp.zeros((pad, 3), tt.dtype)])
+        t2 = jnp.concatenate([t2, jnp.zeros((pad,), t2.dtype)])
+        Rt = jnp.concatenate([Rt, jnp.zeros((pad, 3), Rt.dtype)])
     counts = jax.lax.map(
         score_chunk,
         (R9.reshape(-1, t_chunk, 9), tt.reshape(-1, t_chunk, 3),
-         t2.reshape(-1, t_chunk), Rt.reshape(-1, t_chunk, 3))).reshape(-1)
+         t2.reshape(-1, t_chunk), Rt.reshape(-1, t_chunk, 3))
+    ).reshape(-1)[:trials]
     scores = jnp.where(edge_pass & dist_pass, counts, -1)
     best = jnp.argmax(scores)
     moved_b = transform_points(T[best], src)
