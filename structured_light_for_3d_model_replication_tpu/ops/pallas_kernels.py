@@ -36,13 +36,23 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["use_pallas", "pallas_mode", "nn1", "radius_count_pallas",
-           "decode_maps_fused", "scan_points_fused_views"]
+           "decode_maps_fused", "scan_points_fused_views",
+           "slab_mean_knn", "slab_bisect_ok"]
 
 _FAR = 1e9
 
 _PALLAS_MODE: str | None = None  # "compiled" | "interpret" (probe result, cached)
 _VIEWS_KERNEL_OK = True          # view-batched decode lowering probe result
 _SCAN_FUSED_OK = True            # fused decode+triangulate lowering probe result
+_SLAB_BISECT_OK = True           # slab bisection kernel probe result
+
+
+def slab_bisect_ok() -> bool:
+    """True when the COMPILED slab bisection kernel passed its capability
+    probe — i.e. False in interpret mode (the auto selector then keeps
+    the topk engine; the CPU parity tests exercise the bisect arm
+    explicitly via interpret)."""
+    return use_pallas() and _SLAB_BISECT_OK
 
 
 def scan_fused_ok() -> bool:
@@ -115,6 +125,42 @@ def _probe_compiled() -> bool:
         _SCAN_FUSED_OK = pts.shape == (2, 8 * 256, 3)
     except Exception:
         _SCAN_FUSED_OK = False
+
+    # slab bisection kernel (the outlier engine's selector where Mosaic
+    # compiles): COMPILED run on a tiny sorted line, checked numerically
+    # against brute force — a lowering/rounding surprise demotes only the
+    # bisect selector (topk engine remains), never the other kernels
+    global _SLAB_BISECT_OK
+    try:
+        rngp = np.random.default_rng(0)
+        line = np.sort(rngp.uniform(0, 50, 512)).astype(np.float32)
+        pts3 = np.stack([line, rngp.uniform(0, 1, 512).astype(np.float32),
+                         np.zeros(512, np.float32)], axis=1)
+        md, cnt, _ = slab_mean_knn(jnp.asarray(pts3), 4.0, 4, tile=8,
+                                   wblk=256, interpret=False)
+        md = np.asarray(md)
+        cnt = np.asarray(cnt)
+        d = np.linalg.norm(pts3[None] - pts3[:, None], axis=-1)
+        np.fill_diagonal(d, np.inf)
+        ref = np.sort(d, axis=1)[:, :4].mean(axis=1)
+        fin = np.isfinite(md) & (cnt >= 4)
+        _SLAB_BISECT_OK = bool(fin.sum() > 50 and np.allclose(
+            md[fin], ref[fin], rtol=1e-4))
+        if _SLAB_BISECT_OK:
+            # ALSO compile (no data, no execution) at the PRODUCTION
+            # geometry (tile 64, wblk 8192): a shape-dependent Mosaic
+            # failure — e.g. VMEM exhaustion on the [64, 8192] d2 blocks
+            # — must demote the selector here, not crash the first merge
+            # ("probe passes, flagship crashes", the round-2 lesson)
+            L = 2 * 8192
+            _slab_bisect_call.lower(
+                jax.ShapeDtypeStruct((L, 8), jnp.float32),
+                jax.ShapeDtypeStruct((2, 8, 8192), jnp.float32),
+                jax.ShapeDtypeStruct((L // 64,), jnp.int32),
+                20, int(np.float32(4.0).view(np.int32)), 64, 8192,
+                False).compile()
+    except Exception:
+        _SLAB_BISECT_OK = False
     return True
 
 

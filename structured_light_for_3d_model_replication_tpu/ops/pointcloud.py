@@ -291,7 +291,7 @@ def _voxelized_knn_mean_dist(points, valid, cell, k: int,
             pallas_kernels as pk,
         )
 
-        if pk.use_pallas() and tile is None and window is None:
+        if pk.slab_bisect_ok() and tile is None and window is None:
             selector, tile, window = "bisect", 64, 8192
         else:
             selector = "topk"
