@@ -179,6 +179,18 @@ class ParallelConfig:
     # shard and only the small host-side pose-graph solve stays local)
     # whenever >1 device is attached; single-device hosts are unaffected
     merge_mesh: bool = False
+    # host I/O thread pool shared by the batch-reconstruct pipeline (frame
+    # decode, per-view PLY reads in merge_views). <=1 runs every stage
+    # serially — the pre-pipeline behavior, and the A/B arm the bench
+    # compares against. Env override: SL3D_IO_WORKERS.
+    io_workers: int = field(
+        default_factory=lambda: int(os.environ.get("SL3D_IO_WORKERS", "4")))
+    # how many view frame-stacks the batch-reconstruct prefetcher may hold
+    # in flight ahead of the compute stage (backpressure bound: memory cost
+    # is prefetch_depth x one stack, ~95 MB each at 46x1080p). Env
+    # override: SL3D_PREFETCH_DEPTH.
+    prefetch_depth: int = field(
+        default_factory=lambda: int(os.environ.get("SL3D_PREFETCH_DEPTH", "2")))
 
 
 @dataclass

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import glob
 import os
+import threading
 
 import numpy as np
 
@@ -18,6 +19,29 @@ __all__ = ["list_frame_files", "load_stack", "save_stack", "load_gray",
            "load_color", "save_image"]
 
 _EXTS = (".bmp", ".png", ".jpg", ".jpeg", ".ppm", ".pgm")
+
+# one shared decode pool for the whole process: per-call executors cost
+# ~ms of thread spin-up — more than a small frame decodes in — and a shared
+# pool also caps TOTAL imread concurrency when the batch pipeline prefetches
+# several stacks at once. Grown (never shrunk) to the largest request.
+_POOL: "object | None" = None
+_POOL_SIZE = 0
+_POOL_LOCK = threading.Lock()
+
+
+def _imread_pool(workers: int):
+    from concurrent.futures import ThreadPoolExecutor
+
+    global _POOL, _POOL_SIZE
+    with _POOL_LOCK:
+        if _POOL is None or _POOL_SIZE < workers:
+            old = _POOL
+            _POOL = ThreadPoolExecutor(max_workers=workers,
+                                       thread_name_prefix="sl3d-imread")
+            _POOL_SIZE = workers
+            if old is not None:
+                old.shutdown(wait=False)
+        return _POOL
 
 
 def _imread(path: str, gray: bool):
@@ -82,11 +106,18 @@ def list_frame_files(source) -> list[str]:
     raise FileNotFoundError(f"no frames ({'/'.join(_EXTS)}) in {source}")
 
 
-def load_stack(source, expected: int | None = None):
+def load_stack(source, expected: int | None = None,
+               io_workers: int | None = None):
     """Load a capture folder/list -> (frames uint8 [F,H,W], texture uint8 [H,W,3]).
 
     The texture is the white frame (frame 0) in color, per the reference's use
     of files[0] as the point-cloud color source (processing.py:124).
+
+    ``io_workers``: per-frame decodes run on a bounded thread pool when > 1
+    (cv2/PIL release the GIL inside the codec, so decodes genuinely overlap);
+    None or <= 1 keeps the serial loop. Identical arrays either way — the
+    pool only reorders WHEN each frame decodes, every frame still lands in
+    its own preallocated slot.
     """
     from structured_light_for_3d_model_replication_tpu.io import native
 
@@ -109,11 +140,21 @@ def load_stack(source, expected: int | None = None):
         first = load_gray(files[0])
         frames = np.empty((len(files),) + first.shape, np.uint8)
         frames[0] = first
-        for i, p in enumerate(files[1:], start=1):
+
+        def _load_into(i: int, p: str) -> None:
             img = load_gray(p)
             if img.shape != first.shape:
                 raise ValueError(f"{p}: frame size {img.shape} != {first.shape}")
             frames[i] = img
+
+        rest = list(enumerate(files[1:], start=1))
+        if io_workers and io_workers > 1 and len(rest) > 1:
+            # list() drains the map so the first decode error re-raises
+            # here with its original traceback, like the serial loop
+            list(_imread_pool(io_workers).map(lambda a: _load_into(*a), rest))
+        else:
+            for i, p in rest:
+                _load_into(i, p)
     texture = load_color(files[0])
     return frames, texture
 

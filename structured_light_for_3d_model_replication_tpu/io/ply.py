@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["write_ply", "read_ply", "write_mesh_ply"]
+__all__ = ["write_ply", "read_ply", "write_mesh_ply", "WritebackQueue"]
 
 _PLY_DTYPES = {
     "float": "<f4", "float32": "<f4", "double": "<f8", "float64": "<f8",
@@ -88,6 +88,71 @@ def write_ply(path: str, points: np.ndarray, colors: np.ndarray | None = None,
             f.write("\n".join(lines))
             if lines:
                 f.write("\n")
+
+
+class WritebackQueue:
+    """Background PLY writeback: the handoff that takes artifact writes off
+    the critical path of a pipelined producer.
+
+    One writer thread (disk writes of one artifact stream don't benefit from
+    concurrency, and a single worker preserves submission order on disk, so a
+    crash leaves a clean prefix of the batch). ``submit`` returns a
+    ``Future`` the caller holds until its drain point; the future carries the
+    written path on success and re-raises the write error on failure — the
+    producer maps it back to its per-item failure accounting. Bytes are
+    identical to a direct ``write_ply`` call: same writer, same arrays.
+    """
+
+    def __init__(self, on_write=None):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="sl3d-plywrite")
+        self._pending: list = []
+        # optional (path, elapsed_s) hook, called in the writer thread after
+        # each successful write — the pipeline's write-wall gauge
+        self._on_write = on_write
+
+    def submit(self, path: str, points: np.ndarray,
+               colors: np.ndarray | None = None,
+               normals: np.ndarray | None = None, binary: bool = True):
+        """Enqueue one cloud write; returns a Future resolving to ``path``."""
+
+        def _write() -> str:
+            import time
+
+            t0 = time.perf_counter()
+            write_ply(path, points, colors, normals, binary=binary)
+            if self._on_write is not None:
+                self._on_write(path, time.perf_counter() - t0)
+            return path
+
+        fut = self._pool.submit(_write)
+        self._pending.append(fut)
+        return fut
+
+    @property
+    def backlog(self) -> int:
+        """Writes submitted but not yet finished (the queue-depth gauge)."""
+        return sum(1 for f in self._pending if not f.done())
+
+    def drain(self) -> list[str]:
+        """Block until every submitted write finished; returns written paths.
+        The first write error re-raises here (callers holding per-item
+        futures instead call ``.result()`` on those and never need drain)."""
+        out = [f.result() for f in self._pending]
+        self._pending.clear()
+        return out
+
+    def close(self, wait: bool = True) -> None:
+        self._pool.shutdown(wait=wait, cancel_futures=not wait)
+
+    def __enter__(self) -> "WritebackQueue":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # on error, don't block shutdown on a backlog nobody will consume
+        self.close(wait=exc_type is None)
 
 
 def write_mesh_ply(path: str, vertices: np.ndarray, faces: np.ndarray,

@@ -162,6 +162,21 @@ class SLScanner:
             return CloudResult(out.points[0], out.colors[0], out.valid[0])
         return self._fwd(frames, jnp.float32(s), jnp.float32(c))
 
+    def forward_async(self, frames, thresh_mode: str = "otsu",
+                      shadow_val: float = 40.0,
+                      contrast_val: float = 10.0) -> CloudResult:
+        """Non-blocking ``forward``: enqueue the host->device transfer and the
+        fused program and return immediately with in-flight device arrays
+        (JAX async dispatch — no host sync anywhere on this path). The caller
+        overlaps the NEXT view's disk load/decode with this view's transfer+
+        compute and pays the sync only at its drain point
+        (``jax.block_until_ready`` / ``np.asarray``), which is how the
+        pipelined batch executor keeps the device busy between views.
+        Numerically identical to ``forward``: same program, same inputs —
+        only the moment the host waits moves."""
+        return self.forward(jax.device_put(frames), thresh_mode=thresh_mode,
+                            shadow_val=shadow_val, contrast_val=contrast_val)
+
     def forward_views(self, frames_v, thresh_mode: str = "otsu",
                       shadow_val: float = 40.0, contrast_val: float = 10.0,
                       use_fused: bool | None = None) -> CloudResult:

@@ -88,6 +88,13 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
                    default="single")
     p.add_argument("--output", default=None,
                    help="output .ply (single) or output directory (batch/files)")
+    p.add_argument("--io-workers", type=int, default=None,
+                   help="host I/O threads for the pipelined batch executor "
+                        "(frame prefetch + PLY writeback; <=1 forces the "
+                        "serial loop; default: parallel.io_workers)")
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   help="frame stacks the prefetcher may hold ahead of "
+                        "compute (default: parallel.prefetch_depth)")
     add_config_args(p)
 
     p = sub.add_parser("clean", help="point-cloud cleanup chain on one PLY")
@@ -270,8 +277,18 @@ def run(args: argparse.Namespace) -> int:
 def _cmd_reconstruct(args) -> int:
     from structured_light_for_3d_model_replication_tpu.pipeline import stages
 
+    cfg = _cfg(args)
+    if args.io_workers is not None:
+        cfg.parallel.io_workers = args.io_workers
+    if args.prefetch_depth is not None:
+        cfg.parallel.prefetch_depth = args.prefetch_depth
     report = stages.reconstruct(args.calib, args.target, mode=args.mode,
-                                output=args.output, cfg=_cfg(args))
+                                output=args.output, cfg=cfg)
+    if report.overlap:
+        o = report.overlap
+        print(f"[reconstruct] pipeline overlap: load {o['load_s']}s + "
+              f"compute {o['compute_s']}s + write {o['write_s']}s in "
+              f"{o['critical_path_s']}s wall (x{o['overlap_ratio']})")
     return 0 if report.outputs and not report.failed else (2 if report.outputs else 1)
 
 

@@ -37,11 +37,18 @@ _DEG_RE = re.compile(r"(\d+(?:\.\d+)?)\s*deg", re.IGNORECASE)
 
 @dataclass
 class BatchReport:
-    """Per-item success/failure accounting (processing.py:314-334 semantics)."""
+    """Per-item success/failure accounting (processing.py:314-334 semantics).
+
+    ``overlap``: filled by the pipelined batch executor with the
+    load/compute/write overlap accounting (``OverlapStats.as_dict()``);
+    None on the serial path. Not part of the per-item contract — outputs,
+    failed, and the summary counts are identical across executors.
+    """
 
     outputs: list[str] = field(default_factory=list)
     failed: list[tuple[str, str]] = field(default_factory=list)
     elapsed_s: float = 0.0
+    overlap: dict | None = None
 
     @property
     def summary(self) -> str:
@@ -60,9 +67,11 @@ def sort_ply_paths_by_angle(paths: list[str]) -> list[str]:
     return sorted(paths, key=key)
 
 
-def _scan_sources(target: str, mode: str, need: int) -> list[str]:
+def _scan_sources(target: str, mode: str, need: int, log=None) -> list[str]:
     """Resolve `target` to a list of scan-folder sources per the reference's
-    single/batch/files modes (processing.py:300-322)."""
+    single/batch/files modes (processing.py:300-322). Batch mode logs every
+    folder it skips (too few frames / no frames) so a partial capture is
+    diagnosable instead of silently shrinking the batch."""
     if mode == "single":
         return [target]
     if mode == "batch":
@@ -73,26 +82,36 @@ def _scan_sources(target: str, mode: str, need: int) -> list[str]:
         out = []
         for s in subs:
             try:
-                if len(imio.list_frame_files(s)) >= need:
-                    out.append(s)
+                n = len(imio.list_frame_files(s))
             except (FileNotFoundError, NotADirectoryError):
+                if log is not None:
+                    log(f"[reconstruct] skipping {s}: no frame images found")
                 continue
+            if n >= need:
+                out.append(s)
+            elif log is not None:
+                log(f"[reconstruct] skipping {s}: {n} frames < {need} "
+                    f"required (partial capture?)")
         return out
     if mode == "files":
         return [p.strip() for p in target.split(",") if p.strip()]
     raise ValueError(f"unknown reconstruct mode {mode!r} (single|batch|files)")
 
 
-def reconstruct_source(source, calib: dict, cfg: Config, scanner=None):
-    """One scan source (folder or file list) -> (points, colors) compact arrays.
+def _compute_cloud(frames, texture, calib: dict, cfg: Config, scanner=None,
+                   async_dispatch: bool = False):
+    """Decode + triangulate one loaded stack -> CloudResult (possibly lazy).
 
-    Backend-switched: ``cfg.parallel.backend == 'numpy'`` runs the bit-exact
-    CPU path; ``'jax'`` runs the fused TPU program (SLScanner when provided,
-    else the module-level jit kernels).
+    Backend-switched exactly like ``reconstruct_source``; the split from the
+    disk load is what lets the pipelined executor prefetch stacks on a
+    thread pool while this runs. ``async_dispatch`` routes the scanner path
+    through ``forward_async`` (explicit device_put, no host sync) — same
+    program, same numbers, only the wait point moves to the caller's drain.
+    On the jax paths the returned CloudResult is in flight either way (JAX
+    async dispatch); the sync happens at ``tri.compact_cloud``.
     """
     dcfg, tcfg = cfg.decode, cfg.triangulate
     ds = cfg.projector.downsample  # must match the capture-time D_SAMPLE_PROJ
-    frames, texture = imio.load_stack(source)
     if cfg.parallel.backend == "numpy":
         dec = gc.decode_stack_np(
             frames, texture, n_cols=dcfg.n_cols, n_rows=dcfg.n_rows,
@@ -108,9 +127,10 @@ def reconstruct_source(source, calib: dict, cfg: Config, scanner=None):
     elif scanner is not None and not tcfg.bitexact:
         # a bitexact config must take the branch below (device decode +
         # host-NumPy triangulation) no matter what the caller passed
-        cloud = scanner.forward(frames, thresh_mode=dcfg.thresh_mode,
-                                shadow_val=dcfg.shadow_val,
-                                contrast_val=dcfg.contrast_val)
+        fwd = scanner.forward_async if async_dispatch else scanner.forward
+        cloud = fwd(frames, thresh_mode=dcfg.thresh_mode,
+                    shadow_val=dcfg.shadow_val,
+                    contrast_val=dcfg.contrast_val)
     else:
         import jax.numpy as jnp
 
@@ -126,7 +146,192 @@ def reconstruct_source(source, calib: dict, cfg: Config, scanner=None):
             row_mode=tcfg.row_mode, epipolar_tol=tcfg.epipolar_tol,
             plane_eval=tcfg.plane_eval, bitexact=tcfg.bitexact,
         )
-    return tri.compact_cloud(cloud)
+    return cloud
+
+
+def reconstruct_source(source, calib: dict, cfg: Config, scanner=None):
+    """One scan source (folder or file list) -> (points, colors) compact arrays.
+
+    Backend-switched: ``cfg.parallel.backend == 'numpy'`` runs the bit-exact
+    CPU path; ``'jax'`` runs the fused TPU program (SLScanner when provided,
+    else the module-level jit kernels).
+    """
+    frames, texture = imio.load_stack(source,
+                                      io_workers=cfg.parallel.io_workers)
+    return tri.compact_cloud(_compute_cloud(frames, texture, calib, cfg,
+                                            scanner))
+
+
+def _item_name(src) -> str:
+    return os.path.basename(os.path.normpath(src)) or "cloud"
+
+
+def _out_path_for(src, mode: str, output: str | None) -> str:
+    """Output-path contract shared by both executors (identical artifacts)."""
+    if mode == "single" and output:
+        return output
+    if output:
+        return os.path.join(output, f"{_item_name(src)}.ply")
+    return os.path.normpath(src) + ".ply"
+
+
+def _reconstruct_serial(sources, calib, cfg, scanner, mode, output, report,
+                        log) -> None:
+    """The reference-shaped per-view loop: load, compute, write, one view at
+    a time. Kept as the ``parallel.io_workers <= 1`` arm and the semantics
+    twin the pipelined executor is verified against."""
+    timer = prof.StageTimer()
+    for src in sources:
+        name = _item_name(src)
+        try:
+            with timer.stage(name), prof.trace():
+                pts, cols = reconstruct_source(src, calib, cfg, scanner)
+            out_path = _out_path_for(src, mode, output)
+            ply.write_ply(out_path, pts, cols)
+            log(f"[reconstruct] {name}: {len(pts):,} points -> {out_path}")
+            report.outputs.append(out_path)
+        except Exception as e:  # per-item tolerance (processing.py:323-330)
+            from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (
+                is_backend_init_error,
+            )
+
+            if is_backend_init_error(e):
+                # process-level condition, not an item failure: propagate
+                # so the CLI's CPU-fallback retry can handle it (otherwise
+                # every item "fails" identically and no retry fires)
+                raise
+            log(f"[reconstruct] {name} FAILED: {e}")
+            report.failed.append((src, str(e)))
+    prof.get_logger().debug("reconstruct stage timing:\n%s", timer.report())
+
+
+def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
+                           log) -> None:
+    """Pipelined batch executor: three overlapped stages per view.
+
+      load     — frame stacks prefetched on an ``io_workers`` thread pool,
+                 at most ``prefetch_depth`` stacks in flight (backpressure:
+                 each 46x1080p stack is ~95 MB of host RAM)
+      compute  — the main thread dispatches view N+1's transfer+decode+
+                 triangulate while view N is still in flight (JAX async
+                 dispatch; the numpy backend computes inline instead)
+      write    — a drain worker pays the device sync (``compact_cloud``)
+                 and hands the compacted arrays to ``ply.WritebackQueue``,
+                 so PLY encoding/disk never blocks the next dispatch
+
+    Per-item results are assembled strictly in source order at the end, so
+    outputs/failed/summary are identical to ``_reconstruct_serial`` — only
+    the schedule differs. Backend-init errors propagate (the CPU-fallback
+    retry contract), whether they fire at dispatch or first surface at the
+    drain sync.
+    """
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (
+        is_backend_init_error,
+    )
+
+    stats = prof.OverlapStats()
+    depth = max(1, cfg.parallel.prefetch_depth)
+    workers = cfg.parallel.io_workers
+
+    # idx -> ("fail", src, msg) | ("done", drain_future); assembled in order
+    results: dict[int, tuple] = {}
+    load_pool = ThreadPoolExecutor(max_workers=workers,
+                                   thread_name_prefix="sl3d-prefetch")
+    drain_pool = ThreadPoolExecutor(max_workers=1,
+                                    thread_name_prefix="sl3d-drain")
+    wbq = ply.WritebackQueue(
+        on_write=lambda _path, dt: stats.add("write", dt))
+
+    def load_one(src):
+        t0 = time.perf_counter()
+        out = imio.load_stack(src, io_workers=workers)
+        stats.add("load", time.perf_counter() - t0)
+        return out
+
+    def drain_one(cloud, out_path):
+        # the device sync lives HERE, off the dispatch thread: compaction's
+        # np.asarray blocks until the view's program retires
+        t0 = time.perf_counter()
+        pts, cols = tri.compact_cloud(cloud)
+        stats.add("compute", time.perf_counter() - t0, items=1)
+        wfut = wbq.submit(out_path, pts, cols)
+        return out_path, len(pts), wfut
+
+    t_wall = time.perf_counter()
+    try:
+        with prof.trace():
+            inflight: deque = deque()
+            undrained: deque = deque()
+            pending = list(enumerate(sources))
+            for idx, src in pending[:depth]:
+                inflight.append((idx, src, load_pool.submit(load_one, src)))
+            next_i = len(inflight)
+            while inflight:
+                idx, src, lfut = inflight.popleft()
+                stats.sample_queue(len(inflight))
+                if next_i < len(pending):       # keep the prefetch bound full
+                    j, s = pending[next_i]
+                    inflight.append((j, s, load_pool.submit(load_one, s)))
+                    next_i += 1
+                try:
+                    frames, texture = lfut.result()
+                except Exception as e:
+                    results[idx] = ("fail", src, str(e))
+                    continue
+                # backpressure on the compute->drain side too: at most
+                # depth+1 dispatched-but-undrained clouds live at once
+                # (each holds a full uncompacted H*W result on host or in
+                # HBM), so batch size never multiplies peak memory.
+                # Future.exception() blocks without raising — per-item
+                # errors stay with the in-order drain below.
+                while len(undrained) > depth:
+                    undrained.popleft().exception()
+                try:
+                    t0 = time.perf_counter()
+                    cloud = _compute_cloud(frames, texture, calib, cfg,
+                                           scanner, async_dispatch=True)
+                    stats.add("compute", time.perf_counter() - t0)
+                except Exception as e:
+                    if is_backend_init_error(e):
+                        raise
+                    results[idx] = ("fail", src, str(e))
+                    continue
+                out_path = _out_path_for(src, mode, output)
+                dfut = drain_pool.submit(drain_one, cloud, out_path)
+                undrained.append(dfut)
+                results[idx] = ("done", dfut)
+
+            # ---- in-order drain: the single sync point of the pipeline ----
+            for idx, src in pending:
+                name = _item_name(src)
+                kind, *rest = results[idx]
+                if kind == "done":
+                    try:
+                        out_path, n_pts, wfut = rest[0].result()
+                        wfut.result()           # surface write errors
+                        log(f"[reconstruct] {name}: {n_pts:,} points -> "
+                            f"{out_path}")
+                        report.outputs.append(out_path)
+                        continue
+                    except Exception as e:
+                        # a backend-init failure can first surface at the
+                        # drain sync — still a process-level condition
+                        if is_backend_init_error(e):
+                            raise
+                        rest = [src, str(e)]
+                log(f"[reconstruct] {name} FAILED: {rest[-1]}")
+                report.failed.append((src, rest[-1]))
+    finally:
+        load_pool.shutdown(wait=False, cancel_futures=True)
+        drain_pool.shutdown(wait=False, cancel_futures=True)
+        wbq.close(wait=True)
+    stats.finish(time.perf_counter() - t_wall)
+    report.overlap = stats.as_dict()
+    prof.get_logger().debug("reconstruct pipeline overlap: %s",
+                            stats.summary())
 
 
 def reconstruct(calib_path: str, target: str, mode: str = "single",
@@ -136,12 +341,18 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
 
     ``output``: for single mode a .ply path (default: <target>.ply); for
     batch/files a directory (default: alongside each source).
+
+    Multi-view batches run on the pipelined executor (prefetch + async
+    device dispatch + background writeback — ``_reconstruct_pipelined``)
+    when ``cfg.parallel.io_workers > 1``; outputs and the report are
+    identical to the serial loop, which remains the ``io_workers <= 1``
+    fallback and the single-view path.
     """
     cfg = cfg or Config()
     calib = matfile.load_calibration(calib_path)
     need = gc.frames_per_view(cfg.decode.n_cols, cfg.decode.n_rows,
                               cfg.projector.downsample)
-    sources = _scan_sources(target, mode, need)
+    sources = _scan_sources(target, mode, need, log=log)
     if not sources:
         raise ValueError(f"no scan sources found under {target!r} (mode={mode})")
 
@@ -165,38 +376,17 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
         )
 
     report = BatchReport()
-    timer = prof.StageTimer()
+    if output and mode != "single":
+        os.makedirs(output, exist_ok=True)
     t0 = time.monotonic()
-    for src in sources:
-        name = os.path.basename(os.path.normpath(src)) or "cloud"
-        try:
-            with timer.stage(name), prof.trace():
-                pts, cols = reconstruct_source(src, calib, cfg, scanner)
-            if mode == "single" and output:
-                out_path = output
-            elif output:
-                os.makedirs(output, exist_ok=True)
-                out_path = os.path.join(output, f"{name}.ply")
-            else:
-                out_path = os.path.normpath(src) + ".ply"
-            ply.write_ply(out_path, pts, cols)
-            log(f"[reconstruct] {name}: {len(pts):,} points -> {out_path}")
-            report.outputs.append(out_path)
-        except Exception as e:  # per-item tolerance (processing.py:323-330)
-            from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (
-                is_backend_init_error,
-            )
-
-            if is_backend_init_error(e):
-                # process-level condition, not an item failure: propagate
-                # so the CLI's CPU-fallback retry can handle it (otherwise
-                # every item "fails" identically and no retry fires)
-                raise
-            log(f"[reconstruct] {name} FAILED: {e}")
-            report.failed.append((src, str(e)))
+    if cfg.parallel.io_workers > 1 and len(sources) > 1:
+        _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output,
+                               report, log)
+    else:
+        _reconstruct_serial(sources, calib, cfg, scanner, mode, output,
+                            report, log)
     report.elapsed_s = time.monotonic() - t0
     log(f"[reconstruct] {report.summary}")
-    prof.get_logger().debug("reconstruct stage timing:\n%s", timer.report())
     return report
 
 
@@ -300,9 +490,20 @@ def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
     if len(paths) < 2:
         raise ValueError(f"need >= 2 PLY views in {input_folder}, found {len(paths)}")
     log(f"[merge] {len(paths)} views: " + ", ".join(os.path.basename(p) for p in paths))
+    # per-view PLY reads on the shared I/O pool (parallel.io_workers; the
+    # registration can't start early anyway, so amortize the disk wall);
+    # pool.map preserves path order, so the merge chain is unchanged
+    if cfg.parallel.io_workers > 1 and len(paths) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+                max_workers=min(cfg.parallel.io_workers, len(paths)),
+                thread_name_prefix="sl3d-plyread") as pool:
+            datas = list(pool.map(ply.read_ply, paths))
+    else:
+        datas = [ply.read_ply(p) for p in paths]
     clouds = []
-    for p in paths:
-        d = ply.read_ply(p)
+    for d in datas:
         c = d.get("colors")
         if c is None:
             c = np.zeros_like(d["points"], dtype=np.uint8)

@@ -1,0 +1,258 @@
+"""Pipelined batch reconstruct vs the serial loop: identical artifacts,
+identical report, identical failure semantics — only the schedule differs.
+
+The executor contract (pipeline/stages._reconstruct_pipelined):
+  - PLY outputs byte-identical to the serial path (same math, same writer)
+  - BatchReport outputs/failed in the same order, same summary counts
+  - per-item tolerance: one view failing mid-batch fails that item only
+  - backend-init errors propagate (the CLI CPU-fallback retry contract),
+    never get swallowed into per-item failures
+  - overlap accounting is recorded (load/compute/write vs critical path)
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.cli import main as cli_main
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io import images as imio
+from structured_light_for_3d_model_replication_tpu.io import ply as plyio
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("pipeds"))
+    rc = cli_main(["synth", root, "--views", "4",
+                   "--cam", "160x120", "--proj", "128x64"])
+    assert rc == 0
+    return root
+
+
+def _cfg(io_workers: int, prefetch: int = 2) -> Config:
+    cfg = Config()
+    # numpy backend: deterministic, no jax warm-up — the executor schedule
+    # under test is backend-independent
+    cfg.parallel.backend = "numpy"
+    cfg.parallel.io_workers = io_workers
+    cfg.parallel.prefetch_depth = prefetch
+    cfg.decode.n_cols, cfg.decode.n_rows = 128, 64
+    cfg.decode.thresh_mode = "manual"
+    return cfg
+
+
+def _run(dataset, out_dir, io_workers, log=None):
+    calib = os.path.join(dataset, "calib.mat")
+    return stages.reconstruct(calib, dataset, mode="batch", output=str(out_dir),
+                              cfg=_cfg(io_workers), log=log or (lambda m: None))
+
+
+def test_pipelined_outputs_byte_identical_to_serial(dataset, tmp_path):
+    rep_s = _run(dataset, tmp_path / "serial", io_workers=1)
+    rep_p = _run(dataset, tmp_path / "pipe", io_workers=4)
+
+    names_s = sorted(os.listdir(tmp_path / "serial"))
+    names_p = sorted(os.listdir(tmp_path / "pipe"))
+    assert names_s == names_p and len(names_s) == 4
+    for f in names_s:
+        a = (tmp_path / "serial" / f).read_bytes()
+        b = (tmp_path / "pipe" / f).read_bytes()
+        assert a == b, f"{f}: pipelined PLY differs from serial"
+
+    # identical report modulo the directory prefix and wall time
+    assert [os.path.basename(p) for p in rep_s.outputs] == \
+           [os.path.basename(p) for p in rep_p.outputs]
+    assert rep_s.failed == rep_p.failed == []
+    assert rep_s.summary.split(" in ")[0] == rep_p.summary.split(" in ")[0]
+
+
+def test_overlap_accounting_recorded(dataset, tmp_path):
+    rep_p = _run(dataset, tmp_path / "pipe", io_workers=4)
+    rep_s = _run(dataset, tmp_path / "serial", io_workers=1)
+    assert rep_s.overlap is None  # serial path records nothing
+    o = rep_p.overlap
+    assert o is not None
+    for k in ("load_s", "compute_s", "write_s", "critical_path_s",
+              "serial_sum_s", "overlap_ratio", "max_queue_depth",
+              "mean_queue_depth"):
+        assert k in o, f"missing overlap field {k}"
+    assert o["items"] == 4
+    assert o["critical_path_s"] > 0
+    assert o["serial_sum_s"] == pytest.approx(
+        o["load_s"] + o["compute_s"] + o["write_s"], abs=1e-3)
+    assert o["max_queue_depth"] <= 2  # the prefetch bound held
+
+
+def test_pipeline_hides_injected_io_latency(dataset, tmp_path, monkeypatch):
+    """The executor's reason to exist, made deterministic: every load pays a
+    sleep (blocking-without-CPU, like a network read — concurrent even on a
+    single-core CI host), and the pipelined wall must come in well under the
+    serial wall that pays it per view."""
+    lat = 0.05
+    real_load = imio.load_stack
+
+    def latent_load(source, expected=None, io_workers=None):
+        out = real_load(source, expected=expected, io_workers=io_workers)
+        time.sleep(lat)
+        return out
+
+    monkeypatch.setattr(imio, "load_stack", latent_load)
+    t0 = time.perf_counter()
+    rep_s = _run(dataset, tmp_path / "serial", io_workers=1)
+    serial_wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    rep_p = _run(dataset, tmp_path / "pipe", io_workers=4)
+    pipe_wall = time.perf_counter() - t0
+
+    assert len(rep_s.outputs) == len(rep_p.outputs) == 4
+    assert serial_wall >= 4 * lat          # serial pays every view's latency
+    # pipelined hides at least two of the four latencies behind compute
+    # (generous margin: CI boxes are noisy)
+    assert pipe_wall < serial_wall - 1.5 * lat
+    assert rep_p.overlap["critical_path_s"] < rep_p.overlap["serial_sum_s"]
+
+
+def test_mid_batch_failure_matches_serial(dataset, tmp_path, monkeypatch):
+    """One view failing to load is an item failure in BOTH executors, with
+    the same (source, message) record and the other views unaffected."""
+    victim = sorted(
+        d for d in os.listdir(dataset)
+        if os.path.isdir(os.path.join(dataset, d)))[1]
+    real_load = imio.load_stack
+
+    def flaky_load(source, expected=None, io_workers=None):
+        if os.path.basename(os.path.normpath(str(source))) == victim:
+            raise IOError(f"simulated unreadable frame in {victim}")
+        return real_load(source, expected=expected, io_workers=io_workers)
+
+    monkeypatch.setattr(imio, "load_stack", flaky_load)
+    rep_s = _run(dataset, tmp_path / "serial", io_workers=1)
+    rep_p = _run(dataset, tmp_path / "pipe", io_workers=4)
+
+    assert len(rep_s.failed) == len(rep_p.failed) == 1
+    assert [os.path.basename(os.path.normpath(s)) for s, _ in rep_s.failed] \
+        == [os.path.basename(os.path.normpath(s)) for s, _ in rep_p.failed] \
+        == [victim]
+    assert rep_s.failed[0][1] == rep_p.failed[0][1]
+    assert [os.path.basename(p) for p in rep_s.outputs] == \
+           [os.path.basename(p) for p in rep_p.outputs]
+    assert len(rep_p.outputs) == 3
+
+
+def test_mid_batch_compute_failure_is_item_failure(dataset, tmp_path,
+                                                   monkeypatch):
+    victim = sorted(
+        d for d in os.listdir(dataset)
+        if os.path.isdir(os.path.join(dataset, d)))[2]
+    real_compute = stages._compute_cloud
+    calls = {"n": 0}
+
+    def flaky_compute(frames, texture, calib, cfg, scanner=None,
+                      async_dispatch=False):
+        calls["n"] += 1
+        if calls["n"] == 3:  # the third dispatched view
+            raise ValueError("simulated decode blow-up")
+        return real_compute(frames, texture, calib, cfg, scanner,
+                            async_dispatch=async_dispatch)
+
+    monkeypatch.setattr(stages, "_compute_cloud", flaky_compute)
+    rep_p = _run(dataset, tmp_path / "pipe", io_workers=4)
+    assert len(rep_p.failed) == 1
+    assert os.path.basename(os.path.normpath(rep_p.failed[0][0])) == victim
+    assert "simulated decode blow-up" in rep_p.failed[0][1]
+    assert len(rep_p.outputs) == 3
+
+
+@pytest.mark.parametrize("io_workers", [1, 4])
+def test_backend_init_error_propagates(dataset, tmp_path, monkeypatch,
+                                       io_workers):
+    """The CPU-fallback retry contract: an accelerator init failure is a
+    process-level condition and must raise out of reconstruct() from either
+    executor, not be folded into per-item failures."""
+    def init_fail(*a, **k):
+        raise RuntimeError(
+            "Unable to initialize backend 'axon': Backend 'axon' is not in "
+            "the list of known backends")
+
+    monkeypatch.setattr(stages, "_compute_cloud", init_fail)
+    with pytest.raises(RuntimeError, match="[Uu]nable to initialize backend"):
+        _run(dataset, tmp_path / f"out{io_workers}", io_workers=io_workers)
+
+
+def test_scan_sources_logs_skipped_folders(dataset, tmp_path):
+    """Batch mode names every folder it drops, with its frame count — a
+    partial capture must be diagnosable, not a silently smaller batch."""
+    import shutil
+
+    root = tmp_path / "scans"
+    shutil.copytree(dataset, root)
+    os.remove(root / "calib.mat")
+    views = sorted(os.listdir(root))
+    partial = root / views[0]
+    for f in sorted(os.listdir(partial))[4:]:  # leave 4 of 28 frames
+        os.remove(partial / f)
+    empty = root / "zz_no_frames"
+    empty.mkdir()
+
+    logs = []
+    sources = stages._scan_sources(str(root), "batch", need=28,
+                                   log=logs.append)
+    assert len(sources) == len(views) - 1
+    skip_lines = [m for m in logs if "skipping" in m]
+    assert any(views[0] in m and "4 frames < 28" in m for m in skip_lines)
+    assert any("zz_no_frames" in m and "no frame images" in m
+               for m in skip_lines)
+
+
+def test_load_stack_threaded_matches_serial(tmp_path, monkeypatch):
+    rng = np.random.default_rng(7)
+    frames = rng.integers(0, 255, (8, 48, 64), np.uint8)
+    imio.save_stack(str(tmp_path), frames)
+    # force the pure-python loader so the thread pool under test actually
+    # runs (the native decoder is its own, already-parallel path)
+    from structured_light_for_3d_model_replication_tpu.io import native
+
+    monkeypatch.setattr(native, "probe_png", lambda p: None)
+    a, ta = imio.load_stack(str(tmp_path), io_workers=1)
+    b, tb = imio.load_stack(str(tmp_path), io_workers=4)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(ta, tb)
+
+    # a mismatched frame raises from the pool exactly like the serial loop
+    imio.save_image(str(tmp_path / "09.png"),
+                    np.zeros((12, 12), np.uint8))
+    with pytest.raises(ValueError, match="frame size"):
+        imio.load_stack(str(tmp_path), io_workers=4)
+    with pytest.raises(ValueError, match="frame size"):
+        imio.load_stack(str(tmp_path), io_workers=1)
+
+
+def test_writeback_queue_orders_and_reports_errors(tmp_path):
+    pts = np.zeros((10, 3), np.float32)
+    written = []
+    wbq = plyio.WritebackQueue(on_write=lambda p, dt: written.append(p))
+    with wbq:
+        futs = [wbq.submit(str(tmp_path / f"c{i}.ply"), pts)
+                for i in range(3)]
+        bad = wbq.submit(str(tmp_path / "no_dir" / "x.ply"), pts)
+        assert [f.result() for f in futs] == \
+            [str(tmp_path / f"c{i}.ply") for i in range(3)]
+        with pytest.raises(OSError):
+            bad.result()
+    assert written == [str(tmp_path / f"c{i}.ply") for i in range(3)]
+    for i in range(3):
+        assert len(plyio.read_ply(str(tmp_path / f"c{i}.ply"))["points"]) == 10
+
+
+def test_single_source_and_single_worker_use_serial_path(dataset, tmp_path):
+    view0 = os.path.join(dataset, sorted(
+        d for d in os.listdir(dataset)
+        if os.path.isdir(os.path.join(dataset, d)))[0])
+    rep = stages.reconstruct(os.path.join(dataset, "calib.mat"), view0,
+                             mode="single",
+                             output=str(tmp_path / "one.ply"),
+                             cfg=_cfg(io_workers=8), log=lambda m: None)
+    assert rep.overlap is None  # one view: nothing to pipeline
+    assert len(rep.outputs) == 1
