@@ -274,6 +274,131 @@ def bench_reconstruct_pipeline(views: int = PIPE_VIEWS, reps: int = 2,
     return out
 
 
+def bench_pipeline_e2e(views: int = PIPE_VIEWS) -> dict:
+    """Fused ``slscan pipeline`` vs the discrete reconstruct -> clean ->
+    merge-360 -> mesh command chain on the synthetic turntable rig (numpy
+    decode backend — parent-process safe, no accelerator lock).
+
+    Measures wall time for both arms, counts intermediate PLY parses
+    (``ply.read_ply`` calls during each arm — the discrete chain's only
+    stage handoff; the fused path must show ZERO), and asserts the final
+    merged cloud is the same point multiset within float tolerance. A
+    second fused run must hit every stage cache and produce byte-identical
+    artifacts."""
+    import shutil
+    import tempfile
+
+    from structured_light_for_3d_model_replication_tpu.config import Config
+    from structured_light_for_3d_model_replication_tpu.io import matfile
+    from structured_light_for_3d_model_replication_tpu.io import ply as plyio
+    from structured_light_for_3d_model_replication_tpu.io import images as imio
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        synthetic as syn,
+    )
+
+    out: dict = {"views": views, "backend": "numpy",
+                 "host_cpus": os.cpu_count()}
+    tmp = tempfile.mkdtemp(prefix="slbench_e2e_")
+    parse_counter = {"n": 0}
+    real_read = plyio.read_ply
+
+    def counting_read(path):
+        parse_counter["n"] += 1
+        return real_read(path)
+
+    try:
+        rig = syn.default_rig(cam_size=PIPE_CAM, proj_size=PIPE_PROJ)
+        scene = syn.sphere_on_background()
+        obj, background = scene.objects
+        calib_path = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(calib_path, rig.calibration())
+        root = os.path.join(tmp, "scans")
+        os.makedirs(root)
+        step = 360.0 / views
+        pivot = np.array([0.0, 0.0, 420.0])
+        for i, (R, t) in enumerate(syn.turntable_poses(views, step, pivot)):
+            frames, _ = syn.render_scene(
+                rig, syn.Scene([obj.transformed(R, t), background]))
+            imio.save_stack(
+                os.path.join(root, f"scan_{int(round(i * step)):03d}deg_scan"),
+                frames)
+
+        def cfg():
+            c = Config()
+            c.parallel.backend = "numpy"
+            c.decode.n_cols, c.decode.n_rows = PIPE_PROJ
+            c.decode.thresh_mode = "manual"
+            c.merge.voxel_size = 4.0
+            c.merge.ransac_trials = 512
+            c.merge.icp_iters = 10
+            c.mesh.depth = 5
+            c.mesh.density_trim_quantile = 0.0
+            return c
+
+        steps = ("statistical",)
+        # ---- discrete arm: four commands through PLY files ----
+        plyio.read_ply = counting_read
+        stages.ply.read_ply = counting_read
+        t0 = time.perf_counter()
+        vdir = os.path.join(tmp, "views")
+        rep = stages.reconstruct(calib_path, root, mode="batch", output=vdir,
+                                 cfg=cfg(), log=lambda m: None)
+        assert not rep.failed, rep.failed
+        cdir = os.path.join(tmp, "cleaned")
+        os.makedirs(cdir)
+        for f in sorted(os.listdir(vdir)):
+            stages.clean_cloud(os.path.join(vdir, f), os.path.join(cdir, f),
+                               cfg=cfg(), steps=steps, log=lambda m: None)
+        merged_d = os.path.join(tmp, "merged_discrete.ply")
+        stages.merge_views(cdir, merged_d, cfg=cfg(), log=lambda m: None)
+        stl_d = os.path.join(tmp, "model_discrete.stl")
+        stages.mesh_cloud(merged_d, stl_d, cfg=cfg(), log=lambda m: None)
+        out["discrete_s"] = round(time.perf_counter() - t0, 4)
+        out["discrete_ply_parses"] = parse_counter["n"]
+
+        # ---- fused arm (cold cache) ----
+        parse_counter["n"] = 0
+        fdir = os.path.join(tmp, "fused")
+        t0 = time.perf_counter()
+        frep = stages.run_pipeline(calib_path, root, fdir, cfg=cfg(),
+                                   steps=steps, log=lambda m: None)
+        out["fused_s"] = round(time.perf_counter() - t0, 4)
+        out["fused_ply_parses"] = parse_counter["n"]
+        assert not frep.failed, frep.failed
+
+        # ---- fused rerun (warm cache: zero stage compute) ----
+        t0 = time.perf_counter()
+        frep2 = stages.run_pipeline(calib_path, root, fdir, cfg=cfg(),
+                                    steps=steps, log=lambda m: None)
+        out["fused_cached_s"] = round(time.perf_counter() - t0, 4)
+        out["cache_hits_second_run"] = frep2.cache["hits"]
+        out["cache_misses_second_run"] = frep2.cache["misses"]
+        plyio.read_ply = real_read
+        stages.ply.read_ply = real_read
+
+        # ---- equivalence: same point multiset within float tolerance ----
+        pd = real_read(merged_d)["points"]
+        pf = real_read(frep.merged_ply)["points"]
+        out["merged_points"] = int(len(pf))
+        if pd.shape == pf.shape:
+            sd = pd[np.lexsort(pd.T)]
+            sf = pf[np.lexsort(pf.T)]
+            out["merged_max_abs_diff"] = float(np.abs(sd - sf).max())
+            out["equivalent"] = bool(out["merged_max_abs_diff"] <= 1e-4)
+        else:
+            out["equivalent"] = False
+        with open(stl_d, "rb") as fa, open(frep.stl_path, "rb") as fb:
+            out["stl_identical"] = fa.read() == fb.read()
+        out["speedup_vs_discrete"] = round(
+            out["discrete_s"] / out["fused_s"], 3)
+    finally:
+        plyio.read_ply = real_read
+        stages.ply.read_ply = real_read
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # child: all jax work, per-phase persisted results
 # ---------------------------------------------------------------------------
@@ -764,6 +889,20 @@ def main() -> None:
                 "error": f"{type(e).__name__}: {e}"[:200]}
             log(f"pipeline A/B FAILED ({final['reconstruct_pipeline']['error']})")
 
+        # fused scan-to-print vs the discrete command chain (host-only)
+        try:
+            log("pipeline e2e A/B (fused vs discrete chain, numpy backend)...")
+            final["pipeline_e2e"] = bench_pipeline_e2e()
+            pe = final["pipeline_e2e"]
+            log(f"pipeline_e2e: discrete {pe['discrete_s']}s "
+                f"({pe['discrete_ply_parses']} PLY parses) vs fused "
+                f"{pe['fused_s']}s ({pe['fused_ply_parses']} parses), "
+                f"cached rerun {pe['fused_cached_s']}s, equivalent="
+                f"{pe['equivalent']}, stl_identical={pe['stl_identical']}")
+        except Exception as e:
+            final["pipeline_e2e"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+            log(f"pipeline e2e A/B FAILED ({final['pipeline_e2e']['error']})")
+
         # one TPU client at a time, repo-wide: if a validation session (or
         # any other tool) holds the claim lock, QUEUE behind it — racing it
         # is the concurrent-client wedge. Waiting is also the best outcome:
@@ -906,6 +1045,7 @@ if __name__ == "__main__":
             line["value"] = line.get("pipelined_s")
             line["cold_io"] = bench_reconstruct_pipeline(
                 inject_io_latency_s=PIPE_COLD_IO_S)
+            line["pipeline_e2e"] = bench_pipeline_e2e()
         except Exception as e:
             line["error"] = f"{type(e).__name__}: {e}"[:200]
         emit(line)

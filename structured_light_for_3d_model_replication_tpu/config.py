@@ -194,6 +194,23 @@ class ParallelConfig:
 
 
 @dataclass
+class PipelineConfig:
+    """The fused scan-to-print command (``slscan pipeline``). New in the TPU
+    build: the reference chains four file-level commands through PLY
+    artifacts; the fused command hands clouds stage to stage in memory."""
+
+    # content-addressed stage cache under <out>/.slscan-cache: reruns skip
+    # every stage whose inputs (frames, calib, config subtree) are unchanged
+    cache: bool = True
+    # also emit each cleaned per-view cloud as <out>/views/<name>.ply
+    # (side output on the writeback queue; the fused handoff never reads it)
+    write_view_plys: bool = False
+    # final merged-cloud PLY in ASCII (reference interop, %.4f — lossy; see
+    # docs/API.md). INTERMEDIATE artifacts ignore this and stay binary.
+    ascii_output: bool = False
+
+
+@dataclass
 class Config:
     """Root configuration for the whole framework."""
 
@@ -206,6 +223,7 @@ class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     acquire: AcquireConfig = field(default_factory=AcquireConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     scan_root: str = ""  # dated scan folder; empty = ./scans/<date>
 
     def to_dict(self) -> dict[str, Any]:

@@ -36,7 +36,14 @@ def _vertex_dtype(has_colors: bool, has_normals: bool) -> np.dtype:
 def write_ply(path: str, points: np.ndarray, colors: np.ndarray | None = None,
               normals: np.ndarray | None = None, binary: bool = True) -> None:
     """Write a point cloud. points [N,3] float; colors [N,3] uint8 RGB;
-    normals [N,3] float; binary little-endian by default."""
+    normals [N,3] float; binary little-endian by default.
+
+    ``binary=False`` writes the reference's ASCII layout with ``%.4f``
+    coordinates — a LOSSY roundtrip (~0.1 um at mm scale, plus outright
+    truncation for |coord| >= 10^4). It exists for interop with the
+    reference's artifacts only: every *intermediate* pipeline artifact is
+    written binary regardless of user-facing ASCII flags (see docs/API.md),
+    so lossiness can only ever appear in a final, user-requested export."""
     points = np.asarray(points, np.float32)
     n = points.shape[0]
     has_c = colors is not None

@@ -39,6 +39,7 @@ __all__ = [
     "segment_plane", "segment_plane_np",
     "largest_cluster_mask", "largest_cluster_mask_np",
     "voxel_downsample", "voxel_downsample_np",
+    "clean_chain", "clean_chain_np", "chain_params", "CLEAN_STEPS",
 ]
 
 
@@ -741,6 +742,139 @@ def _voxel_downsample_packed(points, colors, valid, vs):
     seg = jnp.cumsum(newgrp.astype(jnp.int32)) - 1
     return _voxel_group_reduce(seg, valid[order], points[order],
                                colors[order].astype(jnp.float32), n)
+
+
+# ---------------------------------------------------------------------------
+# Masked cleanup chain (the tab-3 chain as ONE fixed-shape program)
+# ---------------------------------------------------------------------------
+
+CLEAN_STEPS = ("background", "cluster", "radius", "statistical")
+
+
+def chain_params(cfg, steps=CLEAN_STEPS) -> tuple:
+    """Freeze a CleanConfig + step selection into the hashable static key
+    ``clean_chain`` traces under: a tuple of (step, ((param, value), ...)).
+    One bucket size + one params tuple = one compile for every view and
+    every rerun. ``background`` honors ``remove_background_plane`` exactly
+    like the file-level chain: disabled, the step vanishes (no count)."""
+    params = []
+    for step in steps:
+        if step not in CLEAN_STEPS:
+            raise ValueError(
+                f"unknown clean step {step!r}; valid: {CLEAN_STEPS}")
+        if step == "background":
+            if not cfg.remove_background_plane:
+                continue
+            kw = (("dist", float(cfg.plane_ransac_dist)),
+                  ("trials", int(cfg.plane_ransac_trials)))
+        elif step == "cluster":
+            kw = (("eps", float(cfg.cluster_eps)),
+                  ("min_points", int(cfg.cluster_min_points)))
+        elif step == "radius":
+            kw = (("radius", float(cfg.radius)),
+                  ("nb_points", int(cfg.radius_nb_points)))
+        else:  # statistical
+            kw = (("nb", int(cfg.outlier_nb_neighbors)),
+                  ("std", float(cfg.outlier_std_ratio)))
+        params.append((step, kw))
+    return tuple(params)
+
+
+def _chain_step(points, valid, step: str, kw: dict, jaxpath: bool, key=None):
+    """One masked step: same op the file-level chain ran, but the survivors
+    stay where they are — only the keep-mask narrows."""
+    if step == "background":
+        # the reference keeps the INVERSE of the plane inliers
+        if jaxpath:
+            _, inliers = segment_plane(points, valid,
+                                       distance_threshold=kw["dist"],
+                                       num_iterations=kw["trials"], key=key)
+            return valid & ~inliers
+        _, inliers = segment_plane_np(points, valid,
+                                      distance_threshold=kw["dist"],
+                                      num_iterations=kw["trials"])
+        return valid & ~inliers
+    if step == "cluster":
+        fn = largest_cluster_mask if jaxpath else largest_cluster_mask_np
+        return fn(points, valid, eps=kw["eps"], min_points=kw["min_points"])
+    if step == "radius":
+        fn = radius_outlier_mask if jaxpath else radius_outlier_mask_np
+        return valid & fn(points, valid, radius=kw["radius"],
+                          nb_points=kw["nb_points"])
+    fn = (statistical_outlier_mask if jaxpath
+          else statistical_outlier_mask_np)
+    return valid & fn(points, valid, kw["nb"], kw["std"])
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def _clean_chain_jit(points, valid, key, params: tuple):
+    masks, counts = [], []
+    for step, kw in params:
+        valid = _chain_step(points, valid, step, dict(kw), jaxpath=True,
+                            key=key)
+        masks.append(valid)
+        counts.append(valid.sum())
+    return jnp.stack(masks), jnp.stack(counts).astype(jnp.int32)
+
+
+def clean_chain(points, valid, cfg, steps=CLEAN_STEPS, key=None):
+    """The cleanup chain (background plane -> largest cluster -> radius ->
+    statistical, individually selectable) as masked fixed-shape steps in ONE
+    jitted program: each step narrows a ``valid`` mask in place instead of
+    host-compacting the survivors, so per-view sizes never reshape the trace
+    — pad every cloud to its _bucket_pad bucket and one compile covers all
+    views and reruns (assert via ``_clean_chain_jit._cache_size()``).
+
+    points [N,3] f32 (padded), valid [N] bool. Returns (masks [S,N] bool,
+    counts [S] i32) with one row per EFFECTIVE step (``chain_params``
+    semantics: a disabled background step vanishes), masks[i] the
+    accumulated keep-mask after step i — masks[-1] is the final survivor
+    set, earlier rows feed per-step callbacks/artifacts.
+
+    Host backends above the brute-kNN ceiling run the same masked steps
+    eagerly instead (one extra dispatch per step, no jit): under trace the
+    statistical/radius ops cannot reach their concrete-input host fast
+    paths (cKDTree delegation), and the host grid kNN needs concrete
+    extents."""
+    params = chain_params(cfg, steps)
+    n = points.shape[0]
+    if n == 0 or not params:
+        return (jnp.zeros((len(params), n), bool),
+                jnp.zeros(len(params), jnp.int32))
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    concrete = not (isinstance(points, jax.core.Tracer)
+                    or isinstance(valid, jax.core.Tracer))
+    if (concrete and jax.default_backend() == "cpu"
+            and n > knnlib._BRUTE_MAX):
+        masks, counts = [], []
+        v = jnp.asarray(valid)
+        p = jnp.asarray(points)
+        for step, kw in params:
+            v = _chain_step(p, v, step, dict(kw), jaxpath=True, key=key)
+            masks.append(v)
+            counts.append(v.sum())
+        return jnp.stack(masks), jnp.stack(counts).astype(jnp.int32)
+    return _clean_chain_jit(jnp.asarray(points), jnp.asarray(valid), key,
+                            params)
+
+
+def clean_chain_np(points, valid, cfg, steps=CLEAN_STEPS):
+    """Bit-exact NumPy twin of ``clean_chain`` (same masked semantics via
+    the _np reference ops)."""
+    params = chain_params(cfg, steps)
+    if valid is None:
+        valid = np.ones(points.shape[0], bool)
+    masks, counts = [], []
+    v = np.asarray(valid, bool)
+    for step, kw in params:
+        v = _chain_step(np.asarray(points), v, step, dict(kw), jaxpath=False)
+        masks.append(v)
+        counts.append(int(v.sum()))
+    if not masks:
+        return (np.zeros((0, points.shape[0]), bool),
+                np.zeros(0, np.int32))
+    return np.stack(masks), np.asarray(counts, np.int32)
 
 
 def voxel_downsample_np(points, colors, valid, voxel_size):

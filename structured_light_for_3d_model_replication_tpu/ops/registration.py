@@ -766,7 +766,7 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
     from jax.sharding import PartitionSpec
 
     from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (
-        shard_map,
+        shard_map_unchecked,
     )
 
     p = src_pts.shape[0]
@@ -808,11 +808,15 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
         return _register_pairs_jit(sp, sv, sf, dp, dv, df, dn,
                                    md, imd, es, k[0], **kw)
 
-    fn = jax.jit(shard_map(
-        local, mesh=mesh,
+    # replication/VMA checking OFF: _icp_core's lax.while_loop has no
+    # replication rule in the shard_map checker (jax<=0.4.x raises
+    # NotImplementedError at trace time), and there is nothing to check —
+    # every in/out spec shards the pair axis, nothing is replicated
+    fn = jax.jit(shard_map_unchecked(
+        mesh=mesh,
         in_specs=(spec,) * 8,
         out_specs=(spec, spec, spec, spec),
-    ))
+    )(local))
     inputs = arrays
     try:
         T, gfit, ifit, irmse = fn(*inputs, keys)

@@ -47,6 +47,16 @@ def initialize(coordinator_address: str | None = None,
             "reverse)")
     import jax
 
+    # the XLA:CPU client only runs cross-process collectives over a
+    # pluggable backend; without this pin a CPU process group initializes
+    # fine and then fails at the first psum with "Multiprocess computations
+    # aren't implemented on the CPU backend". Best-effort: accelerator
+    # platforms ignore it, and jax versions without the knob keep their
+    # default.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     try:
         jax.distributed.initialize(
             coordinator_address=addr,

@@ -20,6 +20,9 @@ implementation.
   synth         (new)    render a synthetic scan dataset for tests/demos
   warmup        (new)    pre-compile flagship programs into the persistent cache
   doctor        (new)    bounded environment diagnosis (tunnel, lock, cache)
+  pipeline      (new)    fused scan-to-print: reconstruct -> clean -> merge ->
+      (alias: print)     mesh in one process with device-resident handoff and
+                         a content-addressed stage cache (resume on rerun)
 """
 from __future__ import annotations
 
@@ -97,14 +100,48 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
                         "compute (default: parallel.prefetch_depth)")
     add_config_args(p)
 
-    p = sub.add_parser("clean", help="point-cloud cleanup chain on one PLY")
-    p.add_argument("input")
-    p.add_argument("output")
+    p = sub.add_parser("clean",
+                       help="point-cloud cleanup chain on one PLY, or on "
+                            "every PLY in a folder (batch mode: input is a "
+                            "directory, output is the destination directory)")
+    p.add_argument("input", help=".ply file, or a folder of .ply files")
+    p.add_argument("output", help="output .ply (file input) or output "
+                                  "directory (folder input)")
     p.add_argument("--steps", default="background,cluster,radius,statistical",
                    help="comma list drawn from background,cluster,radius,statistical")
     p.add_argument("--artifacts", default=None,
                    help="record each intermediate cloud into this directory "
-                        "for the web viewer (tab-3 per-step inspection)")
+                        "for the web viewer (tab-3 per-step inspection; "
+                        "single-file mode only)")
+    add_config_args(p)
+
+    p = sub.add_parser(
+        "pipeline", aliases=["print"],
+        help="fused scan-to-print: reconstruct -> per-view clean -> "
+             "merge-360 -> mesh in one process (device-resident handoff, "
+             "no intermediate PLY parses); reruns resume from the "
+             "content-addressed stage cache under <out>/.slscan-cache")
+    p.add_argument("target", help="scan root: one folder per view")
+    p.add_argument("--calib", required=True, help="calibration file (.mat/.npz)")
+    p.add_argument("--out", required=True, help="output directory "
+                   "(merged.ply, model.stl, .slscan-cache/)")
+    p.add_argument("--steps", default="background,cluster,radius,statistical",
+                   help="clean-chain steps per view (comma list; empty "
+                        "string disables cleaning)")
+    p.add_argument("--stl-name", default="model.stl")
+    p.add_argument("--no-cache", action="store_true",
+                   help="recompute every stage (skip the stage cache)")
+    p.add_argument("--view-plys", action="store_true",
+                   help="also emit each cleaned view as <out>/views/*.ply "
+                        "(side output on the writeback queue; always "
+                        "binary)")
+    p.add_argument("--ascii", action="store_true",
+                   help="write the FINAL merged PLY in ASCII (reference "
+                        "interop; %%.4f floats — lossy, see docs/API.md). "
+                        "Intermediates stay binary regardless")
+    p.add_argument("--io-workers", type=int, default=None,
+                   help="host I/O threads for the pipelined executor")
+    p.add_argument("--prefetch-depth", type=int, default=None)
     add_config_args(p)
 
     p = sub.add_parser("merge-360",
@@ -297,6 +334,12 @@ def _cmd_clean(args) -> int:
     from structured_light_for_3d_model_replication_tpu.pipeline import stages
 
     steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
+    if os.path.isdir(args.input):
+        # batch mode: clean every PLY in the folder on the I/O pool
+        report = stages.clean_batch(args.input, args.output, cfg=_cfg(args),
+                                    steps=steps)
+        return 0 if report.outputs and not report.failed else \
+            (2 if report.outputs else 1)
     step_cb = None
     if args.artifacts:
         from structured_light_for_3d_model_replication_tpu.acquire.viewer import (
@@ -308,6 +351,37 @@ def _cmd_clean(args) -> int:
     stages.clean_cloud(args.input, args.output, cfg=_cfg(args), steps=steps,
                        step_callback=step_cb)
     return 0
+
+
+@_runner("pipeline")
+@_runner("print")
+def _cmd_pipeline(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+    cfg = _cfg(args)
+    if args.io_workers is not None:
+        cfg.parallel.io_workers = args.io_workers
+    if args.prefetch_depth is not None:
+        cfg.parallel.prefetch_depth = args.prefetch_depth
+    if args.no_cache:
+        cfg.pipeline.cache = False
+    if args.view_plys:
+        cfg.pipeline.write_view_plys = True
+    if args.ascii:
+        cfg.pipeline.ascii_output = True
+    steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
+    report = stages.run_pipeline(args.calib, args.target, args.out, cfg=cfg,
+                                 steps=steps, stl_name=args.stl_name)
+    if report.overlap:
+        o = report.overlap
+        clean = (f" + clean {o['clean_s']}s" if o.get("clean_s") else "")
+        print(f"[pipeline] overlap: load {o['load_s']}s + compute "
+              f"{o['compute_s']}s{clean} + write {o['write_s']}s in "
+              f"{o['critical_path_s']}s wall (x{o['overlap_ratio']})")
+    if report.cache:
+        print(f"[pipeline] stage cache: {report.cache['hits']} hits, "
+              f"{report.cache['misses']} misses")
+    return 0 if not report.failed else 2
 
 
 @_runner("merge-360")
@@ -565,7 +639,15 @@ def _cmd_warmup(args) -> int:
         jax.config.update("jax_compilation_cache_dir",
                           os.path.abspath(args.cache_dir))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception as e:  # older jax without the knob
+        # jax initializes the persistent cache AT MOST ONCE per process, at
+        # the first compile — embedded in a process that already compiled
+        # something (library use, a multi-command runner), the dir update
+        # above would be silently ignored forever. Reset so the next
+        # compile re-initializes against OUR directory.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception as e:  # older jax without the knob/internals
         print(f"[warmup] persistent cache unavailable ({e})", file=sys.stderr)
     import jax.numpy as jnp
 

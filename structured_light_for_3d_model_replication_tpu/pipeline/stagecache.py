@@ -1,0 +1,144 @@
+"""Content-addressed stage cache for the fused scan-to-print pipeline.
+
+Every pipeline stage is a pure function of (input bytes, config subtree), so
+its output can be keyed by a digest of exactly those inputs and reused across
+runs: an interrupted or re-invoked ``slscan pipeline`` resumes from the first
+stage whose inputs actually changed, paying zero decode/clean/merge/mesh
+compute for everything upstream of the edit.
+
+Key scheme (sha256, hex):
+
+  view stage   H(schema | stage | frame-file names+bytes | calib bytes |
+                 json(decode+triangulate+projector+clean config, steps,
+                 backend))
+  merge stage  H(schema | stage | per-view OUTPUT digests | json(merge cfg))
+  mesh stage   H(schema | stage | merged OUTPUT digest | json(mesh cfg))
+
+Chaining through *output* digests (not input keys) means a view recomputed
+to identical bytes still hits the merge cache, and any upstream change —
+frames, calibration, or the relevant config subtree — dirties every stage
+downstream of it and nothing else. Payloads are ``.npz`` files under
+``<out>/.slscan-cache/<stage>-<key16>.npz``; a corrupt or half-written entry
+reads as a miss (the write is tmp+rename, so interrupts cannot corrupt a
+published entry).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+__all__ = ["StageCache", "config_subtree"]
+
+# bump when a stage's numeric contract changes (payload layout, op
+# semantics): stale entries then read as misses instead of wrong hits
+_SCHEMA = "slscan-cache-v1"
+
+
+def config_subtree(cfg, sections: tuple[str, ...]) -> str:
+    """Canonical JSON of the config sections a stage's numbers depend on —
+    the 'relevant config subtree' part of every cache key."""
+    import dataclasses
+
+    return json.dumps(
+        {s: dataclasses.asdict(getattr(cfg, s)) for s in sections},
+        sort_keys=True)
+
+
+class StageCache:
+    """Filesystem-backed content-addressed cache with hit/miss accounting.
+
+    ``enabled=False`` turns every lookup into a miss and every put into a
+    no-op — one code path for cached and uncached runs.
+    """
+
+    def __init__(self, root: str, enabled: bool = True, log=None):
+        self.root = root
+        self.enabled = enabled
+        self._log = log or (lambda m: None)
+        self.hits: list[str] = []
+        self.misses: list[str] = []
+        if enabled:
+            os.makedirs(root, exist_ok=True)
+
+    # -- keys ------------------------------------------------------------
+
+    def key(self, stage: str, *, files: list[str] | None = None,
+            digests: list[str] | None = None,
+            arrays: dict[str, np.ndarray] | None = None,
+            config_json: str = "") -> str:
+        h = hashlib.sha256()
+        h.update(_SCHEMA.encode())
+        h.update(stage.encode())
+        for path in files or []:
+            h.update(os.path.basename(path).encode())
+            with open(path, "rb") as f:
+                h.update(f.read())
+        for d in digests or []:
+            h.update(d.encode())
+        for name in sorted(arrays or {}):
+            a = np.ascontiguousarray(arrays[name])
+            h.update(name.encode())
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+        h.update(config_json.encode())
+        return h.hexdigest()
+
+    @staticmethod
+    def digest_arrays(**arrays) -> str:
+        """Content digest of a stage OUTPUT — what downstream keys chain on."""
+        h = hashlib.sha256()
+        for name in sorted(arrays):
+            a = np.ascontiguousarray(arrays[name])
+            h.update(name.encode())
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+        return h.hexdigest()
+
+    # -- payloads --------------------------------------------------------
+
+    def _path(self, stage: str, key: str) -> str:
+        return os.path.join(self.root, f"{stage}-{key[:16]}.npz")
+
+    def get(self, stage: str, key: str) -> dict | None:
+        """Load a stage payload; None on any miss (absent, disabled, or
+        unreadable). Hits are logged — the resume trail the operator reads."""
+        if not self.enabled:
+            self.misses.append(stage)
+            return None
+        path = self._path(stage, key)
+        if not os.path.exists(path):
+            self.misses.append(stage)
+            return None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                if "__key__" not in z.files or str(z["__key__"]) != key:
+                    self.misses.append(stage)  # 16-hex-prefix collision
+                    return None
+                out = {k: z[k] for k in z.files if k != "__key__"}
+        except Exception as e:  # half-written/corrupt entry == miss
+            self._log(f"[cache] {stage}: unreadable entry ({e}); recomputing")
+            self.misses.append(stage)
+            return None
+        self.hits.append(stage)
+        self._log(f"[cache] {stage}: hit ({os.path.basename(path)})")
+        return out
+
+    def put(self, stage: str, key: str, **arrays) -> None:
+        if not self.enabled:
+            return
+        path = self._path(stage, key)
+        tmp = path + ".tmp"
+        np.savez(tmp, __key__=np.asarray(key), **arrays)
+        # np.savez appends .npz to names without it
+        if not os.path.exists(tmp) and os.path.exists(tmp + ".npz"):
+            tmp = tmp + ".npz"
+        os.replace(tmp, path)
+
+    def stats(self) -> dict:
+        return {"hits": len(self.hits), "misses": len(self.misses),
+                "hit_stages": list(self.hits)}

@@ -13,6 +13,7 @@ mesh_360/reconstruct_stl (processing.py:632-860).
 """
 from __future__ import annotations
 
+import json
 import os
 import re
 import time
@@ -28,8 +29,9 @@ from structured_light_for_3d_model_replication_tpu.ops import triangulate as tri
 from structured_light_for_3d_model_replication_tpu.utils import profiling as prof
 
 __all__ = [
-    "BatchReport", "reconstruct_source", "reconstruct", "clean_cloud",
-    "merge_views", "mesh_cloud", "sort_ply_paths_by_angle", "write_patterns",
+    "BatchReport", "PipelineReport", "reconstruct_source", "reconstruct",
+    "clean_cloud", "clean_batch", "merge_views", "mesh_cloud",
+    "run_pipeline", "sort_ply_paths_by_angle", "write_patterns",
 ]
 
 _DEG_RE = re.compile(r"(\d+(?:\.\d+)?)\s*deg", re.IGNORECASE)
@@ -176,19 +178,33 @@ def _out_path_for(src, mode: str, output: str | None) -> str:
 
 
 def _reconstruct_serial(sources, calib, cfg, scanner, mode, output, report,
-                        log) -> None:
+                        log, clean_steps=None, collect=None,
+                        write_plys=True) -> None:
     """The reference-shaped per-view loop: load, compute, write, one view at
     a time. Kept as the ``parallel.io_workers <= 1`` arm and the semantics
-    twin the pipelined executor is verified against."""
+    twin the pipelined executor is verified against.
+
+    ``clean_steps``/``collect``/``write_plys``: the fused-pipeline hooks,
+    identical contract to the pipelined executor — an optional masked clean
+    chain after compute, an in-memory per-view sink ``collect(idx, src,
+    pts, cols)``, and PLY emission demoted to an optional side output."""
     timer = prof.StageTimer()
-    for src in sources:
+    for idx, src in enumerate(sources):
         name = _item_name(src)
         try:
             with timer.stage(name), prof.trace():
                 pts, cols = reconstruct_source(src, calib, cfg, scanner)
-            out_path = _out_path_for(src, mode, output)
-            ply.write_ply(out_path, pts, cols)
-            log(f"[reconstruct] {name}: {len(pts):,} points -> {out_path}")
+                if clean_steps is not None:
+                    pts, cols, _ = _clean_arrays(pts, cols, cfg, clean_steps)
+            if write_plys:
+                out_path = _out_path_for(src, mode, output)
+                ply.write_ply(out_path, pts, cols)
+            else:
+                out_path = name
+            if collect is not None:
+                collect(idx, src, pts, cols)
+            log(f"[reconstruct] {name}: {len(pts):,} points -> "
+                f"{out_path if write_plys else 'in-memory handoff'}")
             report.outputs.append(out_path)
         except Exception as e:  # per-item tolerance (processing.py:323-330)
             from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (
@@ -206,8 +222,9 @@ def _reconstruct_serial(sources, calib, cfg, scanner, mode, output, report,
 
 
 def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
-                           log) -> None:
-    """Pipelined batch executor: three overlapped stages per view.
+                           log, clean_steps=None, collect=None,
+                           write_plys=True) -> None:
+    """Pipelined batch executor: three (or four) overlapped stages per view.
 
       load     — frame stacks prefetched on an ``io_workers`` thread pool,
                  at most ``prefetch_depth`` stacks in flight (backpressure:
@@ -215,9 +232,17 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
       compute  — the main thread dispatches view N+1's transfer+decode+
                  triangulate while view N is still in flight (JAX async
                  dispatch; the numpy backend computes inline instead)
+      clean    — (``clean_steps`` given — the fused pipeline) the drain
+                 worker runs the masked clean chain on view N while the
+                 main thread is already reconstructing view N+1; wall time
+                 lands in the OverlapStats ``clean`` lane
       write    — a drain worker pays the device sync (``compact_cloud``)
                  and hands the compacted arrays to ``ply.WritebackQueue``,
-                 so PLY encoding/disk never blocks the next dispatch
+                 so PLY encoding/disk never blocks the next dispatch.
+                 ``write_plys=False`` (the fused pipeline) skips the PLY
+                 side output entirely; ``collect(idx, src, pts, cols)``
+                 then receives each view's cleaned compact cloud in the
+                 drain thread.
 
     Per-item results are assembled strictly in source order at the end, so
     outputs/failed/summary are identical to ``_reconstruct_serial`` — only
@@ -251,13 +276,19 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
         stats.add("load", time.perf_counter() - t0)
         return out
 
-    def drain_one(cloud, out_path):
+    def drain_one(idx, src, cloud, out_path):
         # the device sync lives HERE, off the dispatch thread: compaction's
         # np.asarray blocks until the view's program retires
         t0 = time.perf_counter()
         pts, cols = tri.compact_cloud(cloud)
         stats.add("compute", time.perf_counter() - t0, items=1)
-        wfut = wbq.submit(out_path, pts, cols)
+        if clean_steps is not None:
+            t0 = time.perf_counter()
+            pts, cols, _ = _clean_arrays(pts, cols, cfg, clean_steps)
+            stats.add("clean", time.perf_counter() - t0)
+        wfut = wbq.submit(out_path, pts, cols) if write_plys else None
+        if collect is not None:
+            collect(idx, src, pts, cols)
         return out_path, len(pts), wfut
 
     t_wall = time.perf_counter()
@@ -299,8 +330,10 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
                         raise
                     results[idx] = ("fail", src, str(e))
                     continue
-                out_path = _out_path_for(src, mode, output)
-                dfut = drain_pool.submit(drain_one, cloud, out_path)
+                out_path = (_out_path_for(src, mode, output) if write_plys
+                            else _item_name(src))
+                dfut = drain_pool.submit(drain_one, idx, src, cloud,
+                                         out_path)
                 undrained.append(dfut)
                 results[idx] = ("done", dfut)
 
@@ -311,9 +344,10 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
                 if kind == "done":
                     try:
                         out_path, n_pts, wfut = rest[0].result()
-                        wfut.result()           # surface write errors
+                        if wfut is not None:
+                            wfut.result()       # surface write errors
                         log(f"[reconstruct] {name}: {n_pts:,} points -> "
-                            f"{out_path}")
+                            f"{out_path if wfut is not None else 'in-memory handoff'}")
                         report.outputs.append(out_path)
                         continue
                     except Exception as e:
@@ -332,6 +366,31 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
     report.overlap = stats.as_dict()
     prof.get_logger().debug("reconstruct pipeline overlap: %s",
                             stats.summary())
+
+
+def _build_scanner(sources, calib, cfg: Config):
+    """SLScanner for the fused device program, or None for the NumPy /
+    bitexact paths (which triangulate through the host twin). Shared by
+    ``reconstruct`` and ``run_pipeline``."""
+    # bitexact export triangulates through the NumPy twin in
+    # reconstruct_source, never the scanner's fused program
+    if cfg.parallel.backend == "numpy" or cfg.triangulate.bitexact:
+        return None
+    from structured_light_for_3d_model_replication_tpu.models.scanner import (
+        SLScanner,
+    )
+
+    first = imio.list_frame_files(sources[0])
+    probe = imio.load_gray(first[0])
+    return SLScanner(
+        calib, (probe.shape[1], probe.shape[0]),
+        proj_size=(cfg.decode.n_cols, cfg.decode.n_rows),
+        row_mode=cfg.triangulate.row_mode,
+        epipolar_tol=cfg.triangulate.epipolar_tol,
+        n_sets_col=cfg.decode.n_sets_col, n_sets_row=cfg.decode.n_sets_row,
+        downsample=cfg.projector.downsample,
+        plane_eval=cfg.triangulate.plane_eval,
+    )
 
 
 def reconstruct(calib_path: str, target: str, mode: str = "single",
@@ -356,24 +415,7 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
     if not sources:
         raise ValueError(f"no scan sources found under {target!r} (mode={mode})")
 
-    scanner = None
-    # bitexact export triangulates through the NumPy twin in
-    # reconstruct_source, never the scanner's fused program
-    if cfg.parallel.backend != "numpy" and not cfg.triangulate.bitexact:
-        from structured_light_for_3d_model_replication_tpu.models.scanner import (
-            SLScanner,
-        )
-        first = imio.list_frame_files(sources[0])
-        probe = imio.load_gray(first[0])
-        scanner = SLScanner(
-            calib, (probe.shape[1], probe.shape[0]),
-            proj_size=(cfg.decode.n_cols, cfg.decode.n_rows),
-            row_mode=cfg.triangulate.row_mode,
-            epipolar_tol=cfg.triangulate.epipolar_tol,
-            n_sets_col=cfg.decode.n_sets_col, n_sets_row=cfg.decode.n_sets_row,
-            downsample=cfg.projector.downsample,
-            plane_eval=cfg.triangulate.plane_eval,
-        )
+    scanner = _build_scanner(sources, calib, cfg)
 
     report = BatchReport()
     if output and mode != "single":
@@ -393,79 +435,133 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
 _CLEAN_STEPS = ("background", "cluster", "radius", "statistical")
 
 
+def _clean_arrays(pts: np.ndarray, cols: np.ndarray, cfg: Config,
+                  steps=_CLEAN_STEPS, log=None, step_callback=None):
+    """Masked-chain cleanup of one in-memory cloud; the single implementation
+    behind clean_cloud, the batch clean, and the fused pipeline's clean lane.
+
+    Runs ops/pointcloud.clean_chain: every step narrows a validity mask over
+    a _bucket_pad-padded fixed shape — ONE jitted program, one compile per
+    (bucket, params) pair across all views and reruns, host compaction only
+    once at the end. Returns (pts', cols', counts dict) with the same
+    counts/log/abort semantics the file-level chain always had."""
+    from structured_light_for_3d_model_replication_tpu.models.reconstruction import (
+        _bucket_pad,
+    )
+    from structured_light_for_3d_model_replication_tpu.ops import pointcloud as pc
+
+    log = log or (lambda m: None)
+    n = len(pts)
+    counts = {"input": n}
+    params = pc.chain_params(cfg.clean, tuple(steps))
+    if not params:
+        return pts, cols, counts
+    if cfg.parallel.backend == "numpy":
+        masks, cnts = pc.clean_chain_np(pts, np.ones(n, bool), cfg.clean,
+                                        tuple(steps))
+        masks, cnts = np.asarray(masks), np.asarray(cnts)
+    else:
+        import jax.numpy as jnp
+
+        bucket = _bucket_pad(n)
+        pts_pad = pts
+        if bucket > n:
+            pts_pad = np.concatenate(
+                [pts, np.full((bucket - n, 3), 1e9, np.float32)])
+        valid = np.arange(bucket) < n
+        masks_d, cnts_d = pc.clean_chain(jnp.asarray(pts_pad),
+                                         jnp.asarray(valid), cfg.clean,
+                                         tuple(steps))
+        masks = np.asarray(masks_d)[:, :n]
+        cnts = np.asarray(cnts_d)
+    final = masks[-1] if len(params) else np.ones(n, bool)
+    for i, (step, _) in enumerate(params):
+        counts[step] = int(cnts[i])
+        log(f"[clean] {step}: {int(cnts[i]):,} points remain")
+        if step_callback is not None:
+            step_callback(step, pts[masks[i]], cols[masks[i]])
+        if int(cnts[i]) == 0:
+            log("[clean] WARNING: all points removed; aborting chain")
+            final = masks[i]
+            break
+    return pts[final], cols[final], counts
+
+
 def clean_cloud(input_ply: str, output_ply: str, cfg: Config | None = None,
                 steps: tuple[str, ...] | list[str] = _CLEAN_STEPS,
                 log=print, step_callback=None) -> dict:
-    """Cleanup chain on one cloud: background plane removal -> largest cluster
-    -> radius outlier -> statistical outlier (the tab-3 chain, gui.py:1391-1522;
-    ops per processing.py:337-448). Steps are individually selectable.
-    ``step_callback(name, points, colors)`` receives each intermediate cloud
-    (the tab's in-memory per-step inspection flow, made non-blocking)."""
-    import jax.numpy as jnp
-
-    from structured_light_for_3d_model_replication_tpu.ops import pointcloud as pc
-
+    """Cleanup chain on one cloud PLY: background plane removal -> largest
+    cluster -> radius outlier -> statistical outlier (the tab-3 chain,
+    gui.py:1391-1522; ops per processing.py:337-448). Steps are individually
+    selectable. ``step_callback(name, points, colors)`` receives each
+    intermediate cloud (the tab's in-memory per-step inspection flow, made
+    non-blocking). The chain itself is the masked fixed-shape program
+    (ops/pointcloud.clean_chain) — this wrapper only adds the PLY boundary."""
     cfg = cfg or Config()
-    ccfg = cfg.clean
     data = ply.read_ply(input_ply)
     pts = np.asarray(data["points"], np.float32)
     cols = np.asarray(data.get("colors")) if data.get("colors") is not None \
         else np.zeros_like(pts, dtype=np.uint8)
-    use_np = cfg.parallel.backend == "numpy"
-    counts = {"input": len(pts)}
-
-    for step in steps:
-        if step not in _CLEAN_STEPS:
-            raise ValueError(f"unknown clean step {step!r}; valid: {_CLEAN_STEPS}")
-        valid = np.ones(len(pts), bool)
-        if step == "background" and ccfg.remove_background_plane:
-            # the reference keeps the INVERSE of the plane inliers
-            # (processing.py:349-354)
-            if use_np:
-                _, inliers = pc.segment_plane_np(
-                    pts, valid, distance_threshold=ccfg.plane_ransac_dist,
-                    num_iterations=ccfg.plane_ransac_trials)
-            else:
-                _, inliers = pc.segment_plane(
-                    jnp.asarray(pts), jnp.asarray(valid),
-                    distance_threshold=ccfg.plane_ransac_dist,
-                    num_iterations=ccfg.plane_ransac_trials)
-            keep = valid & ~np.asarray(inliers)
-        elif step == "cluster":
-            fn = pc.largest_cluster_mask_np if use_np else pc.largest_cluster_mask
-            keep = np.asarray(fn(pts if use_np else jnp.asarray(pts),
-                                 valid if use_np else jnp.asarray(valid),
-                                 eps=ccfg.cluster_eps,
-                                 min_points=ccfg.cluster_min_points))
-        elif step == "radius":
-            fn = pc.radius_outlier_mask_np if use_np else pc.radius_outlier_mask
-            keep = np.asarray(fn(pts if use_np else jnp.asarray(pts),
-                                 valid if use_np else jnp.asarray(valid),
-                                 radius=ccfg.radius,
-                                 nb_points=ccfg.radius_nb_points))
-        elif step == "statistical":
-            # degraded jax-on-CPU delegates inside the op itself to the
-            # cKDTree twin at production scale (see statistical_outlier_mask)
-            fn = (pc.statistical_outlier_mask_np if use_np
-                  else pc.statistical_outlier_mask)
-            keep = np.asarray(fn(pts if use_np else jnp.asarray(pts),
-                                 valid if use_np else jnp.asarray(valid),
-                                 ccfg.outlier_nb_neighbors,
-                                 ccfg.outlier_std_ratio))
-        else:
-            continue
-        pts, cols = pts[keep], cols[keep]
-        counts[step] = len(pts)
-        log(f"[clean] {step}: {len(pts):,} points remain")
-        if step_callback is not None:
-            step_callback(step, pts, cols)
-        if len(pts) == 0:
-            log("[clean] WARNING: all points removed; aborting chain")
-            break
-
+    pts, cols, counts = _clean_arrays(pts, cols, cfg, tuple(steps), log=log,
+                                      step_callback=step_callback)
     ply.write_ply(output_ply, pts, cols)
     log(f"[clean] wrote {output_ply} ({len(pts):,} points)")
     return counts
+
+
+def clean_batch(input_folder: str, output_folder: str,
+                cfg: Config | None = None,
+                steps: tuple[str, ...] | list[str] = _CLEAN_STEPS,
+                log=print) -> BatchReport:
+    """Clean every PLY in a folder: reads on the shared I/O pool, the masked
+    chain per cloud (clouds sharing a _bucket_pad bucket share ONE compile),
+    writes on the WritebackQueue — the batch twin of clean_cloud with
+    reconstruct's per-item failure tolerance."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    cfg = cfg or Config()
+    paths = sorted(os.path.join(input_folder, f)
+                   for f in os.listdir(input_folder)
+                   if f.lower().endswith(".ply"))
+    if not paths:
+        raise ValueError(f"no .ply files in {input_folder!r}")
+    os.makedirs(output_folder, exist_ok=True)
+    report = BatchReport()
+    t0 = time.monotonic()
+    workers = max(1, cfg.parallel.io_workers)
+    with ThreadPoolExecutor(max_workers=min(workers, len(paths)),
+                            thread_name_prefix="sl3d-cleanread") as pool, \
+            ply.WritebackQueue() as wbq:
+        reads = [(p, pool.submit(ply.read_ply, p)) for p in paths]
+        pend = []
+        for src, fut in reads:
+            name = os.path.basename(src)
+            try:
+                data = fut.result()
+                pts = np.asarray(data["points"], np.float32)
+                cols = (np.asarray(data["colors"])
+                        if data.get("colors") is not None
+                        else np.zeros_like(pts, dtype=np.uint8))
+                pts, cols, counts = _clean_arrays(pts, cols, cfg,
+                                                  tuple(steps))
+                out_path = os.path.join(output_folder, name)
+                pend.append((src, out_path, len(pts),
+                             wbq.submit(out_path, pts, cols)))
+            except Exception as e:
+                log(f"[clean] {name} FAILED: {e}")
+                report.failed.append((src, str(e)))
+        for src, out_path, n_pts, wfut in pend:
+            try:
+                wfut.result()
+                log(f"[clean] {os.path.basename(src)}: {n_pts:,} points -> "
+                    f"{out_path}")
+                report.outputs.append(out_path)
+            except Exception as e:
+                log(f"[clean] {os.path.basename(src)} FAILED: {e}")
+                report.failed.append((src, str(e)))
+    report.elapsed_s = time.monotonic() - t0
+    log(f"[clean] {report.summary}")
+    return report
 
 
 def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
@@ -537,21 +633,16 @@ def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
     return points, colors, transforms
 
 
-def mesh_cloud(input_ply: str, output_path: str, cfg: Config | None = None,
-               save_normals_path: str | None = None, log=print):
-    """Cloud PLY -> mesh (.stl or .ply by extension): reconstruct_stl/mesh_360
-    parity including the optional normals debug dump (processing.py:690-693)."""
+def _mesh_arrays(pts: np.ndarray, cfg: Config, log=print, normals=None):
+    """Cloud arrays -> (verts, faces): the meshing stage without the PLY
+    boundary — normals estimated+oriented when not supplied. Shared by
+    mesh_cloud and the fused pipeline."""
     import jax.numpy as jnp
 
     from structured_light_for_3d_model_replication_tpu.models import meshing
     from structured_light_for_3d_model_replication_tpu.ops import normals as nrm
 
-    cfg = cfg or Config()
-    data = ply.read_ply(input_ply)
-    pts = np.asarray(data["points"], np.float32)
     valid = np.ones(len(pts), bool)
-
-    normals = data.get("normals")
     if normals is None:
         nr = nrm.estimate_normals(jnp.asarray(pts), jnp.asarray(valid),
                                   k=cfg.mesh.normal_max_nn,
@@ -561,19 +652,230 @@ def mesh_cloud(input_ply: str, output_path: str, cfg: Config | None = None,
         normals = np.asarray(nr)
         log(f"[mesh] estimated normals (k={cfg.mesh.normal_max_nn}, "
             f"{cfg.mesh.orientation} orientation)")
-    if save_normals_path:
-        ply.write_ply(save_normals_path, pts, data.get("colors"), normals)
-        log(f"[mesh] normals debug cloud -> {save_normals_path}")
-
     with prof.trace():
         verts, faces = meshing.reconstruct_mesh(pts, valid, normals,
                                                 cfg=cfg.mesh, log=log)
+    return np.asarray(verts), np.asarray(faces), normals
+
+
+def _write_mesh(output_path: str, verts, faces, log=print):
+    from structured_light_for_3d_model_replication_tpu.models import meshing
+
     if output_path.lower().endswith(".stl"):
         meshing.mesh_to_stl(output_path, verts, faces)
     else:
         ply.write_mesh_ply(output_path, verts, faces)
     log(f"[mesh] wrote {output_path} ({len(verts):,} verts, {len(faces):,} faces)")
+
+
+def mesh_cloud(input_ply: str, output_path: str, cfg: Config | None = None,
+               save_normals_path: str | None = None, log=print):
+    """Cloud PLY -> mesh (.stl or .ply by extension): reconstruct_stl/mesh_360
+    parity including the optional normals debug dump (processing.py:690-693)."""
+    cfg = cfg or Config()
+    data = ply.read_ply(input_ply)
+    pts = np.asarray(data["points"], np.float32)
+
+    verts, faces, normals = _mesh_arrays(pts, cfg, log=log,
+                                         normals=data.get("normals"))
+    if save_normals_path:
+        ply.write_ply(save_normals_path, pts, data.get("colors"), normals)
+        log(f"[mesh] normals debug cloud -> {save_normals_path}")
+    _write_mesh(output_path, verts, faces, log=log)
     return verts, faces
+
+
+@dataclass
+class PipelineReport:
+    """Accounting for one fused scan-to-print run."""
+
+    merged_ply: str | None = None
+    stl_path: str | None = None
+    views_computed: int = 0
+    views_cached: int = 0
+    failed: list[tuple[str, str]] = field(default_factory=list)
+    merge_status: str = ""          # 'computed' | 'cache-hit'
+    mesh_status: str = ""
+    merged_points: int = 0
+    overlap: dict | None = None     # executor lanes incl. the clean lane
+    cache: dict | None = None       # StageCache.stats()
+    elapsed_s: float = 0.0
+
+    @property
+    def summary(self) -> str:
+        return (f"{self.views_computed} views computed + "
+                f"{self.views_cached} cached, merge {self.merge_status}, "
+                f"mesh {self.mesh_status}, {self.merged_points:,} points "
+                f"in {self.elapsed_s:.1f}s")
+
+
+def run_pipeline(calib_path: str, target: str, out_dir: str,
+                 cfg: Config | None = None,
+                 steps: tuple[str, ...] | list[str] = _CLEAN_STEPS,
+                 merged_name: str = "merged.ply",
+                 stl_name: str = "model.stl", log=print) -> PipelineReport:
+    """The fused scan-to-print command: reconstruct -> per-view masked clean
+    -> merge-360 -> mesh, end to end in ONE process with device-resident
+    handoff — per-view clouds flow from the pipelined executor's clean lane
+    into ``merge_360`` as a ``DeviceClouds`` stack, and the merged cloud is
+    meshed from memory. No stage reads another stage's PLY: PLY/STL emission
+    is a side output (intermediates always binary; the final merged PLY
+    honors ``pipeline.ascii_output``).
+
+    Every stage sits behind the content-addressed StageCache under
+    ``<out_dir>/.slscan-cache``: a rerun (after an interrupt, or after
+    editing frames/calibration/config) recomputes only the stages whose
+    inputs changed — a fully-warm rerun does zero decode/clean/merge/mesh
+    compute and just re-emits the artifacts.
+    """
+    from structured_light_for_3d_model_replication_tpu.models import (
+        reconstruction as recon,
+    )
+    from structured_light_for_3d_model_replication_tpu.pipeline.stagecache import (
+        StageCache, config_subtree,
+    )
+
+    cfg = cfg or Config()
+    t_start = time.monotonic()
+    calib = matfile.load_calibration(calib_path)
+    need = gc.frames_per_view(cfg.decode.n_cols, cfg.decode.n_rows,
+                              cfg.projector.downsample)
+    sources = _scan_sources(target, "batch", need, log=log)
+    if len(sources) < 2:
+        raise ValueError(
+            f"pipeline needs >= 2 scan views under {target!r}, found "
+            f"{len(sources)}")
+    # the merge chain is angle-ordered; scan folders carry the same
+    # '<n>deg' tag the per-view PLYs would, so the fused run and the
+    # discrete reconstruct->merge-360 chain see the views in one order
+    sources = sort_ply_paths_by_angle(sources)
+    os.makedirs(out_dir, exist_ok=True)
+    report = PipelineReport()
+    cache = StageCache(os.path.join(out_dir, ".slscan-cache"),
+                       enabled=cfg.pipeline.cache, log=log)
+
+    # ---- stage 1+2: per-view reconstruct + masked clean -----------------
+    steps = tuple(steps)
+    view_cfg = config_subtree(cfg, ("decode", "triangulate", "projector",
+                                    "clean")) + json.dumps(
+        {"steps": list(steps), "backend": cfg.parallel.backend})
+    view_keys: list[str] = []
+    collected: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+    missing: list[tuple[int, str]] = []
+    for i, src in enumerate(sources):
+        key = cache.key("view", files=[calib_path] + imio.list_frame_files(src),
+                        config_json=view_cfg)
+        view_keys.append(key)
+        hit = cache.get("view", key)
+        if hit is not None:
+            collected[i] = (np.asarray(hit["points"], np.float32),
+                            np.asarray(hit["colors"], np.uint8))
+        else:
+            missing.append((i, src))
+    report.views_cached = len(collected)
+
+    if missing:
+        miss_sources = [s for _, s in missing]
+        scanner = _build_scanner(miss_sources, calib, cfg)
+        view_dir = None
+        if cfg.pipeline.write_view_plys:
+            view_dir = os.path.join(out_dir, "views")
+            os.makedirs(view_dir, exist_ok=True)
+
+        def collect(j, src, pts, cols):
+            i = missing[j][0]
+            collected[i] = (pts, cols)
+            cache.put("view", view_keys[i], points=pts, colors=cols)
+
+        batch = BatchReport()
+        run_args = (miss_sources, calib, cfg, scanner, "batch", view_dir,
+                    batch, log)
+        kw = dict(clean_steps=steps, collect=collect,
+                  write_plys=cfg.pipeline.write_view_plys)
+        if cfg.parallel.io_workers > 1 and len(miss_sources) > 1:
+            _reconstruct_pipelined(*run_args, **kw)
+        else:
+            _reconstruct_serial(*run_args, **kw)
+        report.failed = batch.failed
+        report.overlap = batch.overlap
+    report.views_computed = len(collected) - report.views_cached
+    if len(collected) < 2:
+        raise ValueError(
+            f"pipeline: only {len(collected)} views survived reconstruction "
+            f"(failed: {[os.path.basename(s) for s, _ in report.failed]})")
+
+    # ---- stage 3: merge-360 (device-resident handoff) -------------------
+    order = sorted(collected)
+    view_digests = [StageCache.digest_arrays(points=collected[i][0],
+                                             colors=collected[i][1])
+                    for i in order]
+    merge_cfg = config_subtree(cfg, ("merge",)) + json.dumps(
+        {"backend": cfg.parallel.backend,
+         "force_bf16": cfg.parallel.force_bf16_features,
+         "merge_mesh": cfg.parallel.merge_mesh})
+    merge_key = cache.key("merge", digests=view_digests,
+                          config_json=merge_cfg)
+    hit = cache.get("merge", merge_key)
+    merged_path = os.path.join(out_dir, merged_name)
+    if hit is not None:
+        points = np.asarray(hit["points"], np.float32)
+        colors = np.asarray(hit["colors"], np.uint8)
+        transforms = [t for t in np.asarray(hit["transforms"])]
+        report.merge_status = "cache-hit"
+    else:
+        clouds = [collected[i] for i in order]
+        mesh_grid = None
+        if cfg.parallel.merge_mesh:
+            from structured_light_for_3d_model_replication_tpu.parallel import (
+                mesh as meshlib,
+            )
+
+            mesh_grid = meshlib.merge_mesh(cfg.parallel)
+        fb16 = True if cfg.parallel.force_bf16_features else None
+        with prof.trace():
+            if cfg.merge.method == "posegraph":
+                points, colors, transforms = recon.merge_360_posegraph(
+                    clouds, cfg.merge, log=log, mesh=mesh_grid,
+                    feat_bf16=fb16)
+            else:
+                # DeviceClouds: the per-view clean -> merge handoff stays
+                # in accelerator memory (one compact upload on a host
+                # executor; zero re-upload when the views are resident)
+                dcv = recon.stack_views_device(clouds)
+                points, colors, transforms = recon.merge_360(
+                    dcv, cfg.merge, log=log, mesh=mesh_grid, feat_bf16=fb16)
+        points = np.asarray(points, np.float32)
+        colors = np.asarray(colors, np.uint8)
+        cache.put("merge", merge_key, points=points, colors=colors,
+                  transforms=np.stack([np.asarray(t) for t in transforms]))
+        report.merge_status = "computed"
+    ply.write_ply(merged_path, points, colors,
+                  binary=not cfg.pipeline.ascii_output)
+    log(f"[pipeline] merged cloud -> {merged_path} ({len(points):,} points)")
+    report.merged_ply = merged_path
+    report.merged_points = len(points)
+
+    # ---- stage 4: mesh -> STL ------------------------------------------
+    merged_digest = StageCache.digest_arrays(points=points)
+    mesh_key = cache.key("mesh", digests=[merged_digest],
+                         config_json=config_subtree(cfg, ("mesh",)))
+    hit = cache.get("mesh", mesh_key)
+    if hit is not None:
+        verts = np.asarray(hit["verts"], np.float32)
+        faces = np.asarray(hit["faces"], np.int32)
+        report.mesh_status = "cache-hit"
+    else:
+        verts, faces, _ = _mesh_arrays(points, cfg, log=log)
+        cache.put("mesh", mesh_key, verts=verts, faces=faces)
+        report.mesh_status = "computed"
+    stl_path = os.path.join(out_dir, stl_name)
+    _write_mesh(stl_path, verts, faces, log=log)
+    report.stl_path = stl_path
+
+    report.cache = cache.stats()
+    report.elapsed_s = time.monotonic() - t_start
+    log(f"[pipeline] {report.summary}")
+    return report
 
 
 def write_patterns(out_dir: str, cfg: Config | None = None, log=print) -> list[str]:

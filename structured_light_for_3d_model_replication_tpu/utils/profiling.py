@@ -117,7 +117,9 @@ class StageTimer:
 
 
 class OverlapStats:
-    """Overlap accounting for a pipelined executor (load / compute / write).
+    """Overlap accounting for a pipelined executor (load / compute / clean /
+    write — the clean lane is zero unless the executor runs the fused
+    pipeline's per-view cleanup stage).
 
     Worker threads accumulate per-stage wall time with ``add``; the owner
     stamps the end-to-end wall with ``finish``. The win of a pipeline is
@@ -130,7 +132,7 @@ class OverlapStats:
     ahead and the bound is doing its job).
     """
 
-    _STAGES = ("load", "compute", "write")
+    _STAGES = ("load", "compute", "clean", "write")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -174,9 +176,10 @@ class OverlapStats:
 
     def summary(self) -> str:
         d = self.as_dict()
-        return (f"load {d['load_s']}s + compute {d['compute_s']}s + write "
-                f"{d['write_s']}s = {d['serial_sum_s']}s serial-equivalent "
-                f"in {d['critical_path_s']}s wall "
+        clean = (f" + clean {d['clean_s']}s" if d.get("clean_s") else "")
+        return (f"load {d['load_s']}s + compute {d['compute_s']}s{clean}"
+                f" + write {d['write_s']}s = {d['serial_sum_s']}s "
+                f"serial-equivalent in {d['critical_path_s']}s wall "
                 f"(overlap x{d['overlap_ratio']}, queue depth "
                 f"max {d['max_queue_depth']} mean {d['mean_queue_depth']})")
 

@@ -21,6 +21,41 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _isolate_process_global_state():
+    """Undo the process-global state some product paths legitimately latch.
+
+    The warmup command (and bench/tools twins) point the PERSISTENT compile
+    cache at their own directory via jax.config.update — left latched, every
+    later test writes executables into a deleted tmp dir. The CLI's numpy/cpu
+    backend pin records itself on ``_cfg._cpu_pinned`` so a later accelerator
+    request can warn — across tests that advisory is stale state. Restoring
+    both after every test keeps the suite order-independent (satellite of the
+    order-dependence fix, 2026-08-04)."""
+    import jax
+
+    cache_dir = jax.config.jax_compilation_cache_dir
+    min_compile = jax.config.jax_persistent_cache_min_compile_time_secs
+    yield
+    if jax.config.jax_compilation_cache_dir != cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        try:  # drop the latched cache object pointing at the test's tmp dir
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+    if jax.config.jax_persistent_cache_min_compile_time_secs != min_compile:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile)
+    from structured_light_for_3d_model_replication_tpu.pipeline import (
+        cli_commands,
+    )
+
+    if getattr(cli_commands._cfg, "_cpu_pinned", False):
+        del cli_commands._cfg._cpu_pinned
+
+
 @pytest.fixture()
 def rng(request):
     # per-test stream seeded from the test's name: data no longer depends on

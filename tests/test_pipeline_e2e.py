@@ -1,0 +1,258 @@
+"""Fused ``slscan pipeline`` contract: output parity with the discrete
+reconstruct -> clean -> merge-360 -> mesh command chain, the content-addressed
+stage cache (full-hit reruns do zero stage compute; interrupted runs resume),
+and the masked clean chain's one-compile-per-bucket guarantee."""
+import os
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.cli import main as cli_main
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io import ply as plyio
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+STEPS = ("statistical",)  # tiny clouds carry no dominant RANSAC plane
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("e2eds"))
+    rc = cli_main(["synth", root, "--views", "3",
+                   "--cam", "160x120", "--proj", "128x64"])
+    assert rc == 0
+    return root
+
+
+def _cfg() -> Config:
+    cfg = Config()
+    cfg.decode.n_cols, cfg.decode.n_rows = 128, 64
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 512
+    cfg.merge.icp_iters = 10
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def fused_out(dataset, tmp_path_factory):
+    """One fused run, shared by the parity and cache tests (the cache test
+    reruns against the same out dir)."""
+    out = str(tmp_path_factory.mktemp("fused"))
+    calib = os.path.join(dataset, "calib.mat")
+    rep = stages.run_pipeline(calib, dataset, out, cfg=_cfg(), steps=STEPS,
+                              log=lambda m: None)
+    assert rep.failed == []
+    assert rep.views_computed == 3 and rep.views_cached == 0
+    assert rep.merge_status == "computed" and rep.mesh_status == "computed"
+    return out, rep
+
+
+def test_fused_pipeline_matches_discrete_chain(dataset, fused_out, tmp_path):
+    """ISSUE acceptance: the fused command's merged cloud / STL is equivalent
+    to the discrete reconstruct -> clean -> merge-360 -> mesh chain (same
+    point multiset within float tolerance) — and zero PLY parses happen on
+    the fused path (counted at the reader)."""
+    calib = os.path.join(dataset, "calib.mat")
+    vdir = tmp_path / "views"
+    rep = stages.reconstruct(calib, dataset, mode="batch", output=str(vdir),
+                             cfg=_cfg(), log=lambda m: None)
+    assert rep.failed == []
+    cdir = tmp_path / "cleaned"
+    cdir.mkdir()
+    for f in sorted(os.listdir(vdir)):
+        stages.clean_cloud(str(vdir / f), str(cdir / f), cfg=_cfg(),
+                           steps=STEPS, log=lambda m: None)
+    merged_d = str(tmp_path / "merged_discrete.ply")
+    stages.merge_views(str(cdir), merged_d, cfg=_cfg(), log=lambda m: None)
+    stl_d = str(tmp_path / "model_discrete.stl")
+    stages.mesh_cloud(merged_d, stl_d, cfg=_cfg(), log=lambda m: None)
+
+    out, frep = fused_out
+    pd = plyio.read_ply(merged_d)["points"]
+    pf = plyio.read_ply(frep.merged_ply)["points"]
+    assert pd.shape == pf.shape
+    sd = pd[np.lexsort(pd.T)]
+    sf = pf[np.lexsort(pf.T)]
+    np.testing.assert_allclose(sd, sf, atol=1e-4)
+    with open(stl_d, "rb") as fa, open(frep.stl_path, "rb") as fb:
+        assert fa.read() == fb.read()
+
+
+def test_fused_pipeline_zero_intermediate_ply_parses(dataset, tmp_path,
+                                                     monkeypatch):
+    calls = {"n": 0}
+    real_read = plyio.read_ply
+
+    def counting_read(path):
+        calls["n"] += 1
+        return real_read(path)
+
+    monkeypatch.setattr(plyio, "read_ply", counting_read)
+    rep = stages.run_pipeline(os.path.join(dataset, "calib.mat"), dataset,
+                              str(tmp_path / "out"), cfg=_cfg(), steps=STEPS,
+                              log=lambda m: None)
+    assert rep.failed == []
+    assert calls["n"] == 0, "fused pipeline parsed an intermediate PLY"
+
+
+def test_second_run_hits_every_stage_cache(dataset, fused_out, monkeypatch):
+    """ISSUE acceptance: the rerun skips every stage (logged cache hits) and
+    does ZERO stage compute — decode/clean, merge, and mesh are all
+    poisoned to raise, and the artifacts come out byte-identical."""
+    out, rep1 = fused_out
+    merged_bytes = open(rep1.merged_ply, "rb").read()
+    stl_bytes = open(rep1.stl_path, "rb").read()
+
+    from structured_light_for_3d_model_replication_tpu.models import (
+        reconstruction as recon,
+    )
+
+    def boom(*a, **k):
+        raise AssertionError("stage compute ran on a fully-cached rerun")
+
+    monkeypatch.setattr(stages, "_compute_cloud", boom)
+    monkeypatch.setattr(stages, "_mesh_arrays", boom)
+    monkeypatch.setattr(recon, "merge_360", boom)
+    monkeypatch.setattr(recon, "merge_360_posegraph", boom)
+
+    logs = []
+    rep2 = stages.run_pipeline(os.path.join(dataset, "calib.mat"), dataset,
+                               out, cfg=_cfg(), steps=STEPS, log=logs.append)
+    assert rep2.views_cached == 3 and rep2.views_computed == 0
+    assert rep2.merge_status == "cache-hit" and rep2.mesh_status == "cache-hit"
+    assert rep2.cache["misses"] == 0 and rep2.cache["hits"] == 5
+    assert sum("hit" in m for m in logs if "[cache]" in m) == 5
+    assert open(rep2.merged_ply, "rb").read() == merged_bytes
+    assert open(rep2.stl_path, "rb").read() == stl_bytes
+
+
+def test_interrupted_run_resumes_from_view_cache(dataset, tmp_path,
+                                                 monkeypatch):
+    """Kill the run after the per-view stage (the merge raises, standing in
+    for an interrupt): the rerun must reuse every per-view entry and only
+    recompute from the first dirty stage."""
+    out = str(tmp_path / "out")
+    calib = os.path.join(dataset, "calib.mat")
+    from structured_light_for_3d_model_replication_tpu.models import (
+        reconstruction as recon,
+    )
+
+    real_merge = recon.merge_360
+    monkeypatch.setattr(recon, "merge_360",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("simulated interrupt")))
+    with pytest.raises(RuntimeError, match="simulated interrupt"):
+        stages.run_pipeline(calib, dataset, out, cfg=_cfg(), steps=STEPS,
+                            log=lambda m: None)
+    monkeypatch.setattr(recon, "merge_360", real_merge)
+
+    # views must NOT recompute on resume
+    monkeypatch.setattr(stages, "_compute_cloud",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            AssertionError("view stage recomputed")))
+    rep = stages.run_pipeline(calib, dataset, out, cfg=_cfg(), steps=STEPS,
+                              log=lambda m: None)
+    assert rep.views_cached == 3 and rep.views_computed == 0
+    assert rep.merge_status == "computed" and rep.mesh_status == "computed"
+
+
+def test_config_change_dirties_downstream_stages_only(dataset, tmp_path):
+    """Content addressing: tightening the MESH config reuses the view and
+    merge caches; the mesh stage alone recomputes."""
+    out = str(tmp_path / "out")
+    calib = os.path.join(dataset, "calib.mat")
+    stages.run_pipeline(calib, dataset, out, cfg=_cfg(), steps=STEPS,
+                        log=lambda m: None)
+    cfg2 = _cfg()
+    cfg2.mesh.depth = 4
+    rep = stages.run_pipeline(calib, dataset, out, cfg=cfg2, steps=STEPS,
+                              log=lambda m: None)
+    assert rep.views_cached == 3
+    assert rep.merge_status == "cache-hit"
+    assert rep.mesh_status == "computed"
+
+
+def test_clean_chain_compiles_once_per_bucket(rng):
+    """ISSUE acceptance: running the masked chain over many same-bucket
+    views triggers no per-view retrace — one executable serves them all."""
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pointcloud as pc,
+    )
+
+    cfg = Config()
+    cfg.clean.cluster_eps = 2.0
+    cfg.clean.cluster_min_points = 10
+    before = pc._clean_chain_jit._cache_size()
+    counts = []
+    for n in (3000, 2500, 2900, 3700):  # all pad to the same 4096 bucket
+        pts = rng.normal(0, 2.0, (n, 3)).astype(np.float32)
+        out_p, _, cnt = stages._clean_arrays(
+            pts, np.zeros((n, 3), np.uint8), cfg,
+            steps=("cluster", "statistical"))
+        counts.append(cnt)
+        assert 0 < len(out_p) <= n
+    after = pc._clean_chain_jit._cache_size()
+    assert after - before <= 1, (
+        f"clean chain retraced per view: cache {before} -> {after}")
+
+
+def test_clean_batch_matches_per_file_clean(dataset, tmp_path):
+    """Folder mode of the clean CLI: same bytes as cleaning each file
+    individually, reads on the I/O pool, per-item accounting."""
+    calib = os.path.join(dataset, "calib.mat")
+    vdir = tmp_path / "views"
+    stages.reconstruct(calib, dataset, mode="batch", output=str(vdir),
+                       cfg=_cfg(), log=lambda m: None)
+    single = tmp_path / "single"
+    single.mkdir()
+    for f in sorted(os.listdir(vdir)):
+        stages.clean_cloud(str(vdir / f), str(single / f), cfg=_cfg(),
+                           steps=STEPS, log=lambda m: None)
+    batch = tmp_path / "batch"
+    rep = stages.clean_batch(str(vdir), str(batch), cfg=_cfg(), steps=STEPS,
+                             log=lambda m: None)
+    assert rep.failed == [] and len(rep.outputs) == 3
+    for f in sorted(os.listdir(single)):
+        assert (batch / f).read_bytes() == (single / f).read_bytes()
+
+
+def test_pipeline_cli_and_print_alias(dataset, tmp_path):
+    out = str(tmp_path / "cli_out")
+    common = ["--calib", os.path.join(dataset, "calib.mat"),
+              "--steps", "statistical",
+              "--set", "decode.n_cols=128", "--set", "decode.n_rows=64",
+              "--set", "decode.thresh_mode=manual",
+              "--set", "merge.voxel_size=4.0",
+              "--set", "merge.ransac_trials=512",
+              "--set", "merge.icp_iters=10",
+              "--set", "mesh.depth=5",
+              "--set", "mesh.density_trim_quantile=0"]
+    rc = cli_main(["pipeline", dataset, "--out", out] + common)
+    assert rc == 0
+    assert os.path.exists(os.path.join(out, "merged.ply"))
+    assert os.path.exists(os.path.join(out, "model.stl"))
+    assert os.path.isdir(os.path.join(out, ".slscan-cache"))
+    # the alias resolves to the same runner and hits the same cache
+    rc = cli_main(["print", dataset, "--out", out] + common)
+    assert rc == 0
+
+
+def test_view_plys_side_output_is_binary_even_with_ascii(dataset, tmp_path):
+    """Satellite: intermediate pipeline writes stay binary regardless of the
+    user-facing ASCII flag; only the final merged PLY honors it."""
+    out = str(tmp_path / "out")
+    cfg = _cfg()
+    cfg.pipeline.write_view_plys = True
+    cfg.pipeline.ascii_output = True
+    rep = stages.run_pipeline(os.path.join(dataset, "calib.mat"), dataset,
+                              out, cfg=cfg, steps=STEPS, log=lambda m: None)
+    views = sorted(os.listdir(os.path.join(out, "views")))
+    assert len(views) == 3
+    for v in views:
+        with open(os.path.join(out, "views", v), "rb") as f:
+            assert b"binary_little_endian" in f.read(128)
+    with open(rep.merged_ply, "rb") as f:
+        assert b"format ascii" in f.read(128)

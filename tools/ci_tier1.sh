@@ -1,5 +1,29 @@
 #!/usr/bin/env bash
 # Tier-1 verify — the ROADMAP.md command, verbatim. Run from the repo root.
 # Prints DOTS_PASSED=<n> after the pytest summary; exit code is pytest's.
+# Afterwards: records DOTS_PASSED into a log artifact (tools/_ci/tier1_dots.log)
+# and runs the pipeline bench smoke (`python bench.py --pipeline-only`) — no
+# thresholds, just "completes and the fused/serial outputs are identical".
 cd "$(dirname "$0")/.." || exit 1
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}
+dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+echo DOTS_PASSED=$dots
+
+# ---- log artifact: one line per run, so regressions are greppable ----
+mkdir -p tools/_ci
+echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) DOTS_PASSED=$dots rc=$rc" >> tools/_ci/tier1_dots.log
+
+# ---- pipeline smoke: completes + byte-identical outputs (no thresholds) ----
+smoke_rc=0
+smoke=$(timeout -k 10 870 env JAX_PLATFORMS=cpu python bench.py --pipeline-only 2>/dev/null) || smoke_rc=$?
+echo "$smoke" > tools/_ci/pipeline_smoke.json
+if [ $smoke_rc -eq 0 ] \
+   && echo "$smoke" | grep -q '"outputs_identical": true' \
+   && echo "$smoke" | grep -q '"stl_identical": true' \
+   && echo "$smoke" | grep -q '"equivalent": true'; then
+  echo "PIPELINE_SMOKE=ok"
+else
+  echo "PIPELINE_SMOKE=FAIL (rc=$smoke_rc; see tools/_ci/pipeline_smoke.json)"
+  [ $rc -eq 0 ] && rc=1
+fi
+exit $rc
