@@ -399,6 +399,101 @@ def bench_pipeline_e2e(views: int = PIPE_VIEWS) -> dict:
     return out
 
 
+def bench_pipeline_faults(views: int = PIPE_VIEWS) -> dict:
+    """Resilience-layer cost on the fused pipeline (ISSUE 3 acceptance).
+
+    Arm A (``disabled_s``): the fault layer wired through every site but
+    with NO plan armed — each ``fire()`` is a single None check. This must
+    sit within run-to-run noise of the ``pipeline_e2e`` fused arm (the
+    zero-overhead-by-default contract; the --pipeline-only record carries
+    the ratio).
+
+    Arm B (``faulted_s``): the seeded chaos plan — one transient
+    ``frame.load`` fault (absorbed by a backoff retry) plus one permanent
+    ``compute.view`` fault (view quarantined) — must complete DEGRADED with
+    exactly one failure, still emitting the merged STL. The delta over arm
+    A is the recovery bill (backoff sleeps + the wasted attempt)."""
+    import shutil
+    import tempfile
+
+    from structured_light_for_3d_model_replication_tpu.config import Config
+    from structured_light_for_3d_model_replication_tpu.io import images as imio
+    from structured_light_for_3d_model_replication_tpu.io import matfile
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+    from structured_light_for_3d_model_replication_tpu.utils import faults
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        synthetic as syn,
+    )
+
+    out: dict = {"views": views, "backend": "numpy",
+                 "host_cpus": os.cpu_count()}
+    tmp = tempfile.mkdtemp(prefix="slbench_faults_")
+    try:
+        rig = syn.default_rig(cam_size=PIPE_CAM, proj_size=PIPE_PROJ)
+        scene = syn.sphere_on_background()
+        obj, background = scene.objects
+        calib_path = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(calib_path, rig.calibration())
+        root = os.path.join(tmp, "scans")
+        os.makedirs(root)
+        step = 360.0 / views
+        pivot = np.array([0.0, 0.0, 420.0])
+        view_names = []
+        for i, (R, t) in enumerate(syn.turntable_poses(views, step, pivot)):
+            frames, _ = syn.render_scene(
+                rig, syn.Scene([obj.transformed(R, t), background]))
+            name = f"scan_{int(round(i * step)):03d}deg_scan"
+            view_names.append(name)
+            imio.save_stack(os.path.join(root, name), frames)
+
+        def cfg():
+            c = Config()
+            c.parallel.backend = "numpy"
+            c.decode.n_cols, c.decode.n_rows = PIPE_PROJ
+            c.decode.thresh_mode = "manual"
+            c.merge.voxel_size = 4.0
+            c.merge.ransac_trials = 512
+            c.merge.icp_iters = 10
+            c.mesh.depth = 5
+            c.mesh.density_trim_quantile = 0.0
+            return c
+
+        steps = ("statistical",)
+        # ---- arm A: fault layer present, disarmed ----
+        faults.reset()
+        t0 = time.perf_counter()
+        rep = stages.run_pipeline(calib_path, root,
+                                  os.path.join(tmp, "clean"), cfg=cfg(),
+                                  steps=steps, log=lambda m: None)
+        out["disabled_s"] = round(time.perf_counter() - t0, 4)
+        assert not rep.failed, rep.failed
+
+        # ---- arm B: seeded chaos (1 transient load + 1 permanent view) ----
+        transient_view = view_names[0]
+        permanent_view = view_names[views // 2]
+        spec = (f"frame.load~{transient_view}:transient,"
+                f"compute.view~{permanent_view}:permanent")
+        faults.configure(spec, seed=0)
+        t0 = time.perf_counter()
+        rep2 = stages.run_pipeline(calib_path, root,
+                                   os.path.join(tmp, "chaos"), cfg=cfg(),
+                                   steps=steps, log=lambda m: None)
+        out["faulted_s"] = round(time.perf_counter() - t0, 4)
+        out["fault_spec"] = spec
+        out["failures"] = len(rep2.failed)
+        out["retries"] = rep2.retries
+        out["degraded"] = rep2.degraded
+        out["recovered_ok"] = bool(
+            rep2.stl_path and os.path.exists(rep2.stl_path)
+            and len(rep2.failed) == 1 and rep2.retries >= 1)
+        out["recovery_overhead_s"] = round(
+            out["faulted_s"] - out["disabled_s"], 4)
+    finally:
+        faults.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # child: all jax work, per-phase persisted results
 # ---------------------------------------------------------------------------
@@ -903,6 +998,21 @@ def main() -> None:
             final["pipeline_e2e"] = {"error": f"{type(e).__name__}: {e}"[:200]}
             log(f"pipeline e2e A/B FAILED ({final['pipeline_e2e']['error']})")
 
+        # resilience overhead + seeded-chaos recovery (host-only)
+        try:
+            log("pipeline faults arm (disabled overhead + seeded chaos)...")
+            final["pipeline_faults"] = bench_pipeline_faults()
+            pf = final["pipeline_faults"]
+            log(f"pipeline_faults: disabled {pf['disabled_s']}s vs faulted "
+                f"{pf['faulted_s']}s ({pf['retries']} retries, "
+                f"{pf['failures']} quarantined, recovered_ok="
+                f"{pf['recovered_ok']})")
+        except Exception as e:
+            final["pipeline_faults"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+            log(f"pipeline faults arm FAILED "
+                f"({final['pipeline_faults']['error']})")
+
         # one TPU client at a time, repo-wide: if a validation session (or
         # any other tool) holds the claim lock, QUEUE behind it — racing it
         # is the concurrent-client wedge. Waiting is also the best outcome:
@@ -1046,6 +1156,14 @@ if __name__ == "__main__":
             line["cold_io"] = bench_reconstruct_pipeline(
                 inject_io_latency_s=PIPE_COLD_IO_S)
             line["pipeline_e2e"] = bench_pipeline_e2e()
+            line["pipeline_faults"] = bench_pipeline_faults()
+            fused = line["pipeline_e2e"].get("fused_s")
+            disabled = line["pipeline_faults"].get("disabled_s")
+            if fused and disabled:
+                # the zero-overhead-by-default contract, as a ratio readers
+                # can eyeball against run-to-run noise
+                line["pipeline_faults"]["overhead_vs_e2e"] = round(
+                    disabled / fused, 3)
         except Exception as e:
             line["error"] = f"{type(e).__name__}: {e}"[:200]
         emit(line)

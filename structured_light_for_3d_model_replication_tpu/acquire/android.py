@@ -8,6 +8,14 @@ and Old/android_camera_host_client.py:1-105): the phone app runs an HTTP server
 JSON header. Reachable over Wi-Fi or USB via ``adb reverse tcp:8765``.
 
 Stdlib urllib only — no client dependency.
+
+Resilience: every request runs under a bounded transient-retry budget
+(``retries``/``backoff_s``, defaults matching ``acquire.http_retries`` /
+``acquire.http_backoff_s``) — a dropped Wi-Fi association or a restarting
+phone app is a blip, not a lost view. HTTP is connectionless here, so
+"reconnect" IS the retry; 4xx statuses are permanent and never retried.
+Captured frames land on disk via tmp+rename, so a connection cut mid-body
+never leaves a truncated frame masquerading as a capture.
 """
 from __future__ import annotations
 
@@ -15,6 +23,11 @@ import json
 import urllib.error
 import urllib.request
 from dataclasses import asdict, dataclass
+
+from structured_light_for_3d_model_replication_tpu.io.atomic import (
+    atomic_write,
+)
+from structured_light_for_3d_model_replication_tpu.utils import faults
 
 __all__ = ["CameraSettings", "AndroidCameraClient"]
 
@@ -67,9 +80,33 @@ class CameraSettings:
 
 
 class AndroidCameraClient:
-    def __init__(self, host: str, port: int = 8765, timeout: float = 10.0):
+    def __init__(self, host: str, port: int = 8765, timeout: float = 10.0,
+                 retries: int = 2, backoff_s: float = 0.2,
+                 on_retry=None):
         self.base = f"http://{host}:{port}"
         self.timeout = timeout
+        self.retry_count = 0  # lifetime transient retries (the blip gauge)
+        self._policy = faults.RetryPolicy(max_retries=retries,
+                                          backoff_base_s=backoff_s,
+                                          backoff_max_s=max(2.0, backoff_s))
+        self._on_retry = on_retry  # optional (retry_index, exc) hook
+
+    @staticmethod
+    def _transient(e: BaseException) -> bool:
+        """Socket-level failures retry; an HTTP status is the app answering,
+        so only 5xx (app mid-restart) is worth the budget."""
+        if isinstance(e, urllib.error.HTTPError):
+            return e.code >= 500
+        return faults.is_transient(e)
+
+    def _retry(self, fn):
+        def note(n, e):
+            self.retry_count += 1
+            if self._on_retry is not None:
+                self._on_retry(n, e)
+
+        return faults.retry_call(fn, self._policy, classify=self._transient,
+                                 on_retry=note)
 
     def _request(self, path: str, data: bytes | None = None,
                  headers: dict | None = None):
@@ -79,14 +116,19 @@ class AndroidCameraClient:
         )
         return urllib.request.urlopen(req, timeout=self.timeout)
 
-    def _json(self, path: str, payload: dict | None = None) -> dict:
+    def _json(self, path: str, payload: dict | None = None,
+              retry: bool = True) -> dict:
         data = None
         headers = {}
         if payload is not None:
             data = json.dumps(payload).encode()
             headers["Content-Type"] = "application/json"
-        with self._request(path, data, headers) as resp:
-            return json.loads(resp.read().decode() or "{}")
+
+        def _once() -> dict:
+            with self._request(path, data, headers) as resp:
+                return json.loads(resp.read().decode() or "{}")
+
+        return self._retry(_once) if retry else _once()
 
     def status(self) -> dict:
         return self._json("/status")
@@ -99,24 +141,35 @@ class AndroidCameraClient:
 
     def reachable(self) -> bool:
         try:
-            self.status()
+            # a probe, not a request worth the retry budget: one attempt
+            self._json("/status", retry=False)
             return True
         except (urllib.error.URLError, OSError, ValueError):
             return False
 
     def capture_jpeg(self) -> tuple[bytes, dict]:
-        """Trigger a still capture; returns (jpeg_bytes, capture_metadata)."""
-        with self._request("/capture/jpeg", data=b"") as resp:
-            meta_hdr = resp.headers.get("X-Capture-Meta", "{}")
-            try:
-                meta = json.loads(meta_hdr)
-            except json.JSONDecodeError:
-                meta = {"raw": meta_hdr}
-            return resp.read(), meta
+        """Trigger a still capture; returns (jpeg_bytes, capture_metadata).
+        Transient failures (dropped connection, app restart, injected
+        ``http.capture`` faults) retry with backoff inside the budget."""
+
+        def _once() -> tuple[bytes, dict]:
+            faults.fire("http.capture", item=self.base)
+            with self._request("/capture/jpeg", data=b"") as resp:
+                meta_hdr = resp.headers.get("X-Capture-Meta", "{}")
+                try:
+                    meta = json.loads(meta_hdr)
+                except json.JSONDecodeError:
+                    meta = {"raw": meta_hdr}
+                return resp.read(), meta
+
+        return self._retry(_once)
 
     def capture_to_path(self, path: str) -> dict:
-        """Capture one frame to disk — drop-in CaptureFn for the sequencer."""
+        """Capture one frame to disk — drop-in CaptureFn for the sequencer.
+        tmp+rename publish: a failure at any byte offset leaves no partial
+        frame for the decoder to trip on (sync skipped: frame cadence
+        matters more than power-loss durability for re-capturable data)."""
         jpeg, meta = self.capture_jpeg()
-        with open(path, "wb") as f:
+        with atomic_write(path, sync=False) as tmp, open(tmp, "wb") as f:
             f.write(jpeg)
         return meta

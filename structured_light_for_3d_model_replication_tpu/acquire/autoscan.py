@@ -5,6 +5,21 @@ Capability parity (behavior studied from server/gui.py:1700-1787): N turns of
 view to ``{base}_{angle}deg_scan/``. A rotation timeout logs a warning and
 continues (the reference's behavior, gui.py:1774-1776). Progress reporting
 carries elapsed + estimated-remaining wall-clock.
+
+Resilience (ISSUE 3): the sweep is a long serial chain of fallible hardware
+steps, so each step carries a bounded recovery budget instead of aborting
+hours of upstream work:
+
+  - a failed capture sequence (dropped phone connection, injected
+    ``http.capture`` fault) retries up to ``capture_retries`` times; an
+    exhausted budget records the view as a :class:`FailureRecord` in
+    ``AutoScanResult.failures`` and the sweep CONTINUES — the reconstruction
+    pipeline's min-views degradation handles the hole downstream
+  - a failed rotation (missed DONE, serial error, injected ``serial.rotate``
+    fault) retries up to ``rotate_retries`` times, calling the turntable's
+    ``reopen()`` between attempts when it has one (the serial re-open +
+    bounded re-home path); exhaustion falls back to the reference's
+    warn-and-continue
 """
 from __future__ import annotations
 
@@ -12,6 +27,8 @@ import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable
+
+from structured_light_for_3d_model_replication_tpu.utils import faults
 
 __all__ = ["AutoScanResult", "auto_scan_360", "view_folder_name"]
 
@@ -27,18 +44,87 @@ class AutoScanResult:
     view_dirs: list[str] = field(default_factory=list)
     angles: list[float] = field(default_factory=list)
     rotation_warnings: list[int] = field(default_factory=list)
+    failures: list[faults.FailureRecord] = field(default_factory=list)
+    capture_retries: int = 0
+    rotate_retries: int = 0
     elapsed_s: float = 0.0
+
+
+def _capture_view(sequencer, view_dir: str, retries: int,
+                  result: AutoScanResult, view_name: str, log) -> bool:
+    """One per-view capture under a bounded retry budget; False quarantines
+    the view (recorded in ``result.failures``) and the sweep continues."""
+    for attempt in range(1, retries + 2):
+        try:
+            sequencer.capture_scan(view_dir)
+            return True
+        except faults.InjectedCrash:
+            raise
+        except Exception as e:
+            if attempt <= retries and faults.is_transient(e):
+                result.capture_retries += 1
+                log(f"[autoscan] {view_name}: capture failed "
+                    f"({type(e).__name__}: {e}); retry "
+                    f"{attempt}/{retries}")
+                continue
+            rec = faults.FailureRecord.from_exception(
+                "capture", view_name, e, attempts=attempt)
+            result.failures.append(rec)
+            log(f"[autoscan] {view_name} FAILED after {attempt} "
+                f"attempt(s): {e} — continuing the sweep without it")
+            return False
+
+
+def _rotate_step(turntable, step_deg: float, timeout: float, retries: int,
+                 result: AutoScanResult, step_index: int, log) -> bool:
+    """Rotate + wait-DONE with serial recovery: on a missed DONE or a serial
+    error, re-open the port (``turntable.reopen()`` when available) and
+    re-issue the rotation, up to ``retries`` times. Exhaustion degrades to
+    the reference's warn-and-continue (gui.py:1774-1776)."""
+    for attempt in range(1, retries + 2):
+        try:
+            turntable.rotate(step_deg)
+            if turntable.wait_for_done(timeout):
+                return True
+            err: Exception = TimeoutError(
+                f"rotation {step_index} missed DONE within {timeout:.0f}s")
+        except faults.InjectedCrash:
+            raise
+        except Exception as e:
+            err = e
+        if attempt > retries:
+            break
+        result.rotate_retries += 1
+        log(f"[autoscan] rotation {step_index} failed ({err}); "
+            f"re-opening the turntable and retrying "
+            f"{attempt}/{retries}")
+        reopen = getattr(turntable, "reopen", None)
+        if reopen is not None:
+            try:
+                reopen()
+            except Exception as e:
+                log(f"[autoscan] turntable re-open failed ({e})")
+    # continue with a warning, like the reference (gui.py:1774-1776)
+    log(f"[autoscan] WARNING: rotation {step_index} failed ({err}); "
+        f"continuing")
+    result.rotation_warnings.append(step_index)
+    return False
 
 
 def auto_scan_360(sequencer, turntable, output_root: str,
                   turns: int = 12, step_deg: float = 30.0,
                   base_name: str = "scan", rotate_timeout: float = 30.0,
+                  capture_retries: int = 0, rotate_retries: int = 0,
                   progress: Callable[[dict], None] | None = None,
                   log=print) -> AutoScanResult:
     """Run the full turntable sweep; returns per-view folders + angles.
 
     ``sequencer`` is a CaptureSequencer (or anything with ``capture_scan``);
-    ``turntable`` anything with ``rotate``/``wait_for_done`` (serial, sim, fake).
+    ``turntable`` anything with ``rotate``/``wait_for_done`` (serial, sim,
+    fake — ``reopen()`` is used for recovery when present).
+    ``capture_retries``/``rotate_retries`` default to 0 (the reference's
+    single-attempt behavior); the CLI wires ``acquire.capture_retries`` /
+    ``acquire.rotate_retries``.
     """
     os.makedirs(output_root, exist_ok=True)
     result = AutoScanResult()
@@ -46,10 +132,12 @@ def auto_scan_360(sequencer, turntable, output_root: str,
     for i in range(turns):
         angle = i * step_deg
         view_dir = os.path.join(output_root, view_folder_name(base_name, angle))
+        view_name = os.path.basename(view_dir)
         log(f"[autoscan] view {i + 1}/{turns} @ {angle:.0f}deg")
-        sequencer.capture_scan(view_dir)
-        result.view_dirs.append(view_dir)
-        result.angles.append(angle)
+        if _capture_view(sequencer, view_dir, capture_retries, result,
+                         view_name, log):
+            result.view_dirs.append(view_dir)
+            result.angles.append(angle)
         if progress:
             elapsed = time.monotonic() - t0
             per_view = elapsed / (i + 1)
@@ -59,11 +147,11 @@ def auto_scan_360(sequencer, turntable, output_root: str,
                 "remaining_s": per_view * (turns - i - 1),
             })
         if i < turns - 1:
-            turntable.rotate(step_deg)
-            if not turntable.wait_for_done(rotate_timeout):
-                # continue with a warning, like the reference (gui.py:1774-1776)
-                log(f"[autoscan] WARNING: rotation {i + 1} timed out; continuing")
-                result.rotation_warnings.append(i + 1)
+            _rotate_step(turntable, step_deg, rotate_timeout, rotate_retries,
+                         result, i + 1, log)
     result.elapsed_s = time.monotonic() - t0
-    log(f"[autoscan] {turns} views in {result.elapsed_s:.1f}s")
+    done = f"{len(result.view_dirs)}/{turns} views"
+    if result.failures:
+        done += f" ({len(result.failures)} FAILED + quarantined)"
+    log(f"[autoscan] {done} in {result.elapsed_s:.1f}s")
     return result

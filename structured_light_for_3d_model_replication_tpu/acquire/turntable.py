@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import time
 
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
 __all__ = [
     "TurntableError",
     "SerialTurntable",
@@ -65,11 +67,26 @@ class SerialTurntable:
         return [p.device for p in list_ports.comports()]
 
     def rotate(self, degrees: float) -> None:
+        faults.fire("serial.rotate", item=self.port_name)
         # drop any stale DONE from a previously timed-out rotation, or the
         # next wait_for_done would return before the table stops moving
         self._conn.reset_input_buffer()
         self._conn.write(f"{degrees}\n".encode())
         self._conn.flush()
+
+    def reopen(self) -> None:
+        """Recovery path for a wedged/dropped serial line: close and re-open
+        the port (the firmware resets on open, so this is also the bounded
+        re-home — the table holds position, the controller restarts clean).
+        The boot delay is paid again; callers re-issue the lost rotation."""
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+        self._conn = self._serial_mod.Serial(self.port_name, self.BAUD,
+                                             timeout=0.1)
+        time.sleep(self.BOOT_WAIT_S)
+        self._conn.reset_input_buffer()
 
     def wait_for_done(self, timeout: float = 30.0) -> bool:
         """Poll for the firmware's DONE line at ~10 Hz (server/arduino.py:49-71)."""
@@ -95,6 +112,7 @@ class SimulatedTurntable:
         self._done_at = 0.0
 
     def rotate(self, degrees: float) -> None:
+        faults.fire("serial.rotate", item="sim")
         self.angle = (self.angle + degrees) % 360.0
         self._done_at = time.monotonic() + self.rotate_time_s
 
@@ -114,18 +132,30 @@ class SimulatedTurntable:
 class LoopbackTurntable:
     """Test fake: instant (or scripted) completion, full command log."""
 
-    def __init__(self, fail_after: int | None = None):
+    def __init__(self, fail_after: int | None = None,
+                 recover_on_reopen: bool = True):
         self.commands: list[float] = []
         self.fail_after = fail_after
+        self.recover_on_reopen = recover_on_reopen
+        self.reopens = 0
         self.closed = False
 
     def rotate(self, degrees: float) -> None:
+        faults.fire("serial.rotate", item="loopback")
         self.commands.append(float(degrees))
 
     def wait_for_done(self, timeout: float = 30.0) -> bool:
         if self.fail_after is not None and len(self.commands) > self.fail_after:
             return False
         return True
+
+    def reopen(self) -> None:
+        """Models the serial recovery path: by default the fake 'hardware'
+        comes back healthy after a reopen (``recover_on_reopen=False``
+        scripts a permanently dead line)."""
+        self.reopens += 1
+        if self.recover_on_reopen:
+            self.fail_after = None
 
     @property
     def angle(self) -> float:

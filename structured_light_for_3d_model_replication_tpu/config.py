@@ -156,6 +156,16 @@ class AcquireConfig:
     turns: int = 12
     degrees_per_turn: float = 30.0
     simulate: bool = False       # no-hardware mode (reference gui.py:1705-1779)
+    # resilience: transient-failure retry budgets for the capture rig.
+    # http_retries re-runs a failed phone HTTP request (dropped Wi-Fi, app
+    # restart); rotate_retries re-issues a rotation after a missed DONE or a
+    # serial error, re-opening the port between attempts; capture_retries
+    # re-runs a whole per-view capture sequence before auto-scan records the
+    # view as failed and continues the sweep
+    http_retries: int = 2
+    http_backoff_s: float = 0.2
+    rotate_retries: int = 1
+    capture_retries: int = 1
 
 
 @dataclass
@@ -208,6 +218,35 @@ class PipelineConfig:
     # final merged-cloud PLY in ASCII (reference interop, %.4f — lossy; see
     # docs/API.md). INTERMEDIATE artifacts ignore this and stay binary.
     ascii_output: bool = False
+    # resilience (docs/ARCHITECTURE.md "Failure domains & recovery"):
+    # proceed to merge when at least min_views views survive reconstruction
+    # (failed views are quarantined with a FailureRecord and the run emits a
+    # failure manifest next to the STL); below the floor the run aborts.
+    # The floor never drops under 2 — a merge needs two clouds.
+    min_views: int = 2
+    # bounded retry + exponential backoff for TRANSIENT per-view faults
+    # (torn reads, dropped connections, EAGAIN-class OS errors): up to
+    # max_retries extra attempts, sleeping retry_backoff_s * 2^(n-1) capped
+    # at retry_backoff_max_s. Permanent failures skip straight to quarantine.
+    max_retries: int = 2
+    retry_backoff_s: float = 0.05
+    retry_backoff_max_s: float = 1.0
+    # verify stage-cache payloads against their recorded content digest on
+    # read; a corrupt entry (bit rot, torn write survivor) is evicted and
+    # recomputed instead of poisoning downstream stages
+    verify_cache: bool = True
+
+
+@dataclass
+class FaultsConfig:
+    """Deterministic fault injection (utils/faults.py). Disabled by default
+    (empty spec == zero overhead); the SL3D_FAULTS / SL3D_FAULTS_SEED env
+    vars override this section for config-free chaos runs."""
+
+    # comma list of `site[~substr]:kind[@n][xM][%p]` rules; see
+    # utils/faults.py for the grammar and the wired site names
+    spec: str = ""
+    seed: int = 0
 
 
 @dataclass
@@ -224,6 +263,7 @@ class Config:
     acquire: AcquireConfig = field(default_factory=AcquireConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    faults: FaultsConfig = field(default_factory=FaultsConfig)
     scan_root: str = ""  # dated scan folder; empty = ./scans/<date>
 
     def to_dict(self) -> dict[str, Any]:

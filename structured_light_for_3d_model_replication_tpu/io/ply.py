@@ -14,7 +14,13 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["write_ply", "read_ply", "write_mesh_ply", "WritebackQueue"]
+from structured_light_for_3d_model_replication_tpu.io.atomic import (
+    atomic_write,
+)
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
+__all__ = ["write_ply", "read_ply", "write_mesh_ply", "WritebackQueue",
+           "PlyWriteError"]
 
 _PLY_DTYPES = {
     "float": "<f4", "float32": "<f4", "double": "<f8", "float64": "<f8",
@@ -43,17 +49,30 @@ def write_ply(path: str, points: np.ndarray, colors: np.ndarray | None = None,
     truncation for |coord| >= 10^4). It exists for interop with the
     reference's artifacts only: every *intermediate* pipeline artifact is
     written binary regardless of user-facing ASCII flags (see docs/API.md),
-    so lossiness can only ever appear in a final, user-requested export."""
+    so lossiness can only ever appear in a final, user-requested export.
+
+    Crash-safe: bytes are staged into ``<path>.tmp`` and published with
+    fsync + atomic rename, so an interrupt at any point leaves either the
+    previous complete file or a sweepable orphan — never a truncated PLY."""
+    faults.fire("ply.write", item=path)
     points = np.asarray(points, np.float32)
     n = points.shape[0]
     has_c = colors is not None
     has_n = normals is not None
 
     if binary and n >= 100_000:
+        from structured_light_for_3d_model_replication_tpu.io import (
+            atomic as at,
+        )
         from structured_light_for_3d_model_replication_tpu.io import native
 
-        if native.write_ply_native(path, points, colors, normals):
-            return
+        tmp = path + ".tmp"
+        try:
+            if native.write_ply_native(tmp, points, colors, normals):
+                at.commit(tmp, path)
+                return
+        finally:
+            at.discard(tmp)
 
     header = ["ply",
               "format binary_little_endian 1.0" if binary else "format ascii 1.0",
@@ -74,7 +93,7 @@ def write_ply(path: str, points: np.ndarray, colors: np.ndarray | None = None,
         if has_c:
             col = np.asarray(colors, np.uint8)
             rec["red"], rec["green"], rec["blue"] = col[:, 0], col[:, 1], col[:, 2]
-        with open(path, "wb") as f:
+        with atomic_write(path) as tmp, open(tmp, "wb") as f:
             f.write(("\n".join(header) + "\n").encode("ascii"))
             rec.tofile(f)
     else:
@@ -90,11 +109,23 @@ def write_ply(path: str, points: np.ndarray, colors: np.ndarray | None = None,
             fmt += " %d %d %d"
         body = np.concatenate(cols, axis=1)
         lines = [fmt % tuple(row) for row in body]
-        with open(path, "w") as f:
+        with atomic_write(path) as tmp, open(tmp, "w") as f:
             f.write("\n".join(header) + "\n")
             f.write("\n".join(lines))
             if lines:
                 f.write("\n")
+
+
+class PlyWriteError(RuntimeError):
+    """Aggregate of every write failure in one ``WritebackQueue.drain`` —
+    the ExceptionGroup-style summary (py3.10-compatible) that keeps later
+    failures from being silently dropped behind the first one."""
+
+    def __init__(self, errors: list[tuple[str, Exception]]):
+        self.errors = errors
+        detail = "; ".join(f"{p}: {type(e).__name__}: {e}"
+                           for p, e in errors)
+        super().__init__(f"{len(errors)} PLY write(s) failed: {detail}")
 
 
 class WritebackQueue:
@@ -108,17 +139,24 @@ class WritebackQueue:
     written path on success and re-raises the write error on failure — the
     producer maps it back to its per-item failure accounting. Bytes are
     identical to a direct ``write_ply`` call: same writer, same arrays.
+
+    ``retry``: an optional ``faults.RetryPolicy``; transient write errors
+    (EAGAIN-class, injected transients) are then retried with backoff inside
+    the writer thread, with ``on_retry(path, retry_index, exc)`` notified —
+    the executor's per-lane retry counter hook.
     """
 
-    def __init__(self, on_write=None):
+    def __init__(self, on_write=None, retry=None, on_retry=None):
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(max_workers=1,
                                         thread_name_prefix="sl3d-plywrite")
-        self._pending: list = []
+        self._pending: list[tuple[str, object]] = []
         # optional (path, elapsed_s) hook, called in the writer thread after
         # each successful write — the pipeline's write-wall gauge
         self._on_write = on_write
+        self._retry = retry
+        self._on_retry = on_retry
 
     def submit(self, path: str, points: np.ndarray,
                colors: np.ndarray | None = None,
@@ -129,26 +167,43 @@ class WritebackQueue:
             import time
 
             t0 = time.perf_counter()
-            write_ply(path, points, colors, normals, binary=binary)
+            if self._retry is not None:
+                faults.retry_call(
+                    lambda: write_ply(path, points, colors, normals,
+                                      binary=binary),
+                    self._retry,
+                    on_retry=lambda n, e: (self._on_retry(path, n, e)
+                                           if self._on_retry else None))
+            else:
+                write_ply(path, points, colors, normals, binary=binary)
             if self._on_write is not None:
                 self._on_write(path, time.perf_counter() - t0)
             return path
 
         fut = self._pool.submit(_write)
-        self._pending.append(fut)
+        self._pending.append((path, fut))
         return fut
 
     @property
     def backlog(self) -> int:
         """Writes submitted but not yet finished (the queue-depth gauge)."""
-        return sum(1 for f in self._pending if not f.done())
+        return sum(1 for _, f in self._pending if not f.done())
 
     def drain(self) -> list[str]:
-        """Block until every submitted write finished; returns written paths.
-        The first write error re-raises here (callers holding per-item
-        futures instead call ``.result()`` on those and never need drain)."""
-        out = [f.result() for f in self._pending]
+        """Block until every submitted write finished; returns successfully
+        written paths. ALL write errors are collected and raised together as
+        one :class:`PlyWriteError` (callers holding per-item futures instead
+        call ``.result()`` on those and never need drain)."""
+        out: list[str] = []
+        errors: list[tuple[str, Exception]] = []
+        for path, f in self._pending:
+            try:
+                out.append(f.result())
+            except Exception as e:
+                errors.append((path, e))
         self._pending.clear()
+        if errors:
+            raise PlyWriteError(errors)
         return out
 
     def close(self, wait: bool = True) -> None:
@@ -165,7 +220,8 @@ class WritebackQueue:
 def write_mesh_ply(path: str, vertices: np.ndarray, faces: np.ndarray,
                    colors: np.ndarray | None = None,
                    normals: np.ndarray | None = None) -> None:
-    """Write a triangle mesh (binary little-endian)."""
+    """Write a triangle mesh (binary little-endian, crash-safe tmp+rename)."""
+    faults.fire("ply.write", item=path)
     vertices = np.asarray(vertices, np.float32)
     faces = np.asarray(faces, np.int32)
     has_c = colors is not None
@@ -191,7 +247,7 @@ def write_mesh_ply(path: str, vertices: np.ndarray, faces: np.ndarray,
     frec = np.empty(m, np.dtype([("k", "u1"), ("a", "<i4"), ("b", "<i4"), ("c", "<i4")]))
     frec["k"] = 3
     frec["a"], frec["b"], frec["c"] = faces[:, 0], faces[:, 1], faces[:, 2]
-    with open(path, "wb") as f:
+    with atomic_write(path) as tmp, open(tmp, "wb") as f:
         f.write(("\n".join(header) + "\n").encode("ascii"))
         rec.tofile(f)
         frec.tofile(f)
@@ -251,6 +307,7 @@ def read_ply(path: str):
             # uniform triangle lists only (the overwhelmingly common case)
             ldt = np.dtype([("k", _PLY_DTYPES[props[0][1]]),
                             ("v", _PLY_DTYPES[props[0][2]], 3)])
+            _check_body(path, name, body, ldt.itemsize, count, offset)
             rec = np.frombuffer(body, ldt, count=count, offset=offset)
             if count and not (rec["k"] == 3).all():
                 raise ValueError(f"{path}: only triangle faces supported")
@@ -258,11 +315,25 @@ def read_ply(path: str):
             offset += ldt.itemsize * count
         else:
             dt = np.dtype([(p[0], _PLY_DTYPES[p[1]]) for p in props])
+            _check_body(path, name, body, dt.itemsize, count, offset)
             rec = np.frombuffer(body, dt, count=count, offset=offset)
             arr = np.stack([rec[p[0]].astype(np.float64) for p in props], axis=1)
             _unpack_vertex(out, arr, [p[0] for p in props])
             offset += dt.itemsize * count
     return out
+
+
+def _check_body(path: str, element: str, body: bytes, itemsize: int,
+                count: int, offset: int) -> None:
+    """A body shorter than the header promises is a truncated file (torn
+    write, partial copy) — name it as such instead of letting np.frombuffer
+    raise a generic buffer error."""
+    have = len(body) - offset
+    need = itemsize * count
+    if have < need:
+        raise ValueError(
+            f"{path}: truncated PLY body — {have} bytes for {count} "
+            f"'{element}' records ({need} expected)")
 
 
 def _unpack_vertex(out: dict, arr: np.ndarray, names: list[str]) -> None:

@@ -8,6 +8,9 @@ from __future__ import annotations
 
 import numpy as np
 
+from structured_light_for_3d_model_replication_tpu.io import atomic
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
 __all__ = ["write_stl", "read_stl"]
 
 
@@ -22,15 +25,25 @@ def face_normals(vertices: np.ndarray, faces: np.ndarray) -> np.ndarray:
 
 def write_stl(path: str, vertices: np.ndarray, faces: np.ndarray,
               normals: np.ndarray | None = None) -> None:
-    """Write a binary STL. vertices [N,3] float, faces [M,3] int."""
+    """Write a binary STL. vertices [N,3] float, faces [M,3] int.
+
+    Crash-safe: staged into ``<path>.tmp`` and published with fsync +
+    atomic rename — an interrupt mid-write never leaves a truncated STL
+    masquerading as a print-ready model."""
+    faults.fire("ply.write", item=path)
     vertices = np.asarray(vertices, np.float32)
     faces = np.asarray(faces, np.int64)
     m = faces.shape[0]
     if normals is None and m >= 50_000:
         from structured_light_for_3d_model_replication_tpu.io import native
 
-        if native.write_stl_native(path, vertices, faces):
-            return
+        tmp = path + ".tmp"
+        try:
+            if native.write_stl_native(tmp, vertices, faces):
+                atomic.commit(tmp, path)
+                return
+        finally:
+            atomic.discard(tmp)
     if normals is None:
         normals = face_normals(vertices, faces)
     rec = np.zeros(m, np.dtype([
@@ -41,7 +54,7 @@ def write_stl(path: str, vertices: np.ndarray, faces: np.ndarray,
     rec["v0"] = vertices[faces[:, 0]]
     rec["v1"] = vertices[faces[:, 1]]
     rec["v2"] = vertices[faces[:, 2]]
-    with open(path, "wb") as f:
+    with atomic.atomic_write(path) as tmp, open(tmp, "wb") as f:
         f.write(b"structured_light_for_3d_model_replication_tpu".ljust(80, b"\0"))
         f.write(np.uint32(m).tobytes())
         rec.tofile(f)

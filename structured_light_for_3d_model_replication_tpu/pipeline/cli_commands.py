@@ -38,9 +38,16 @@ _RUNNERS: dict[str, Callable[[argparse.Namespace], int]] = {}
 def _cfg(args: argparse.Namespace):
     from structured_light_for_3d_model_replication_tpu import load_config
     from structured_light_for_3d_model_replication_tpu.cli import parse_overrides
+    from structured_light_for_3d_model_replication_tpu.utils import faults
 
     cfg = load_config(getattr(args, "config", None),
                       parse_overrides(getattr(args, "set", [])))
+    # arm (or disarm: empty spec) the deterministic fault-injection layer;
+    # SL3D_FAULTS/SL3D_FAULTS_SEED env vars override the config section
+    plan = faults.configure_from(cfg.faults)
+    if plan is not None:
+        print(f"[faults] CHAOS RUN: {len(plan.rules)} injection rule(s) "
+              f"armed (seed {plan.seed})", file=sys.stderr)
     if cfg.parallel.backend in ("numpy", "cpu"):
         # honor the backend choice for EVERY stage: jnp-path stages (merge,
         # clean, mesh) would otherwise initialize the ambient accelerator —
@@ -381,7 +388,15 @@ def _cmd_pipeline(args) -> int:
     if report.cache:
         print(f"[pipeline] stage cache: {report.cache['hits']} hits, "
               f"{report.cache['misses']} misses")
-    return 0 if not report.failed else 2
+    if report.failed:
+        # a degraded-but-completed run is a SUCCESS with reduced coverage:
+        # the STL exists, the failures are quarantined + manifested. Exit 0
+        # so automation keeps flowing; an abort (below min_views) raised
+        # out of run_pipeline instead and never reaches here.
+        print(f"[pipeline] WARNING: completed DEGRADED — "
+              f"{len(report.failed)} view(s) quarantined; see "
+              f"{report.manifest_path}", file=sys.stderr)
+    return 0
 
 
 @_runner("merge-360")
@@ -609,6 +624,8 @@ def _cmd_auto_scan(args) -> int:
             sequencer, turntable, args.output_root,
             turns=cfg.acquire.turns, step_deg=cfg.acquire.degrees_per_turn,
             base_name=args.base_name, rotate_timeout=cfg.acquire.rotate_timeout_s,
+            capture_retries=cfg.acquire.capture_retries,
+            rotate_retries=cfg.acquire.rotate_retries,
             progress=progress,
         )
     finally:
@@ -753,13 +770,21 @@ def _cmd_synth(args) -> int:
     rig = syn.default_rig(cam_size=cam, proj_size=proj)
     scene = syn.sphere_on_background()
     obj, background = scene.objects  # turntable rotates the object, not the wall
+    # an off-pivot satellite breaks the main sphere's rotational symmetry:
+    # every rendered view is genuinely distinct, so per-view cache keys,
+    # quarantine decisions, and degraded merges exercise the real
+    # multi-view paths instead of collapsing onto one identical frame set
+    # above the main sphere (|y| > its radius) so no turntable angle can
+    # occlude it; the xz offset gives it a real orbit
+    satellite = syn.Sphere(np.array([48.0, -92.0, 430.0]), 16.0)
     os.makedirs(args.output_root, exist_ok=True)
     matfile.save_calibration(os.path.join(args.output_root, "calib.mat"),
                              rig.calibration())
     step = 360.0 / args.views
     pivot = np.array([0.0, 0.0, 420.0])  # sphere_on_background center depth
     for i, (R, t) in enumerate(syn.turntable_poses(args.views, step, pivot)):
-        view_scene = syn.Scene([obj.transformed(R, t), background])
+        view_scene = syn.Scene([obj.transformed(R, t),
+                                satellite.transformed(R, t), background])
         frames, _ = syn.render_scene(rig, view_scene)
         d = os.path.join(args.output_root,
                          f"scan_{int(round(i * step)):03d}deg_scan")

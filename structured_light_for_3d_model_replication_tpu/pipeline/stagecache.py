@@ -21,6 +21,18 @@ downstream of it and nothing else. Payloads are ``.npz`` files under
 ``<out>/.slscan-cache/<stage>-<key16>.npz``; a corrupt or half-written entry
 reads as a miss (the write is tmp+rename, so interrupts cannot corrupt a
 published entry).
+
+Resilience contract (docs/ARCHITECTURE.md "Failure domains & recovery"):
+
+  - every payload carries a ``__digest__`` of its own arrays; reads verify
+    it (``verify=True``) and a mismatch — bit rot, a torn-write survivor —
+    EVICTS the entry and reads as a miss, so a corrupt entry can never
+    poison downstream stages
+  - ``put`` is best-effort: a failed write (disk full, injected
+    ``cache.put`` fault) cleans up its tmp file, logs, and returns — the
+    cache is an optimization, never allowed to kill a computed result
+  - init sweeps orphaned ``*.tmp`` files (a ``kill -9`` mid-``put`` leaves
+    one behind; they are never valid entries)
 """
 from __future__ import annotations
 
@@ -30,11 +42,15 @@ import os
 
 import numpy as np
 
+from structured_light_for_3d_model_replication_tpu.io.atomic import sweep_tmp
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
 __all__ = ["StageCache", "config_subtree"]
 
 # bump when a stage's numeric contract changes (payload layout, op
 # semantics): stale entries then read as misses instead of wrong hits
-_SCHEMA = "slscan-cache-v1"
+# (v2: payloads carry a __digest__ for read-time verification)
+_SCHEMA = "slscan-cache-v2"
 
 
 def config_subtree(cfg, sections: tuple[str, ...]) -> str:
@@ -54,14 +70,20 @@ class StageCache:
     no-op — one code path for cached and uncached runs.
     """
 
-    def __init__(self, root: str, enabled: bool = True, log=None):
+    def __init__(self, root: str, enabled: bool = True, log=None,
+                 verify: bool = True):
         self.root = root
         self.enabled = enabled
+        self.verify = verify
         self._log = log or (lambda m: None)
         self.hits: list[str] = []
         self.misses: list[str] = []
+        self.evicted: list[str] = []
+        self.put_errors: list[str] = []
         if enabled:
             os.makedirs(root, exist_ok=True)
+            # a kill -9 mid-put leaves a .tmp orphan; never a valid entry
+            sweep_tmp(root, log=self._log)
 
     # -- keys ------------------------------------------------------------
 
@@ -104,13 +126,34 @@ class StageCache:
     def _path(self, stage: str, key: str) -> str:
         return os.path.join(self.root, f"{stage}-{key[:16]}.npz")
 
+    def _evict(self, path: str, stage: str, why: str) -> None:
+        """Remove a bad entry so it cannot poison a later read."""
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        self.evicted.append(stage)
+        self._log(f"[cache] {stage}: evicted {os.path.basename(path)} "
+                  f"({why}); recomputing")
+
     def get(self, stage: str, key: str) -> dict | None:
-        """Load a stage payload; None on any miss (absent, disabled, or
-        unreadable). Hits are logged — the resume trail the operator reads."""
+        """Load a stage payload; None on any miss (absent, disabled,
+        unreadable, or digest-mismatched — the last two also evict the
+        entry). Hits are logged — the resume trail the operator reads."""
         if not self.enabled:
             self.misses.append(stage)
             return None
         path = self._path(stage, key)
+        try:
+            faults.fire("cache.get", item=f"{stage}:{key[:16]}")
+        except faults.InjectedCrash:
+            raise
+        except Exception:
+            # an injected lookup failure behaves like the corrupt-entry
+            # path: evict whatever is there and read as a miss
+            self._evict(path, stage, "injected lookup fault")
+            self.misses.append(stage)
+            return None
         if not os.path.exists(path):
             self.misses.append(stage)
             return None
@@ -119,26 +162,61 @@ class StageCache:
                 if "__key__" not in z.files or str(z["__key__"]) != key:
                     self.misses.append(stage)  # 16-hex-prefix collision
                     return None
-                out = {k: z[k] for k in z.files if k != "__key__"}
+                out = {k: z[k] for k in z.files
+                       if k not in ("__key__", "__digest__")}
+                recorded = (str(z["__digest__"])
+                            if "__digest__" in z.files else None)
+        except faults.InjectedCrash:
+            raise
         except Exception as e:  # half-written/corrupt entry == miss
-            self._log(f"[cache] {stage}: unreadable entry ({e}); recomputing")
+            self._evict(path, stage, f"unreadable: {e}")
             self.misses.append(stage)
             return None
+        if self.verify:
+            # recorded=None is a pre-digest entry (older schema bump
+            # should catch this, but stay safe): treat as unverifiable
+            if recorded is None or self.digest_arrays(**out) != recorded:
+                self._evict(path, stage, "payload digest mismatch "
+                            "(bit rot or torn write)")
+                self.misses.append(stage)
+                return None
         self.hits.append(stage)
         self._log(f"[cache] {stage}: hit ({os.path.basename(path)})")
         return out
 
     def put(self, stage: str, key: str, **arrays) -> None:
+        """Publish a stage payload (tmp + atomic rename). Best-effort: any
+        write failure cleans up the tmp file and logs instead of raising —
+        losing a cache entry must never lose the computed result."""
         if not self.enabled:
             return
         path = self._path(stage, key)
         tmp = path + ".tmp"
-        np.savez(tmp, __key__=np.asarray(key), **arrays)
-        # np.savez appends .npz to names without it
-        if not os.path.exists(tmp) and os.path.exists(tmp + ".npz"):
-            tmp = tmp + ".npz"
-        os.replace(tmp, path)
+        try:
+            faults.fire("cache.put", item=f"{stage}:{key[:16]}")
+            np.savez(tmp, __key__=np.asarray(key),
+                     __digest__=np.asarray(self.digest_arrays(**arrays)),
+                     **arrays)
+            # np.savez appends .npz to names without it
+            if not os.path.exists(tmp) and os.path.exists(tmp + ".npz"):
+                tmp = tmp + ".npz"
+            os.replace(tmp, path)
+        except faults.InjectedCrash:
+            raise
+        except Exception as e:
+            self.put_errors.append(stage)
+            self._log(f"[cache] {stage}: put failed ({e}); continuing "
+                      f"uncached")
+        finally:
+            for leftover in (tmp, tmp + ".npz"):
+                if leftover != path and os.path.exists(leftover):
+                    try:
+                        os.remove(leftover)
+                    except OSError:
+                        pass
 
     def stats(self) -> dict:
         return {"hits": len(self.hits), "misses": len(self.misses),
-                "hit_stages": list(self.hits)}
+                "hit_stages": list(self.hits),
+                "evicted": len(self.evicted),
+                "put_errors": len(self.put_errors)}

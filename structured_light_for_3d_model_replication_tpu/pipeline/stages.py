@@ -22,10 +22,12 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io import atomic
 from structured_light_for_3d_model_replication_tpu.io import images as imio
 from structured_light_for_3d_model_replication_tpu.io import matfile, ply
 from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
 from structured_light_for_3d_model_replication_tpu.ops import triangulate as tri
+from structured_light_for_3d_model_replication_tpu.utils import faults
 from structured_light_for_3d_model_replication_tpu.utils import profiling as prof
 
 __all__ = [
@@ -45,17 +47,26 @@ class BatchReport:
     load/compute/write overlap accounting (``OverlapStats.as_dict()``);
     None on the serial path. Not part of the per-item contract — outputs,
     failed, and the summary counts are identical across executors.
+
+    ``failures`` carries the structured :class:`~.utils.faults.FailureRecord`
+    twin of every ``failed`` tuple (stage, attempt count, transient-vs-
+    permanent classification — the quarantine/manifest payload); ``retries``
+    counts transient-fault retries that ultimately succeeded.
     """
 
     outputs: list[str] = field(default_factory=list)
     failed: list[tuple[str, str]] = field(default_factory=list)
+    failures: list[faults.FailureRecord] = field(default_factory=list)
+    retries: int = 0
     elapsed_s: float = 0.0
     overlap: dict | None = None
 
     @property
     def summary(self) -> str:
         total = len(self.outputs) + len(self.failed)
-        return f"{len(self.outputs)}/{total} succeeded in {self.elapsed_s:.1f}s"
+        retr = f", {self.retries} retried" if self.retries else ""
+        return (f"{len(self.outputs)}/{total} succeeded in "
+                f"{self.elapsed_s:.1f}s{retr}")
 
 
 def sort_ply_paths_by_angle(paths: list[str]) -> list[str]:
@@ -168,6 +179,54 @@ def _item_name(src) -> str:
     return os.path.basename(os.path.normpath(src)) or "cloud"
 
 
+def _retry_policy(cfg: Config) -> faults.RetryPolicy:
+    """The per-view transient-retry budget, from ``pipeline.*`` config."""
+    return faults.RetryPolicy(
+        max_retries=cfg.pipeline.max_retries,
+        backoff_base_s=cfg.pipeline.retry_backoff_s,
+        backoff_max_s=cfg.pipeline.retry_backoff_max_s)
+
+
+def _retry_stage(stage: str, fn, policy: faults.RetryPolicy, on_retry=None):
+    """``faults.retry_call`` with the failing stage annotated onto the final
+    exception, so FailureRecords built downstream name the right lane."""
+    try:
+        return faults.retry_call(fn, policy, on_retry=on_retry)
+    except faults.InjectedCrash:
+        raise
+    except Exception as e:
+        faults.annotate(e, stage=stage)
+        raise
+
+
+def _load_fired(src, cfg: Config):
+    """Frame-stack load behind the ``frame.load`` injection site."""
+    faults.fire("frame.load", item=src)
+    return imio.load_stack(src, io_workers=cfg.parallel.io_workers)
+
+
+def _compute_fired(frames, texture, calib, cfg, scanner, src,
+                   async_dispatch=False):
+    """Per-view decode+triangulate behind the ``compute.view`` site."""
+    faults.fire("compute.view", item=src)
+    return _compute_cloud(frames, texture, calib, cfg, scanner,
+                          async_dispatch=async_dispatch)
+
+
+def _record_failure(report: BatchReport, src, name: str, exc: BaseException,
+                    log, default_stage: str = "compute",
+                    stats: "prof.OverlapStats | None" = None) -> None:
+    """One per-item failure -> log line + legacy tuple + structured record."""
+    rec = faults.FailureRecord.from_exception(default_stage, name, exc)
+    log(f"[reconstruct] {name} FAILED ({rec.stage}, attempt "
+        f"{rec.attempts}): {exc}")
+    report.failed.append((src, str(exc)))
+    report.failures.append(rec)
+    if stats is not None:
+        stats.add_failure(rec.stage if rec.stage in prof.OverlapStats._STAGES
+                          else default_stage)
+
+
 def _out_path_for(src, mode: str, output: str | None) -> str:
     """Output-path contract shared by both executors (identical artifacts)."""
     if mode == "single" and output:
@@ -187,18 +246,41 @@ def _reconstruct_serial(sources, calib, cfg, scanner, mode, output, report,
     ``clean_steps``/``collect``/``write_plys``: the fused-pipeline hooks,
     identical contract to the pipelined executor — an optional masked clean
     chain after compute, an in-memory per-view sink ``collect(idx, src,
-    pts, cols)``, and PLY emission demoted to an optional side output."""
+    pts, cols)``, and PLY emission demoted to an optional side output.
+
+    Resilience: load and compute each run under the ``pipeline.max_retries``
+    transient-retry budget (exponential backoff); an exhausted or permanent
+    failure quarantines the view as a :class:`FailureRecord` and the batch
+    continues — the per-item tolerance (processing.py:323-330), now
+    structured."""
     timer = prof.StageTimer()
+    policy = _retry_policy(cfg)
     for idx, src in enumerate(sources):
         name = _item_name(src)
+
+        def on_retry(n, e, _name=name):
+            report.retries += 1
+            log(f"[reconstruct] {_name}: transient {type(e).__name__} "
+                f"({e}); retry {n}/{policy.max_retries} after "
+                f"{policy.delay_s(n):.2f}s backoff")
+
         try:
             with timer.stage(name), prof.trace():
-                pts, cols = reconstruct_source(src, calib, cfg, scanner)
+                frames, texture = _retry_stage(
+                    "load", lambda: _load_fired(src, cfg), policy, on_retry)
+                pts, cols = _retry_stage(
+                    "compute",
+                    lambda: tri.compact_cloud(
+                        _compute_fired(frames, texture, calib, cfg, scanner,
+                                       src)),
+                    policy, on_retry)
                 if clean_steps is not None:
                     pts, cols, _ = _clean_arrays(pts, cols, cfg, clean_steps)
             if write_plys:
                 out_path = _out_path_for(src, mode, output)
-                ply.write_ply(out_path, pts, cols)
+                _retry_stage("write",
+                             lambda: ply.write_ply(out_path, pts, cols),
+                             policy, on_retry)
             else:
                 out_path = name
             if collect is not None:
@@ -216,8 +298,7 @@ def _reconstruct_serial(sources, calib, cfg, scanner, mode, output, report,
                 # so the CLI's CPU-fallback retry can handle it (otherwise
                 # every item "fails" identically and no retry fires)
                 raise
-            log(f"[reconstruct] {name} FAILED: {e}")
-            report.failed.append((src, str(e)))
+            _record_failure(report, src, name, e, log)
     prof.get_logger().debug("reconstruct stage timing:\n%s", timer.report())
 
 
@@ -258,21 +339,32 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
     )
 
     stats = prof.OverlapStats()
+    policy = _retry_policy(cfg)
     depth = max(1, cfg.parallel.prefetch_depth)
     workers = cfg.parallel.io_workers
 
-    # idx -> ("fail", src, msg) | ("done", drain_future); assembled in order
+    def lane_retry(lane):
+        def on_retry(n, e):
+            stats.add_retry(lane)
+            log(f"[reconstruct] transient {type(e).__name__} in {lane} "
+                f"lane ({e}); retry {n}/{policy.max_retries}")
+        return on_retry
+
+    # idx -> ("fail", src, exc) | ("done", drain_future); assembled in order
     results: dict[int, tuple] = {}
     load_pool = ThreadPoolExecutor(max_workers=workers,
                                    thread_name_prefix="sl3d-prefetch")
     drain_pool = ThreadPoolExecutor(max_workers=1,
                                     thread_name_prefix="sl3d-drain")
     wbq = ply.WritebackQueue(
-        on_write=lambda _path, dt: stats.add("write", dt))
+        on_write=lambda _path, dt: stats.add("write", dt),
+        retry=policy,
+        on_retry=lambda _path, n, e: lane_retry("write")(n, e))
 
     def load_one(src):
         t0 = time.perf_counter()
-        out = imio.load_stack(src, io_workers=workers)
+        out = _retry_stage("load", lambda: _load_fired(src, cfg), policy,
+                           lane_retry("load"))
         stats.add("load", time.perf_counter() - t0)
         return out
 
@@ -310,7 +402,7 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
                 try:
                     frames, texture = lfut.result()
                 except Exception as e:
-                    results[idx] = ("fail", src, str(e))
+                    results[idx] = ("fail", src, e)
                     continue
                 # backpressure on the compute->drain side too: at most
                 # depth+1 dispatched-but-undrained clouds live at once
@@ -322,13 +414,17 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
                     undrained.popleft().exception()
                 try:
                     t0 = time.perf_counter()
-                    cloud = _compute_cloud(frames, texture, calib, cfg,
-                                           scanner, async_dispatch=True)
+                    cloud = _retry_stage(
+                        "compute",
+                        lambda: _compute_fired(frames, texture, calib, cfg,
+                                               scanner, src,
+                                               async_dispatch=True),
+                        policy, lane_retry("compute"))
                     stats.add("compute", time.perf_counter() - t0)
                 except Exception as e:
                     if is_backend_init_error(e):
                         raise
-                    results[idx] = ("fail", src, str(e))
+                    results[idx] = ("fail", src, e)
                     continue
                 out_path = (_out_path_for(src, mode, output) if write_plys
                             else _item_name(src))
@@ -341,11 +437,18 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
             for idx, src in pending:
                 name = _item_name(src)
                 kind, *rest = results[idx]
+                err: BaseException
                 if kind == "done":
                     try:
                         out_path, n_pts, wfut = rest[0].result()
                         if wfut is not None:
-                            wfut.result()       # surface write errors
+                            try:
+                                wfut.result()   # surface write errors
+                            except faults.InjectedCrash:
+                                raise
+                            except Exception as e:
+                                faults.annotate(e, stage="write")
+                                raise
                         log(f"[reconstruct] {name}: {n_pts:,} points -> "
                             f"{out_path if wfut is not None else 'in-memory handoff'}")
                         report.outputs.append(out_path)
@@ -355,15 +458,17 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
                         # drain sync — still a process-level condition
                         if is_backend_init_error(e):
                             raise
-                        rest = [src, str(e)]
-                log(f"[reconstruct] {name} FAILED: {rest[-1]}")
-                report.failed.append((src, rest[-1]))
+                        err = e
+                else:
+                    err = rest[-1]
+                _record_failure(report, src, name, err, log, stats=stats)
     finally:
         load_pool.shutdown(wait=False, cancel_futures=True)
         drain_pool.shutdown(wait=False, cancel_futures=True)
         wbq.close(wait=True)
     stats.finish(time.perf_counter() - t_wall)
     report.overlap = stats.as_dict()
+    report.retries += report.overlap.get("retry_total", 0)
     prof.get_logger().debug("reconstruct pipeline overlap: %s",
                             stats.summary())
 
@@ -586,20 +691,45 @@ def merge_views(input_folder: str, output_ply: str, cfg: Config | None = None,
     if len(paths) < 2:
         raise ValueError(f"need >= 2 PLY views in {input_folder}, found {len(paths)}")
     log(f"[merge] {len(paths)} views: " + ", ".join(os.path.basename(p) for p in paths))
+
     # per-view PLY reads on the shared I/O pool (parallel.io_workers; the
     # registration can't start early anyway, so amortize the disk wall);
-    # pool.map preserves path order, so the merge chain is unchanged
+    # pool.map preserves path order, so the merge chain is unchanged.
+    # Per-file tolerance: a corrupt/truncated view (torn write survivor)
+    # is dropped with a warning as long as >= max(2, pipeline.min_views)
+    # readable views remain — graceful degradation instead of losing the
+    # whole merge to one bad file.
+    def read_one(p):
+        try:
+            return ply.read_ply(p), None
+        except faults.InjectedCrash:
+            raise
+        except Exception as e:
+            return None, e
+
     if cfg.parallel.io_workers > 1 and len(paths) > 1:
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(
                 max_workers=min(cfg.parallel.io_workers, len(paths)),
                 thread_name_prefix="sl3d-plyread") as pool:
-            datas = list(pool.map(ply.read_ply, paths))
+            datas = list(pool.map(read_one, paths))
     else:
-        datas = [ply.read_ply(p) for p in paths]
+        datas = [read_one(p) for p in paths]
+    dropped = [(p, e) for p, (d, e) in zip(paths, datas) if d is None]
+    for p, e in dropped:
+        log(f"[merge] WARNING: dropping unreadable view "
+            f"{os.path.basename(p)}: {e}")
+    floor = max(2, cfg.pipeline.min_views)
+    if len(paths) - len(dropped) < floor:
+        raise ValueError(
+            f"merge: only {len(paths) - len(dropped)}/{len(paths)} views "
+            f"readable, below the pipeline.min_views={floor} floor "
+            f"(unreadable: {[os.path.basename(p) for p, _ in dropped]})")
     clouds = []
-    for d in datas:
+    for d, _ in datas:
+        if d is None:
+            continue
         c = d.get("colors")
         if c is None:
             c = np.zeros_like(d["points"], dtype=np.uint8)
@@ -694,6 +824,10 @@ class PipelineReport:
     views_computed: int = 0
     views_cached: int = 0
     failed: list[tuple[str, str]] = field(default_factory=list)
+    failures: list[faults.FailureRecord] = field(default_factory=list)
+    retries: int = 0
+    degraded: bool = False          # merged with fewer views than captured
+    manifest_path: str | None = None  # failure manifest next to the STL
     merge_status: str = ""          # 'computed' | 'cache-hit'
     mesh_status: str = ""
     merged_points: int = 0
@@ -703,10 +837,52 @@ class PipelineReport:
 
     @property
     def summary(self) -> str:
+        deg = (f" DEGRADED ({len(self.failed)} view(s) quarantined)"
+               if self.degraded else "")
         return (f"{self.views_computed} views computed + "
                 f"{self.views_cached} cached, merge {self.merge_status}, "
                 f"mesh {self.mesh_status}, {self.merged_points:,} points "
-                f"in {self.elapsed_s:.1f}s")
+                f"in {self.elapsed_s:.1f}s{deg}")
+
+
+def _write_json_atomic(path: str, payload: dict) -> None:
+    """Crash-safe JSON artifact write (tmp + fsync + rename)."""
+    with atomic.atomic_write(path) as tmp, open(tmp, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _quarantine_failures(out_dir: str, failures, log) -> None:
+    """Persist one ``<out>/quarantine/<view>.json`` per failed view — the
+    per-view debris an operator (or a re-capture loop) acts on without
+    parsing logs."""
+    qdir = os.path.join(out_dir, "quarantine")
+    os.makedirs(qdir, exist_ok=True)
+    for rec in failures:
+        _write_json_atomic(os.path.join(qdir, f"{rec.view}.json"),
+                           rec.as_dict())
+    log(f"[pipeline] quarantined {len(failures)} failed view(s) -> {qdir}")
+
+
+def _failure_manifest(out_dir: str, report: "PipelineReport",
+                      views_total: int, views_survived: int,
+                      aborted: bool, log) -> str:
+    """The failure manifest JSON written next to the STL: every structured
+    failure record, the degradation verdict, and (on chaos runs) the fired
+    injection counts so seeded assertions need no log scraping."""
+    plan = faults.active_plan()
+    path = os.path.join(out_dir, "failures.json")
+    _write_json_atomic(path, {
+        "views_total": views_total,
+        "views_survived": views_survived,
+        "degraded": report.degraded,
+        "aborted": aborted,
+        "retries": report.retries,
+        "failures": [r.as_dict() for r in report.failures],
+        "injected_faults": plan.counts() if plan is not None else {},
+    })
+    log(f"[pipeline] failure manifest -> {path}")
+    return path
 
 
 def run_pipeline(calib_path: str, target: str, out_dir: str,
@@ -750,9 +926,13 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     # discrete reconstruct->merge-360 chain see the views in one order
     sources = sort_ply_paths_by_angle(sources)
     os.makedirs(out_dir, exist_ok=True)
+    # startup sweep: a kill -9 in an earlier run leaves *.tmp orphans under
+    # the out tree (merged/STL/manifest staging, cache puts); none is data
+    atomic.sweep_tmp(out_dir, log=log, recursive=True)
     report = PipelineReport()
     cache = StageCache(os.path.join(out_dir, ".slscan-cache"),
-                       enabled=cfg.pipeline.cache, log=log)
+                       enabled=cfg.pipeline.cache, log=log,
+                       verify=cfg.pipeline.verify_cache)
 
     # ---- stage 1+2: per-view reconstruct + masked clean -----------------
     steps = tuple(steps)
@@ -797,12 +977,32 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
         else:
             _reconstruct_serial(*run_args, **kw)
         report.failed = batch.failed
+        report.failures = batch.failures
+        report.retries = batch.retries
         report.overlap = batch.overlap
     report.views_computed = len(collected) - report.views_cached
-    if len(collected) < 2:
+
+    # ---- failure domain: quarantine + degrade-or-abort decision ---------
+    # the floor never drops under 2 — a merge needs two clouds
+    floor = max(2, cfg.pipeline.min_views)
+    if report.failures:
+        _quarantine_failures(out_dir, report.failures, log)
+    if len(collected) < floor:
+        report.manifest_path = _failure_manifest(
+            out_dir, report, len(sources), len(collected), aborted=True,
+            log=log)
         raise ValueError(
-            f"pipeline: only {len(collected)} views survived reconstruction "
-            f"(failed: {[os.path.basename(s) for s, _ in report.failed]})")
+            f"pipeline: only {len(collected)} views survived "
+            f"reconstruction, below the pipeline.min_views={floor} floor "
+            f"(failed: {[os.path.basename(s) for s, _ in report.failed]}; "
+            f"see {report.manifest_path})")
+    if report.failed:
+        report.degraded = True
+        log(f"[pipeline] WARNING: {len(report.failed)}/{len(sources)} "
+            f"view(s) failed and were quarantined; continuing DEGRADED "
+            f"with {len(collected)} views (floor: pipeline.min_views="
+            f"{floor}). The merged model will have reduced coverage at "
+            f"the failed angles.")
 
     # ---- stage 3: merge-360 (device-resident handoff) -------------------
     order = sorted(collected)
@@ -871,6 +1071,16 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     stl_path = os.path.join(out_dir, stl_name)
     _write_mesh(stl_path, verts, faces, log=log)
     report.stl_path = stl_path
+
+    if report.failures:
+        report.manifest_path = _failure_manifest(
+            out_dir, report, len(sources), len(collected), aborted=False,
+            log=log)
+    else:
+        # a clean (re)run must not advertise a previous run's failures
+        stale = os.path.join(out_dir, "failures.json")
+        if os.path.exists(stale):
+            os.remove(stale)
 
     report.cache = cache.stats()
     report.elapsed_s = time.monotonic() - t_start

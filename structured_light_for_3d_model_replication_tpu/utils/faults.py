@@ -1,0 +1,362 @@
+"""Deterministic fault injection + the retry/quarantine toolkit.
+
+The scan-to-print chain is a long sequence of fallible steps (serial turntable
+moves, HTTP frame capture, per-view decode/triangulate, disk I/O). This module
+supplies the two halves of making that chain resilient:
+
+1. **Fault injection** — named sites in the product code call :func:`fire`;
+   a :class:`FaultPlan` (armed from the ``faults`` config section or the
+   ``SL3D_FAULTS`` env var, seeded so chaos runs are reproducible) decides
+   which calls raise. Disabled by default: ``fire`` is a single ``None``
+   check, so production paths pay nothing.
+
+   Sites wired through the codebase:
+
+   ====================  ====================================================
+   ``frame.load``        per-view frame-stack load (both batch executors)
+   ``compute.view``      per-view decode+triangulate dispatch
+   ``ply.write``         every PLY/STL artifact write (io/ply.py, io/stl.py)
+   ``cache.get``         stage-cache lookup (pipeline/stagecache.py)
+   ``cache.put``         stage-cache publish
+   ``http.capture``      phone HTTP frame capture (acquire/android.py)
+   ``serial.rotate``     turntable rotate+wait (acquire/turntable.py)
+   ====================  ====================================================
+
+2. **Retry/quarantine toolkit** — the exception classifier
+   (:func:`is_transient`), the bounded exponential-backoff
+   :class:`RetryPolicy` + :func:`retry_call`, and the structured
+   :class:`FailureRecord` the pipeline quarantines permanently-failed views
+   with.
+
+Fault-spec grammar (comma-separated rules)::
+
+    site[~substr]:kind[@n][xM][%p]
+
+    kind     transient | permanent | crash
+    ~substr  only fire() calls whose item contains substr count as hits
+    @n       arm on the n-th matching hit (1-based; default 1)
+    xM       fire at most M times (default: 1 for transient/crash,
+             unlimited for permanent)
+    %p       each armed hit fires with probability p (seeded RNG)
+
+Examples::
+
+    frame.load:transient                 first stack load fails once
+    compute.view~144deg:permanent        view 144deg never decodes
+    ply.write:transient@2x3              writes 2,3,4 fail
+    cache.get:transient%0.5              each lookup fails with p=.5 (seeded)
+    ply.write~merged:crash               simulated kill -9 at the merged write
+
+``transient``/``permanent`` raise ordinary exceptions the retry/quarantine
+machinery handles; ``crash`` raises :class:`InjectedCrash` (a BaseException,
+like KeyboardInterrupt) that no per-item handler may swallow — the
+interrupt-mid-stage simulation for crash-safety tests.
+"""
+from __future__ import annotations
+
+import math
+import os
+import random
+import threading
+import time
+import urllib.error
+from dataclasses import dataclass, field
+
+__all__ = [
+    "InjectedFault", "TransientFault", "PermanentFault", "InjectedCrash",
+    "FaultRule", "FaultPlan", "configure", "configure_from", "reset", "fire",
+    "active_plan", "is_transient", "RetryPolicy", "retry_call", "annotate",
+    "FailureRecord",
+]
+
+
+# ---------------------------------------------------------------------------
+# injected exception types
+# ---------------------------------------------------------------------------
+
+class InjectedFault(RuntimeError):
+    """Base of the injectable (catchable) faults."""
+
+    transient = False
+
+
+class TransientFault(InjectedFault):
+    """Models a recoverable blip (dropped connection, EAGAIN, torn read)."""
+
+    transient = True
+
+
+class PermanentFault(InjectedFault):
+    """Models a deterministic failure (corrupt capture, bad view)."""
+
+    transient = False
+
+
+class InjectedCrash(BaseException):
+    """Simulated ``kill -9``: escapes every ``except Exception`` handler, so
+    per-item tolerance cannot swallow it — only crash-safe artifact handling
+    (tmp+rename, startup sweeps, the stage cache) may mask its effects."""
+
+
+# ---------------------------------------------------------------------------
+# the fault plan
+# ---------------------------------------------------------------------------
+
+_KINDS = ("transient", "permanent", "crash")
+
+
+@dataclass
+class FaultRule:
+    site: str
+    kind: str
+    match: str = ""
+    arm_at: int = 1          # start firing on the n-th matching hit
+    times: float = math.inf  # how many times to fire once armed
+    prob: float = 1.0        # per-armed-hit probability (seeded)
+    hits: int = 0
+    fired: int = 0
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultRule":
+        head, sep, tail = text.strip().partition(":")
+        if not sep:
+            raise ValueError(f"fault rule {text!r}: expected site:kind")
+        site, _, match = head.partition("~")
+        kind, arm_at, times, prob = tail, 1, None, 1.0
+        if "%" in kind:
+            kind, p = kind.split("%", 1)
+            prob = float(p)
+        if "x" in kind:
+            kind, m = kind.split("x", 1)
+            times = int(m)
+        if "@" in kind:
+            kind, n = kind.split("@", 1)
+            arm_at = int(n)
+        if kind not in _KINDS:
+            raise ValueError(
+                f"fault rule {text!r}: kind {kind!r} not in {_KINDS}")
+        if times is None:
+            times = math.inf if kind == "permanent" else 1
+        return cls(site=site.strip(), kind=kind, match=match,
+                   arm_at=arm_at, times=times, prob=prob)
+
+    def throw(self) -> None:
+        detail = (f"injected {self.kind} fault at {self.site}"
+                  + (f" (match {self.match!r})" if self.match else ""))
+        if self.kind == "crash":
+            raise InjectedCrash(detail)
+        if self.kind == "transient":
+            raise TransientFault(detail)
+        raise PermanentFault(detail)
+
+
+class FaultPlan:
+    """A parsed, seeded fault plan. Thread-safe: fire() is called from the
+    prefetch/drain/writeback worker threads as well as the main thread."""
+
+    def __init__(self, rules: list[FaultRule], seed: int = 0):
+        self.rules = rules
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        rules = [FaultRule.parse(r) for r in spec.split(",") if r.strip()]
+        return cls(rules, seed)
+
+    def fire(self, site: str, item=None) -> None:
+        text = "" if item is None else str(item)
+        with self._lock:
+            for rule in self.rules:
+                if rule.site != site:
+                    continue
+                if rule.match and rule.match not in text:
+                    continue
+                rule.hits += 1
+                if rule.hits < rule.arm_at or rule.fired >= rule.times:
+                    continue
+                if rule.prob < 1.0 and self._rng.random() > rule.prob:
+                    continue
+                rule.fired += 1
+                rule.throw()
+
+    def counts(self) -> dict[str, int]:
+        """Fired-per-site accounting (for manifests and assertions)."""
+        out: dict[str, int] = {}
+        for r in self.rules:
+            if r.fired:
+                out[r.site] = out.get(r.site, 0) + r.fired
+        return out
+
+
+# module-global active plan; None (the default) means every fire() is a no-op
+_PLAN: FaultPlan | None = None
+
+
+def configure(spec: str = "", seed: int = 0) -> FaultPlan | None:
+    """Install a fault plan process-wide; empty spec deactivates. Returns the
+    installed plan (or None)."""
+    global _PLAN
+    _PLAN = FaultPlan.from_spec(spec, seed) if spec.strip() else None
+    return _PLAN
+
+
+def configure_from(faults_cfg) -> FaultPlan | None:
+    """Arm from a ``FaultsConfig`` section; the ``SL3D_FAULTS`` /
+    ``SL3D_FAULTS_SEED`` env vars win over the config (the chaos-run switch
+    that needs no config file edit)."""
+    spec = os.environ.get("SL3D_FAULTS", "")
+    if spec:
+        seed = int(os.environ.get("SL3D_FAULTS_SEED", "0"))
+    else:
+        spec = getattr(faults_cfg, "spec", "") or ""
+        seed = int(getattr(faults_cfg, "seed", 0) or 0)
+    return configure(spec, seed)
+
+
+def reset() -> None:
+    configure("")
+
+
+def active_plan() -> FaultPlan | None:
+    return _PLAN
+
+
+def fire(site: str, item=None) -> None:
+    """Injection site: raises per the active plan; no-op (one None check)
+    when no plan is armed — the zero-overhead-by-default contract."""
+    if _PLAN is None:
+        return
+    _PLAN.fire(site, item)
+
+
+# ---------------------------------------------------------------------------
+# transient-vs-permanent classification
+# ---------------------------------------------------------------------------
+
+_TRANSIENT_ERRNOS = frozenset({
+    4,    # EINTR
+    11,   # EAGAIN
+    16,   # EBUSY
+    104,  # ECONNRESET
+    110,  # ETIMEDOUT
+    111,  # ECONNREFUSED (service restarting)
+})
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Classify an exception as transient (worth a bounded retry) or
+    permanent (retry is wasted work; quarantine instead).
+
+    Unknown exception types default to permanent — a retry budget spent on a
+    deterministic failure just delays the quarantine decision."""
+    if isinstance(exc, InjectedFault):
+        return exc.transient
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if isinstance(exc, urllib.error.URLError):
+        # wraps socket-level failures; the HTTP capture path's blip class
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in _TRANSIENT_ERRNOS
+    return False
+
+
+# ---------------------------------------------------------------------------
+# bounded retry + exponential backoff
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff: retry ``max_retries`` times, sleeping
+    ``backoff_base_s * 2**(retry-1)`` (capped at ``backoff_max_s``) before
+    each. ``max_retries=0`` disables retrying entirely."""
+
+    max_retries: int = 2
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 1.0
+
+    def delay_s(self, retry: int) -> float:
+        """Backoff before the ``retry``-th retry (1-based)."""
+        return min(self.backoff_base_s * (2.0 ** (retry - 1)),
+                   self.backoff_max_s)
+
+
+def retry_call(fn, policy: RetryPolicy, *, classify=is_transient,
+               on_retry=None, sleep=time.sleep):
+    """Run ``fn()`` with the policy's transient-retry budget.
+
+    Permanent (per ``classify``) or budget-exhausted exceptions re-raise the
+    ORIGINAL exception annotated with ``_sl3d_attempts`` (total attempts
+    made) so failure records can report the true attempt count.
+    ``on_retry(retry_index, exc)`` fires before each backoff sleep — the
+    hook retry counters and logs hang off. :class:`InjectedCrash` is never
+    retried (it models a process kill)."""
+    attempts = 1
+    while True:
+        try:
+            return fn()
+        except InjectedCrash:
+            raise
+        except Exception as e:
+            retries_done = attempts - 1
+            if retries_done >= policy.max_retries or not classify(e):
+                annotate(e, attempts=attempts)
+                raise
+            if on_retry is not None:
+                on_retry(retries_done + 1, e)
+            sleep(policy.delay_s(retries_done + 1))
+            attempts += 1
+
+
+def annotate(exc: BaseException, stage: str | None = None,
+             attempts: int | None = None) -> BaseException:
+    """Attach failure-record context to an exception that will cross a
+    thread/future boundary before being recorded."""
+    if stage is not None:
+        exc._sl3d_stage = stage  # type: ignore[attr-defined]
+    if attempts is not None:
+        exc._sl3d_attempts = attempts  # type: ignore[attr-defined]
+    return exc
+
+
+# ---------------------------------------------------------------------------
+# structured failure records (the quarantine payload)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FailureRecord:
+    """One per-item failure, structured for the failure manifest: which
+    stage, which view, how many attempts were made, what raised, and whether
+    the final exception classified transient (budget exhausted) or permanent
+    (not worth retrying)."""
+
+    stage: str
+    view: str
+    attempts: int
+    error_type: str
+    message: str
+    transient: bool
+    extra: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_exception(cls, stage: str, view: str, exc: BaseException,
+                       attempts: int | None = None) -> "FailureRecord":
+        return cls(
+            stage=getattr(exc, "_sl3d_stage", None) or stage,
+            view=view,
+            attempts=attempts if attempts is not None
+            else getattr(exc, "_sl3d_attempts", 1),
+            error_type=type(exc).__name__,
+            message=str(exc),
+            transient=is_transient(exc),
+        )
+
+    def as_dict(self) -> dict:
+        out = {"stage": self.stage, "view": self.view,
+               "attempts": self.attempts, "error_type": self.error_type,
+               "message": self.message, "transient": self.transient}
+        if self.extra:
+            out["extra"] = self.extra
+        return out

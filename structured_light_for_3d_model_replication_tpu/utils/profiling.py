@@ -137,6 +137,8 @@ class OverlapStats:
     def __init__(self):
         self._lock = threading.Lock()
         self._stage_s = {s: 0.0 for s in self._STAGES}
+        self._retries = {s: 0 for s in self._STAGES}
+        self._failures = {s: 0 for s in self._STAGES}
         self._items = 0
         self._queue_samples: list[int] = []
         self.critical_path_s = 0.0
@@ -149,6 +151,22 @@ class OverlapStats:
         with self._lock:
             self._stage_s[stage] += elapsed_s
             self._items += items
+
+    def add_retry(self, stage: str) -> None:
+        """Count one transient-fault retry in a lane (the resilience layer's
+        per-lane gauge: a climbing load retry count with a flat failure
+        count means backoff is absorbing the blips it is meant to)."""
+        if stage not in self._retries:
+            raise ValueError(f"unknown pipeline stage {stage!r}")
+        with self._lock:
+            self._retries[stage] += 1
+
+    def add_failure(self, stage: str) -> None:
+        """Count one exhausted/permanent per-item failure in a lane."""
+        if stage not in self._failures:
+            raise ValueError(f"unknown pipeline stage {stage!r}")
+        with self._lock:
+            self._failures[stage] += 1
 
     def sample_queue(self, depth: int) -> None:
         with self._lock:
@@ -172,16 +190,25 @@ class OverlapStats:
         out["items"] = self._items
         out["max_queue_depth"] = max(q) if q else 0
         out["mean_queue_depth"] = round(sum(q) / len(q), 2) if q else 0.0
+        out["retries"] = dict(self._retries)
+        out["failures"] = dict(self._failures)
+        out["retry_total"] = sum(self._retries.values())
+        out["failure_total"] = sum(self._failures.values())
         return out
 
     def summary(self) -> str:
         d = self.as_dict()
         clean = (f" + clean {d['clean_s']}s" if d.get("clean_s") else "")
+        resil = ""
+        if d["retry_total"] or d["failure_total"]:
+            resil = (f", {d['retry_total']} retries / "
+                     f"{d['failure_total']} failures")
         return (f"load {d['load_s']}s + compute {d['compute_s']}s{clean}"
                 f" + write {d['write_s']}s = {d['serial_sum_s']}s "
                 f"serial-equivalent in {d['critical_path_s']}s wall "
                 f"(overlap x{d['overlap_ratio']}, queue depth "
-                f"max {d['max_queue_depth']} mean {d['mean_queue_depth']})")
+                f"max {d['max_queue_depth']} mean {d['mean_queue_depth']}"
+                f"{resil})")
 
 
 @contextlib.contextmanager

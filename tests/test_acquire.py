@@ -320,3 +320,103 @@ def test_auto_scan_progress_feeds_viewer_recorder(tmp_path):
     assert [e["view"] for e in prog] == [1, 2, 3]
     assert all(e["stage"] == "autoscan" for e in prog)
     assert prog[-1]["remaining_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# resilience (ISSUE 3): capture retries, rotation recovery, injected faults
+# ---------------------------------------------------------------------------
+
+def test_auto_scan_rotation_recovery_reopens_and_retries(tmp_path):
+    """A missed DONE with a retry budget re-opens the serial line and
+    re-issues the rotation — the sweep completes with NO warning."""
+    proj = VirtualProjector(32, 16)
+    seq = CaptureSequencer(proj, lambda p: open(p, "wb").write(b"x"),
+                           proj_size=(32, 16), log=lambda *_: None)
+    table = LoopbackTurntable(fail_after=1)  # second rotation misses DONE
+    res = auto_scan_360(seq, table, str(tmp_path), turns=3, step_deg=120.0,
+                        rotate_retries=1, log=lambda *_: None)
+    assert len(res.view_dirs) == 3
+    assert res.rotation_warnings == []
+    assert table.reopens == 1 and res.rotate_retries == 1
+
+
+def test_auto_scan_rotation_recovery_exhausts_to_warning(tmp_path):
+    """A permanently dead line exhausts the budget and degrades to the
+    reference's warn-and-continue."""
+    proj = VirtualProjector(32, 16)
+    seq = CaptureSequencer(proj, lambda p: open(p, "wb").write(b"x"),
+                           proj_size=(32, 16), log=lambda *_: None)
+    table = LoopbackTurntable(fail_after=1, recover_on_reopen=False)
+    res = auto_scan_360(seq, table, str(tmp_path), turns=3, step_deg=120.0,
+                        rotate_retries=2, log=lambda *_: None)
+    assert len(res.view_dirs) == 3
+    assert res.rotation_warnings == [2] and table.reopens == 2
+
+
+def test_auto_scan_capture_retry_absorbs_transient(tmp_path):
+    """One transient capture failure (dropped phone link) is retried and the
+    sweep records every view."""
+    proj = VirtualProjector(32, 16)
+    state = {"fails": 1}
+
+    def flaky_capture(p):
+        if state["fails"]:
+            state["fails"] -= 1
+            raise ConnectionResetError("wifi blip")
+        open(p, "wb").write(b"x")
+
+    seq = CaptureSequencer(proj, flaky_capture, proj_size=(32, 16),
+                           log=lambda *_: None)
+    res = auto_scan_360(seq, LoopbackTurntable(), str(tmp_path), turns=2,
+                        step_deg=180.0, capture_retries=1,
+                        log=lambda *_: None)
+    assert len(res.view_dirs) == 2 and res.failures == []
+    assert res.capture_retries == 1
+
+
+def test_auto_scan_quarantines_failed_view_and_continues(tmp_path):
+    """A permanently failing view is recorded as a FailureRecord and the
+    sweep continues — the reconstruction layer's min-views degradation
+    handles the hole downstream."""
+    proj = VirtualProjector(32, 16)
+    calls = {"n": 0}
+
+    def capture(p):
+        calls["n"] += 1
+        if "120deg" in os.path.dirname(p):
+            raise ValueError("sensor returned garbage")
+        open(p, "wb").write(b"x")
+
+    seq = CaptureSequencer(proj, capture, proj_size=(32, 16),
+                           log=lambda *_: None)
+    res = auto_scan_360(seq, LoopbackTurntable(), str(tmp_path), turns=3,
+                        step_deg=120.0, capture_retries=2,
+                        log=lambda *_: None)
+    assert len(res.view_dirs) == 2  # 0deg and 240deg survive
+    assert len(res.failures) == 1
+    rec = res.failures[0]
+    assert "120deg" in rec.view and rec.stage == "capture"
+    assert not rec.transient  # ValueError classifies permanent: no retry
+    assert rec.attempts == 1
+
+
+def test_injected_serial_fault_drives_rotation_recovery(tmp_path):
+    """The serial.rotate injection site exercises the same recovery path as
+    real hardware faults — deterministic chaos for the sweep."""
+    from structured_light_for_3d_model_replication_tpu.utils import faults
+
+    proj = VirtualProjector(32, 16)
+    seq = CaptureSequencer(proj, lambda p: open(p, "wb").write(b"x"),
+                           proj_size=(32, 16), log=lambda *_: None)
+    table = LoopbackTurntable()
+    faults.configure("serial.rotate:transient")
+    try:
+        res = auto_scan_360(seq, table, str(tmp_path), turns=3,
+                            step_deg=120.0, rotate_retries=1,
+                            log=lambda *_: None)
+    finally:
+        faults.reset()
+    assert len(res.view_dirs) == 3
+    assert res.rotation_warnings == [] and res.rotate_retries == 1
+    assert table.reopens == 1
+    assert len(table.commands) == 2  # the lost rotation was re-issued

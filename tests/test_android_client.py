@@ -154,3 +154,49 @@ def test_capture_jpeg_returns_bytes_and_meta(fake_device, tmp_path):
 
 def test_unreachable_is_false():
     assert not AndroidCameraClient("127.0.0.1", 1).reachable()
+
+
+def test_capture_retry_absorbs_injected_transient(fake_device, tmp_path):
+    """Resilience: an injected http.capture fault (standing in for a dropped
+    Wi-Fi association) is absorbed by the bounded retry and the frame still
+    lands — atomically, with no staging debris."""
+    from structured_light_for_3d_model_replication_tpu.utils import faults
+
+    c = AndroidCameraClient("127.0.0.1", fake_device.server_address[1],
+                            backoff_s=0.0)
+    faults.configure("http.capture:transient")
+    try:
+        out = tmp_path / "frame.jpg"
+        c.capture_to_path(str(out))
+    finally:
+        faults.reset()
+    assert c.retry_count == 1
+    assert out.read_bytes().startswith(b"\xff\xd8")
+    assert [f for f in tmp_path.iterdir() if ".tmp" in f.name] == []
+
+
+def test_capture_exhausted_budget_raises_and_writes_nothing(fake_device,
+                                                            tmp_path):
+    from structured_light_for_3d_model_replication_tpu.utils import faults
+
+    c = AndroidCameraClient("127.0.0.1", fake_device.server_address[1],
+                            retries=1, backoff_s=0.0)
+    faults.configure("http.capture:transientx99")  # outlasts the budget
+    try:
+        with pytest.raises(faults.TransientFault) as ei:
+            c.capture_to_path(str(tmp_path / "frame.jpg"))
+    finally:
+        faults.reset()
+    assert ei.value._sl3d_attempts == 2  # 1 try + 1 retry
+    assert list(tmp_path.iterdir()) == []  # no partial frame, no .tmp
+
+
+def test_http_4xx_is_permanent_5xx_transient():
+    import io
+    import urllib.error
+
+    perm = urllib.error.HTTPError("u", 404, "nf", {}, io.BytesIO())
+    srv = urllib.error.HTTPError("u", 503, "restarting", {}, io.BytesIO())
+    assert not AndroidCameraClient._transient(perm)
+    assert AndroidCameraClient._transient(srv)
+    assert AndroidCameraClient._transient(urllib.error.URLError("drop"))

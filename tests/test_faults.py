@@ -1,0 +1,227 @@
+"""The deterministic fault-injection layer + retry/quarantine toolkit
+(utils/faults.py): rule grammar, seeded plans, transient classification,
+backoff schedule, and the structured FailureRecord contract."""
+import urllib.error
+
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves the process-wide plan disarmed."""
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# rule grammar
+# ---------------------------------------------------------------------------
+
+def test_rule_parse_full_grammar():
+    r = faults.FaultRule.parse("frame.load~072deg:transient@2x3%0.5")
+    assert r.site == "frame.load" and r.kind == "transient"
+    assert r.match == "072deg" and r.arm_at == 2
+    assert r.times == 3 and r.prob == 0.5
+
+
+def test_rule_parse_defaults():
+    t = faults.FaultRule.parse("ply.write:transient")
+    assert t.times == 1  # transient fires once by default
+    p = faults.FaultRule.parse("compute.view:permanent")
+    assert p.times == float("inf")  # permanent fires every time
+
+
+@pytest.mark.parametrize("bad", ["nosep", "x:notakind", "a:transient@z"])
+def test_rule_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.FaultRule.parse(bad)
+
+
+# ---------------------------------------------------------------------------
+# plan firing
+# ---------------------------------------------------------------------------
+
+def test_disarmed_fire_is_noop():
+    faults.reset()
+    for _ in range(100):
+        faults.fire("frame.load", item="anything")  # must never raise
+
+
+def test_transient_fires_once_then_stops():
+    faults.configure("frame.load:transient")
+    with pytest.raises(faults.TransientFault):
+        faults.fire("frame.load", item="v0")
+    faults.fire("frame.load", item="v0")  # budget spent: silent
+    faults.fire("other.site")             # different site: never armed
+
+
+def test_permanent_fires_every_matching_hit_and_respects_match():
+    faults.configure("compute.view~bad:permanent")
+    faults.fire("compute.view", item="good_view")  # substring miss
+    for _ in range(3):
+        with pytest.raises(faults.PermanentFault):
+            faults.fire("compute.view", item="bad_view")
+
+
+def test_arm_at_and_times_window():
+    faults.configure("ply.write:transient@2x2")
+    faults.fire("ply.write")                     # hit 1: not yet armed
+    for _ in range(2):                           # hits 2,3 fire
+        with pytest.raises(faults.TransientFault):
+            faults.fire("ply.write")
+    faults.fire("ply.write")                     # hit 4: budget spent
+
+
+def test_probabilistic_rule_is_seed_deterministic():
+    def fired_pattern(seed):
+        plan = faults.FaultPlan.from_spec("cache.get:transientx1000%0.5",
+                                          seed=seed)
+        out = []
+        for i in range(50):
+            try:
+                plan.fire("cache.get", item=i)
+                out.append(False)
+            except faults.TransientFault:
+                out.append(True)
+        return out
+
+    a, b = fired_pattern(7), fired_pattern(7)
+    assert a == b and any(a) and not all(a)
+    assert fired_pattern(8) != a
+
+
+def test_crash_kind_escapes_except_exception():
+    faults.configure("ply.write~merged:crash")
+    with pytest.raises(faults.InjectedCrash):
+        try:
+            faults.fire("ply.write", item="/out/merged.ply")
+        except Exception:  # per-item tolerance must NOT swallow a crash
+            pytest.fail("InjectedCrash was caught by `except Exception`")
+
+
+def test_plan_counts_and_env_override(monkeypatch):
+    faults.configure("a.b:transient,c.d:permanent")
+    plan = faults.active_plan()
+    with pytest.raises(faults.TransientFault):
+        faults.fire("a.b")
+    assert plan.counts() == {"a.b": 1}
+
+    monkeypatch.setenv("SL3D_FAULTS", "x.y:permanent")
+    monkeypatch.setenv("SL3D_FAULTS_SEED", "3")
+
+    class Cfg:
+        spec = "a.b:transient"
+        seed = 0
+
+    plan = faults.configure_from(Cfg())
+    assert plan.rules[0].site == "x.y" and plan.seed == 3
+    monkeypatch.delenv("SL3D_FAULTS")
+    assert faults.configure_from(Cfg()).rules[0].site == "a.b"
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+def test_is_transient_classification():
+    assert faults.is_transient(faults.TransientFault("x"))
+    assert not faults.is_transient(faults.PermanentFault("x"))
+    assert faults.is_transient(ConnectionResetError())
+    assert faults.is_transient(TimeoutError())
+    assert faults.is_transient(urllib.error.URLError("dropped"))
+    assert faults.is_transient(OSError(11, "EAGAIN"))
+    assert not faults.is_transient(OSError(2, "ENOENT"))
+    # unknown types default to permanent: retrying a deterministic failure
+    # only delays the quarantine decision
+    assert not faults.is_transient(ValueError("corrupt"))
+    assert not faults.is_transient(KeyError("k"))
+
+
+# ---------------------------------------------------------------------------
+# retry + backoff
+# ---------------------------------------------------------------------------
+
+def test_retry_call_backoff_schedule_exact():
+    """The acceptance-criteria contract: retries happen exactly per policy —
+    doubling from backoff_base_s, capped at backoff_max_s, max_retries
+    total, then the ORIGINAL exception with the true attempt count."""
+    policy = faults.RetryPolicy(max_retries=3, backoff_base_s=0.1,
+                                backoff_max_s=0.25)
+    sleeps, retries = [], []
+    calls = {"n": 0}
+
+    def always_transient():
+        calls["n"] += 1
+        raise faults.TransientFault(f"attempt {calls['n']}")
+
+    with pytest.raises(faults.TransientFault, match="attempt 4") as ei:
+        faults.retry_call(always_transient, policy,
+                          on_retry=lambda n, e: retries.append(n),
+                          sleep=sleeps.append)
+    assert calls["n"] == 4                    # 1 try + 3 retries
+    assert sleeps == [0.1, 0.2, 0.25]         # doubling, capped
+    assert retries == [1, 2, 3]
+    assert ei.value._sl3d_attempts == 4
+
+
+def test_retry_call_recovers_and_skips_permanent():
+    policy = faults.RetryPolicy(max_retries=2, backoff_base_s=0.0)
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 2:
+            raise faults.TransientFault("blip")
+        return "ok"
+
+    assert faults.retry_call(flaky, policy, sleep=lambda s: None) == "ok"
+
+    def perma():
+        state["n"] += 1
+        raise faults.PermanentFault("dead")
+
+    state["n"] = 0
+    with pytest.raises(faults.PermanentFault):
+        faults.retry_call(perma, policy, sleep=lambda s: None)
+    assert state["n"] == 1  # permanent: no retry at all
+
+    with pytest.raises(faults.InjectedCrash):  # crashes are never retried
+        faults.retry_call(
+            lambda: (_ for _ in ()).throw(faults.InjectedCrash("kill")),
+            policy, sleep=lambda s: None)
+
+
+def test_zero_budget_policy_disables_retry():
+    policy = faults.RetryPolicy(max_retries=0)
+    calls = {"n": 0}
+
+    def f():
+        calls["n"] += 1
+        raise faults.TransientFault("x")
+
+    with pytest.raises(faults.TransientFault):
+        faults.retry_call(f, policy, sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# failure records
+# ---------------------------------------------------------------------------
+
+def test_failure_record_from_annotated_exception():
+    e = faults.annotate(faults.TransientFault("torn read"),
+                        stage="load", attempts=3)
+    rec = faults.FailureRecord.from_exception("compute", "scan_072deg", e)
+    assert rec.stage == "load"          # annotation wins over the default
+    assert rec.attempts == 3
+    assert rec.view == "scan_072deg"
+    assert rec.error_type == "TransientFault" and rec.transient
+    d = rec.as_dict()
+    assert d["stage"] == "load" and d["transient"] is True
+
+    plain = faults.FailureRecord.from_exception("compute", "v",
+                                                ValueError("bad"))
+    assert plain.stage == "compute" and plain.attempts == 1
+    assert not plain.transient

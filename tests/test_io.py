@@ -117,3 +117,153 @@ def test_image_stack_errors(tmp_path):
     images.save_stack(str(folder), np.zeros((2, 8, 8), np.uint8))
     with pytest.raises(ValueError, match="at least 4"):
         images.load_stack(str(folder))
+
+
+# ---------------------------------------------------------------------------
+# resilience satellites (ISSUE 3): corrupt inputs, atomic publish, aggregate
+# writeback errors
+# ---------------------------------------------------------------------------
+
+def test_zero_byte_frame_raises_clean_error(tmp_path):
+    """A zero-byte frame image (crashed capture) must surface as an ordinary
+    exception the per-item tolerance can quarantine — never a crash deeper
+    in the stack."""
+    from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
+    frames = gc.generate_pattern_stack(32, 16, brightness=200)
+    folder = str(tmp_path / "scan")
+    paths = images.save_stack(folder, frames)
+    open(paths[2], "wb").close()  # truncate one frame to zero bytes
+    with pytest.raises(Exception) as ei:
+        images.load_stack(folder)
+    assert isinstance(ei.value, (IOError, ValueError))
+
+
+def test_truncated_ply_body_named_not_buffer_error(tmp_path, cloud):
+    """Satellite: a PLY whose body is shorter than the header promises (torn
+    write, partial copy) raises a named truncation error for BOTH vertex and
+    face elements — not numpy's generic buffer complaint."""
+    pts, cols, _ = cloud
+    p = str(tmp_path / "c.ply")
+    ply.write_ply(p, pts, cols)
+    blob = open(p, "rb").read()
+    cut = str(tmp_path / "cut.ply")
+    with open(cut, "wb") as f:
+        f.write(blob[:len(blob) - 100])
+    with pytest.raises(ValueError, match="truncated PLY body"):
+        ply.read_ply(cut)
+
+    verts = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0], [0, 0, 1]], np.float32)
+    faces = np.array([[0, 1, 2], [0, 2, 3]], np.int32)
+    m = str(tmp_path / "m.ply")
+    ply.write_mesh_ply(m, verts, faces)
+    blob = open(m, "rb").read()
+    with open(cut, "wb") as f:
+        f.write(blob[:len(blob) - 5])  # cut inside the face list
+    with pytest.raises(ValueError, match="truncated PLY body"):
+        ply.read_ply(cut)
+
+
+def test_ply_write_is_atomic_no_tmp_after_success(tmp_path, cloud):
+    pts, cols, _ = cloud
+    for name, write in (
+        ("bin.ply", lambda p: ply.write_ply(p, pts, cols)),
+        ("asc.ply", lambda p: ply.write_ply(p, pts, cols, binary=False)),
+        ("mesh.ply", lambda p: ply.write_mesh_ply(
+            p, pts[:4], np.array([[0, 1, 2], [0, 2, 3]], np.int32))),
+        ("m.stl", lambda p: stl.write_stl(
+            p, pts[:4], np.array([[0, 1, 2], [0, 2, 3]], np.int32))),
+    ):
+        p = str(tmp_path / name)
+        write(p)
+        assert ply.read_ply(p) if name.endswith(".ply") else stl.read_stl(p)
+        leftovers = [f for f in tmp_path.iterdir() if ".tmp" in f.name]
+        assert leftovers == [], f"{name} left staging debris: {leftovers}"
+
+
+def test_crash_mid_write_leaves_previous_artifact_intact(tmp_path, cloud):
+    """Crash-safety acceptance: an InjectedCrash at the write site leaves
+    either the previous complete artifact or nothing — never partial bytes
+    — and no un-swept staging file that masquerades as data."""
+    from structured_light_for_3d_model_replication_tpu.utils import faults
+
+    pts, cols, _ = cloud
+    p = str(tmp_path / "c.ply")
+    ply.write_ply(p, pts[:100], cols[:100])
+    before = open(p, "rb").read()
+    faults.configure("ply.write:crash")
+    try:
+        with pytest.raises(faults.InjectedCrash):
+            ply.write_ply(p, pts, cols)
+    finally:
+        faults.reset()
+    assert open(p, "rb").read() == before
+    assert [f for f in tmp_path.iterdir() if ".tmp" in f.name] == []
+
+
+def test_sweep_tmp_removes_stale_orphans(tmp_path):
+    from structured_light_for_3d_model_replication_tpu.io import atomic
+
+    (tmp_path / "a.ply.tmp").write_bytes(b"partial")
+    (tmp_path / "cache").mkdir()
+    (tmp_path / "cache" / "view-x.npz.tmp.npz").write_bytes(b"partial")
+    (tmp_path / "keep.ply").write_bytes(b"real")
+    removed = atomic.sweep_tmp(str(tmp_path), recursive=True)
+    assert len(removed) == 2
+    assert (tmp_path / "keep.ply").exists()
+    assert not (tmp_path / "a.ply.tmp").exists()
+    # missing folder is a no-op, not an error
+    assert atomic.sweep_tmp(str(tmp_path / "nope")) == []
+
+
+def test_writeback_drain_aggregates_all_errors(tmp_path, cloud):
+    """Satellite fix: drain() must surface EVERY failed write, not just the
+    first — later failures were silently dropped before."""
+    from structured_light_for_3d_model_replication_tpu.utils import faults
+
+    pts, cols, _ = cloud
+    ok_dir = tmp_path / "ok"
+    ok_dir.mkdir()
+    # two doomed writes (unwritable directories) sandwiching a good one
+    bad1 = str(tmp_path / "no_dir_1" / "a.ply")
+    good = str(ok_dir / "b.ply")
+    bad2 = str(tmp_path / "no_dir_2" / "c.ply")
+    q = ply.WritebackQueue()
+    q.submit(bad1, pts, cols)
+    q.submit(good, pts, cols)
+    q.submit(bad2, pts, cols)
+    with pytest.raises(ply.PlyWriteError) as ei:
+        q.drain()
+    q.close()
+    assert len(ei.value.errors) == 2
+    assert {p for p, _ in ei.value.errors} == {bad1, bad2}
+    assert "2 PLY write(s) failed" in str(ei.value)
+    ply.read_ply(good)  # the good write still landed
+
+    # a clean drain returns the written paths and clears the backlog
+    q = ply.WritebackQueue()
+    q.submit(good, pts, cols)
+    assert q.drain() == [good]
+    assert q.drain() == []  # idempotent after clear
+    q.close()
+
+
+def test_writeback_retry_policy_absorbs_transients(tmp_path, cloud):
+    """The write lane's bounded retry: an injected transient ply.write fault
+    is retried inside the writer thread and the write still lands."""
+    from structured_light_for_3d_model_replication_tpu.utils import faults
+
+    pts, cols, _ = cloud
+    p = str(tmp_path / "c.ply")
+    notes = []
+    faults.configure("ply.write:transient")
+    try:
+        q = ply.WritebackQueue(
+            retry=faults.RetryPolicy(max_retries=2, backoff_base_s=0.0),
+            on_retry=lambda path, n, e: notes.append((path, n)))
+        q.submit(p, pts, cols)
+        assert q.drain() == [p]
+        q.close()
+    finally:
+        faults.reset()
+    assert notes == [(p, 1)]
+    np.testing.assert_array_equal(ply.read_ply(p)["points"], pts)

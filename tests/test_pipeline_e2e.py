@@ -240,6 +240,158 @@ def test_pipeline_cli_and_print_alias(dataset, tmp_path):
     assert rc == 0
 
 
+def test_chaos_e2e_degraded_run_matches_clean_four_view_run(
+        tmp_path_factory):
+    """ISSUE 3 acceptance: with 1 transient + 1 permanent injected fault
+    across 5 synthetic views, the pipeline completes, retries the transient
+    exactly per the backoff policy, quarantines the permanent view with a
+    FailureRecord in the manifest — and the merged output is byte-identical
+    to a clean run over the 4 surviving views."""
+    import json
+    import shutil
+
+    from structured_light_for_3d_model_replication_tpu.utils import faults
+
+    base = tmp_path_factory.mktemp("chaos")
+    root5 = str(base / "ds5")
+    assert cli_main(["synth", root5, "--views", "5",
+                     "--cam", "160x120", "--proj", "128x64"]) == 0
+    calib = os.path.join(root5, "calib.mat")
+    # 5 views at 72deg: 000 / 072 / 144 / 216 / 288
+    spec = ("frame.load~072deg:transient,"
+            "compute.view~216deg:permanent")
+
+    out_chaos = str(base / "out_chaos")
+    faults.configure(spec, seed=0)
+    try:
+        logs = []
+        rep = stages.run_pipeline(calib, root5, out_chaos, cfg=_cfg(),
+                                  steps=STEPS, log=logs.append)
+    finally:
+        plan = faults.active_plan()
+        faults.reset()
+    # transient retried exactly once (one injected blip, absorbed); the
+    # permanent view fired once per attempt budget and was quarantined
+    assert rep.retries == 1
+    assert rep.degraded and len(rep.failures) == 1
+    rec = rep.failures[0]
+    assert "216deg" in rec.view and not rec.transient
+    assert rec.error_type == "PermanentFault"
+    assert plan.counts()["frame.load"] == 1
+    assert rep.views_computed == 4
+    assert any("DEGRADED" in m for m in logs)
+    # quarantine record + manifest on disk, crash-safe
+    qrec = os.path.join(out_chaos, "quarantine", f"{rec.view}.json")
+    assert os.path.exists(qrec)
+    assert rep.manifest_path and os.path.exists(rep.manifest_path)
+    with open(rep.manifest_path) as f:
+        manifest = json.load(f)
+    assert manifest["views_total"] == 5 and manifest["views_survived"] == 4
+    assert len(manifest["failures"]) == 1 and manifest["retries"] == 1
+
+    # ---- clean 4-view run: the same dataset minus the quarantined view ----
+    root4 = str(base / "ds4")
+    shutil.copytree(root5, root4)
+    shutil.rmtree(os.path.join(root4, "scan_216deg_scan"))
+    out_clean = str(base / "out_clean")
+    rep4 = stages.run_pipeline(calib, root4, out_clean, cfg=_cfg(),
+                               steps=STEPS, log=lambda m: None)
+    assert rep4.failed == [] and not rep4.degraded
+    assert rep4.manifest_path is None
+    assert not os.path.exists(os.path.join(out_clean, "failures.json"))
+    with open(rep.merged_ply, "rb") as fa, open(rep4.merged_ply, "rb") as fb:
+        assert fa.read() == fb.read(), "degraded merge != clean 4-view merge"
+    with open(rep.stl_path, "rb") as fa, open(rep4.stl_path, "rb") as fb:
+        assert fa.read() == fb.read()
+
+
+@pytest.mark.parametrize("site", [
+    "frame.load", "compute.view", "ply.write~merged", "ply.write~model",
+    "cache.get", "cache.put"])
+def test_crash_at_any_site_leaves_no_partial_artifact_and_resumes(
+        dataset, tmp_path, site):
+    """Crash-safety acceptance: a simulated kill -9 (InjectedCrash escapes
+    every per-item handler) at each injection site leaves NO partial final
+    artifact and no poisoned cache entry; the rerun resumes from the first
+    dirty stage and completes."""
+    from structured_light_for_3d_model_replication_tpu.utils import faults
+
+    out = str(tmp_path / "out")
+    calib = os.path.join(dataset, "calib.mat")
+    faults.configure(f"{site}:crash")
+    try:
+        with pytest.raises(faults.InjectedCrash):
+            stages.run_pipeline(calib, dataset, out, cfg=_cfg(), steps=STEPS,
+                                log=lambda m: None)
+    finally:
+        faults.reset()
+    # no half-written FINAL artifact: merged/STL are absent or fully
+    # readable, and no staging debris survived the unwind
+    for name in ("merged.ply", "model.stl"):
+        p = os.path.join(out, name)
+        if os.path.exists(p):
+            assert plyio.read_ply(p) if name.endswith(".ply") else True
+    for dirpath, _, files in os.walk(out):
+        for f in files:
+            assert ".tmp" not in f, f"staging debris: {dirpath}/{f}"
+    # rerun (faults disarmed) resumes and completes; every cache entry it
+    # reads verified against its digest, so nothing poisoned survives
+    rep = stages.run_pipeline(calib, dataset, out, cfg=_cfg(), steps=STEPS,
+                              log=lambda m: None)
+    assert rep.failed == []
+    assert os.path.getsize(rep.stl_path) > 0
+    assert plyio.read_ply(rep.merged_ply)["points"].shape[0] > 0
+    if site.startswith("ply.write"):
+        # the crash hit AFTER every stage published to the cache: the rerun
+        # must do zero view recompute — resume from the first dirty stage
+        assert rep.views_cached == 3 and rep.views_computed == 0
+
+
+def test_corrupt_cache_entry_evicted_and_recomputed(dataset, tmp_path):
+    """Satellite: a cache entry whose payload rots on disk (bit flip, torn
+    write survivor) must be EVICTED on read and recomputed — never handed
+    to a downstream stage — and a mismatched __key__ reads as a clean
+    miss."""
+    import glob
+
+    out = str(tmp_path / "out")
+    calib = os.path.join(dataset, "calib.mat")
+    rep1 = stages.run_pipeline(calib, dataset, out, cfg=_cfg(), steps=STEPS,
+                               log=lambda m: None)
+    merged_bytes = open(rep1.merged_ply, "rb").read()
+    entries = sorted(glob.glob(os.path.join(out, ".slscan-cache",
+                                            "view-*.npz")))
+    assert len(entries) == 3
+
+    # flip bytes in the middle of one payload
+    blob = bytearray(open(entries[0], "rb").read())
+    mid = len(blob) // 2
+    for i in range(mid, mid + 32):
+        blob[i] ^= 0xFF
+    with open(entries[0], "wb") as f:
+        f.write(bytes(blob))
+
+    logs = []
+    rep2 = stages.run_pipeline(calib, dataset, out, cfg=_cfg(), steps=STEPS,
+                               log=logs.append)
+    assert rep2.failed == []
+    assert rep2.views_cached == 2 and rep2.views_computed == 1
+    assert rep2.cache["evicted"] >= 1
+    assert any("evicted" in m for m in logs if "[cache]" in m)
+    # the recomputed view chains to the SAME downstream digests: merge and
+    # mesh stay cache-hits and the artifacts are unchanged
+    assert rep2.merge_status == "cache-hit"
+    assert open(rep2.merged_ply, "rb").read() == merged_bytes
+
+    # __key__ mismatch (16-hex-prefix collision shape): clean miss, no crash
+    with np.load(entries[1], allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files if k != "__key__"}
+    np.savez(entries[1][:-4], __key__=np.asarray("deadbeef" * 8), **arrays)
+    rep3 = stages.run_pipeline(calib, dataset, out, cfg=_cfg(), steps=STEPS,
+                               log=lambda m: None)
+    assert rep3.failed == [] and rep3.views_computed == 1
+
+
 def test_view_plys_side_output_is_binary_even_with_ascii(dataset, tmp_path):
     """Satellite: intermediate pipeline writes stay binary regardless of the
     user-facing ASCII flag; only the final merged PLY honors it."""

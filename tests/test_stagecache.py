@@ -1,0 +1,104 @@
+"""StageCache resilience contract: best-effort puts that never leak tmp
+files, orphan sweep on init, digest verification that evicts corruption,
+and clean misses for key collisions."""
+import os
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.pipeline.stagecache import (
+    StageCache,
+)
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.reset()
+
+
+def _arrays(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"points": rng.normal(size=(50, 3)).astype(np.float32),
+            "colors": rng.integers(0, 255, (50, 3)).astype(np.uint8)}
+
+
+def test_roundtrip_and_stats(tmp_path):
+    c = StageCache(str(tmp_path / "cache"))
+    key = c.key("view", config_json="{}")
+    assert c.get("view", key) is None
+    c.put("view", key, **_arrays())
+    out = c.get("view", key)
+    np.testing.assert_array_equal(out["points"], _arrays()["points"])
+    assert c.stats() == {"hits": 1, "misses": 1, "hit_stages": ["view"],
+                         "evicted": 0, "put_errors": 0}
+
+
+def test_failed_put_cleans_tmp_and_does_not_raise(tmp_path):
+    """Satellite fix: a failed np.savez used to leak the .tmp forever AND
+    kill the run; now it cleans up and the computed result survives."""
+    root = str(tmp_path / "cache")
+    c = StageCache(root)
+    faults.configure("cache.put:permanent")
+    key = c.key("view", config_json="{}")
+    c.put("view", key, **_arrays())  # must not raise
+    faults.reset()
+    assert c.stats()["put_errors"] == 1
+    assert [f for f in os.listdir(root) if ".tmp" in f] == []
+    assert c.get("view", key) is None  # nothing half-published
+
+
+def test_init_sweeps_orphaned_tmp(tmp_path):
+    root = tmp_path / "cache"
+    root.mkdir()
+    (root / "view-deadbeef.npz.tmp").write_bytes(b"partial")
+    (root / "view-deadbeef.npz.tmp.npz").write_bytes(b"partial")
+    StageCache(str(root))
+    assert [f for f in os.listdir(root) if ".tmp" in f] == []
+
+
+def test_corrupt_payload_evicted_on_read(tmp_path):
+    c = StageCache(str(tmp_path / "cache"))
+    key = c.key("view", config_json="{}")
+    c.put("view", key, **_arrays())
+    path = c._path("view", key)
+    blob = bytearray(open(path, "rb").read())
+    mid = len(blob) // 2
+    for i in range(mid, mid + 16):
+        blob[i] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    assert c.get("view", key) is None
+    assert not os.path.exists(path), "corrupt entry must be evicted"
+    assert c.stats()["evicted"] == 1
+    # and the slot is immediately reusable
+    c.put("view", key, **_arrays())
+    assert c.get("view", key) is not None
+
+
+def test_key_prefix_collision_reads_as_clean_miss(tmp_path):
+    """Satellite: an entry whose stored __key__ mismatches (16-hex-prefix
+    collision shape) is a miss — never a wrong hit, never a crash."""
+    c = StageCache(str(tmp_path / "cache"))
+    key = c.key("view", config_json="{}")
+    path = c._path("view", key)
+    np.savez(path[:-4], __key__=np.asarray("f" * 64), **_arrays())
+    assert c.get("view", key) is None
+    assert c.stats()["hits"] == 0
+
+
+def test_verify_false_skips_digest_check(tmp_path):
+    c = StageCache(str(tmp_path / "cache"), verify=False)
+    key = c.key("view", config_json="{}")
+    c.put("view", key, **_arrays())
+    assert c.get("view", key) is not None
+
+
+def test_disabled_cache_is_all_misses_no_files(tmp_path):
+    root = str(tmp_path / "cache")
+    c = StageCache(root, enabled=False)
+    key = "a" * 64
+    c.put("view", key, **_arrays())
+    assert c.get("view", key) is None
+    assert not os.path.isdir(root)

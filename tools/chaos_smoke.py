@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Seeded chaos smoke for CI (run by tools/ci_tier1.sh).
+
+Renders a 5-view synthetic turntable dataset, arms the deterministic
+fault-injection plan from ISSUE 3's acceptance criteria — one transient
+``frame.load`` fault (must be absorbed by a backoff retry) plus one
+permanent ``compute.view`` fault (must quarantine that view) — and runs
+``sl3d pipeline`` end to end, asserting the resilience contract:
+
+  - exit code 0: a degraded-but-completed run is a success
+  - exactly 1 FailureRecord in the failure manifest, >= 1 retry recorded
+  - the merged STL exists (4 of 5 views merged)
+  - the quarantine folder holds the failed view's record
+
+Prints ``CHAOS_SMOKE=ok`` (exit 0) or ``CHAOS_SMOKE=FAIL (...)`` (exit 1).
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# arm via env (wins over any config file) BEFORE the CLI reads config;
+# 5 views at 72 deg/step -> folders 000/072/144/216/288
+FAULT_SPEC = "frame.load~000deg:transient,compute.view~216deg:permanent"
+
+
+def fail(why: str) -> int:
+    print(f"CHAOS_SMOKE=FAIL ({why})")
+    return 1
+
+
+def main() -> int:
+    os.environ["SL3D_FAULTS"] = FAULT_SPEC
+    os.environ["SL3D_FAULTS_SEED"] = "0"
+    from structured_light_for_3d_model_replication_tpu.cli import (
+        main as cli_main,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="slchaos_")
+    try:
+        root = os.path.join(tmp, "dataset")
+        out = os.path.join(tmp, "out")
+        rc = cli_main(["synth", root, "--views", "5",
+                       "--cam", "160x120", "--proj", "128x64"])
+        if rc != 0:
+            return fail(f"synth rc={rc}")
+        rc = cli_main([
+            "pipeline", root, "--out", out,
+            "--calib", os.path.join(root, "calib.mat"),
+            "--steps", "statistical",
+            "--set", "parallel.backend=numpy",
+            "--set", "decode.n_cols=128", "--set", "decode.n_rows=64",
+            "--set", "decode.thresh_mode=manual",
+            "--set", "merge.voxel_size=4.0",
+            "--set", "merge.ransac_trials=512",
+            "--set", "merge.icp_iters=10",
+            "--set", "mesh.depth=5",
+            "--set", "mesh.density_trim_quantile=0",
+        ])
+        if rc != 0:
+            return fail(f"pipeline rc={rc} (must exit 0 when degraded)")
+        stl = os.path.join(out, "model.stl")
+        if not os.path.exists(stl) or os.path.getsize(stl) == 0:
+            return fail("merged STL missing after degraded run")
+        manifest_path = os.path.join(out, "failures.json")
+        if not os.path.exists(manifest_path):
+            return fail("failure manifest missing")
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        if len(manifest["failures"]) != 1:
+            return fail(f"expected exactly 1 quarantined view, got "
+                        f"{len(manifest['failures'])}")
+        rec = manifest["failures"][0]
+        if "216deg" not in rec["view"]:
+            return fail(f"wrong view quarantined: {rec['view']}")
+        if rec["transient"]:
+            return fail("permanent fault misclassified as transient")
+        if manifest["retries"] < 1:
+            return fail("transient frame.load fault was not retried")
+        if manifest["injected_faults"].get("frame.load") != 1:
+            return fail(f"unexpected injection counts: "
+                        f"{manifest['injected_faults']}")
+        qrec = os.path.join(out, "quarantine", f"{rec['view']}.json")
+        if not os.path.exists(qrec):
+            return fail(f"quarantine record missing: {qrec}")
+        print(f"CHAOS_SMOKE=ok (1 view quarantined, "
+              f"{manifest['retries']} retry(ies), STL "
+              f"{os.path.getsize(stl)} bytes from 4/5 views)")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

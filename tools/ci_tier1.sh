@@ -26,4 +26,16 @@ else
   echo "PIPELINE_SMOKE=FAIL (rc=$smoke_rc; see tools/_ci/pipeline_smoke.json)"
   [ $rc -eq 0 ] && rc=1
 fi
+
+# ---- chaos smoke: seeded fault plan (1 transient + 1 permanent over 5
+# views) must retry, quarantine, and still ship the STL with exit 0 ----
+chaos_rc=0
+chaos=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py 2>&1) || chaos_rc=$?
+echo "$chaos" > tools/_ci/chaos_smoke.log
+if [ $chaos_rc -eq 0 ] && echo "$chaos" | grep -q 'CHAOS_SMOKE=ok'; then
+  echo "$chaos" | grep 'CHAOS_SMOKE=ok'
+else
+  echo "CHAOS_SMOKE=FAIL (rc=$chaos_rc; see tools/_ci/chaos_smoke.log)"
+  [ $rc -eq 0 ] && rc=1
+fi
 exit $rc
