@@ -399,6 +399,135 @@ def bench_pipeline_e2e(views: int = PIPE_VIEWS) -> dict:
     return out
 
 
+BATCHED_VIEWS = 8
+BATCHED_COMPUTE = 4
+
+
+def bench_reconstruct_batched(views: int = BATCHED_VIEWS,
+                              compute_batch: int = BATCHED_COMPUTE,
+                              reps: int = 2) -> dict:
+    """Per-view device dispatch vs the view-batched executor (the ISSUE-4
+    compute lane), byte-comparing the PLYs. REQUIRES jax (the jax backend is
+    the whole point — the per-view loop's one-launch-per-view schedule vs
+    bucket-padded ``forward_views`` launches); callers that must not claim
+    an accelerator run it via ``--batched-only`` in a JAX_PLATFORMS=cpu
+    subprocess (``_run_batched_child``). Records the launch accounting
+    (launches / views per launch / bucket compile proxy / transfer wall)
+    plus ``host_cpus`` and ``device_count`` so the regime is legible: on one
+    CPU device the win is launch amortization only; with >1 device the view
+    axis shards across chips (parallel.shard_views)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from structured_light_for_3d_model_replication_tpu.config import Config
+    from structured_light_for_3d_model_replication_tpu.io import images as imio
+    from structured_light_for_3d_model_replication_tpu.io import matfile
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        synthetic as syn,
+    )
+
+    out: dict = {"views": views, "compute_batch": compute_batch,
+                 "backend": f"jax-{jax.default_backend()}",
+                 "host_cpus": os.cpu_count(),
+                 "device_count": jax.device_count()}
+    tmp = tempfile.mkdtemp(prefix="slbench_batched_")
+    try:
+        rig = syn.default_rig(cam_size=PIPE_CAM, proj_size=PIPE_PROJ)
+        scene = syn.sphere_on_background()
+        obj, background = scene.objects
+        calib_path = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(calib_path, rig.calibration())
+        root = os.path.join(tmp, "scans")
+        os.makedirs(root)
+        step = 360.0 / views
+        pivot = np.array([0.0, 0.0, 420.0])
+        for i, (R, t) in enumerate(syn.turntable_poses(views, step, pivot)):
+            frames, _ = syn.render_scene(
+                rig, syn.Scene([obj.transformed(R, t), background]))
+            imio.save_stack(
+                os.path.join(root, f"scan_{int(round(i * step)):03d}deg_scan"),
+                frames)
+
+        def run(batch: int, outdir: str):
+            cfg = Config()
+            cfg.parallel.backend = "jax"
+            cfg.parallel.io_workers = 4
+            cfg.parallel.compute_batch = batch
+            cfg.decode.n_cols, cfg.decode.n_rows = PIPE_PROJ
+            cfg.decode.thresh_mode = "manual"
+            t0 = time.perf_counter()
+            rep = stages.reconstruct(calib_path, root, mode="batch",
+                                     output=outdir, cfg=cfg,
+                                     log=lambda m: None)
+            wall = time.perf_counter() - t0
+            assert not rep.failed, f"batched bench item failed: {rep.failed}"
+            return wall, rep
+
+        pv_dir = os.path.join(tmp, "perview")
+        bt_dir = os.path.join(tmp, "batched")
+        pv_best = bt_best = np.inf
+        rep_bt = None
+        for _ in range(max(1, reps)):
+            s, _rep = run(1, pv_dir)           # compute_batch<=1: per-view arm
+            pv_best = min(pv_best, s)
+            b, rep_bt = run(compute_batch, bt_dir)
+            bt_best = min(bt_best, b)
+
+        identical = True
+        for f in sorted(os.listdir(pv_dir)):
+            with open(os.path.join(pv_dir, f), "rb") as fa, \
+                    open(os.path.join(bt_dir, f), "rb") as fb:
+                if fa.read() != fb.read():
+                    identical = False
+                    break
+        out["per_view_s"] = round(pv_best, 4)
+        out["batched_s"] = round(bt_best, 4)
+        out["speedup"] = round(pv_best / bt_best, 3)
+        out["outputs_identical"] = identical
+        o = rep_bt.overlap or {}
+        for k in ("launches", "views_dispatched", "mean_views_per_launch",
+                  "min_views_per_launch", "max_views_per_launch",
+                  "bucket_first_dispatch_s", "transfer_s", "compute_s",
+                  "compute_per_item_s", "transfer_per_item_s",
+                  "shard_devices", "critical_path_s"):
+            if k in o:
+                out[k] = o[k]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _run_batched_child(views: int = BATCHED_VIEWS,
+                       compute_batch: int = BATCHED_COMPUTE,
+                       timeout: int = 900) -> dict:
+    """Run ``bench_reconstruct_batched`` in a JAX_PLATFORMS=cpu subprocess:
+    the parent process (bench main / --pipeline-only) must never initialize
+    a jax backend itself — on an accelerator box that would open a second
+    device claim against the measured child (the concurrent-client wedge).
+    The A/B measures launch-schedule overlap, which the CPU backend
+    exhibits the same way; on-chip regimes come from the operator running
+    ``--batched-only`` directly on the accelerator."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--batched-only",
+             f"--views={views}", f"--compute-batch={compute_batch}"],
+            capture_output=True, text=True, timeout=timeout, env=env)
+        for line in reversed(p.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        return {"error": f"no JSON line (rc={p.returncode}, "
+                         f"stderr: {p.stderr.strip()[-200:]})"}
+    except subprocess.TimeoutExpired:
+        return {"error": f"batched child timed out after {timeout}s"}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def bench_pipeline_faults(views: int = PIPE_VIEWS) -> dict:
     """Resilience-layer cost on the fused pipeline (ISSUE 3 acceptance).
 
@@ -519,7 +648,10 @@ def child_main(out_path: str, views: int, force_cpu: bool) -> None:
         res["backend_error"] = str(e)[:200]
         views = min(views, CPU_FALLBACK_VIEWS)  # CPU can't afford 24 full views
     res["backend"] = dev.platform
-    log(f"child: backend={dev.platform} device={dev}")
+    res["host_cpus"] = os.cpu_count()
+    res["device_count"] = jax.device_count()
+    log(f"child: backend={dev.platform} device={dev} "
+        f"({res['device_count']} device(s), {res['host_cpus']} host cpus)")
     # persistent executable cache: a re-run (or the driver's run after a local
     # warmup) skips XLA compilation, so the compile-vs-steady split below
     # reflects what a warmed deployment sees
@@ -922,6 +1054,23 @@ def _wait_for_accelerator(preflight, window: float, gap: float):
 
 
 def emit(final: dict) -> None:
+    # every emitted line carries the execution regime (ISSUE-4 satellite):
+    # host_cpus always; device_count only when this process ALREADY holds an
+    # initialized jax backend — the numpy-backend parent must never claim an
+    # accelerator just to count it (its children record their own counts),
+    # so a null here reads "no backend in this process", not "one device"
+    final.setdefault("host_cpus", os.cpu_count())
+    if "device_count" not in final:
+        final["device_count"] = None
+        try:
+            from jax._src import xla_bridge as _xb
+
+            if _xb._backends:
+                import jax
+
+                final["device_count"] = jax.device_count()
+        except Exception:
+            pass
     print(json.dumps(final), flush=True)
 
 
@@ -983,6 +1132,21 @@ def main() -> None:
             final["reconstruct_pipeline"] = {
                 "error": f"{type(e).__name__}: {e}"[:200]}
             log(f"pipeline A/B FAILED ({final['reconstruct_pipeline']['error']})")
+
+        # view-batched reconstruct A/B (cpu-pinned subprocess: jax without
+        # touching the parent's accelerator claim)
+        log("reconstruct batched A/B (per-view vs view-batched, jax cpu "
+            "subprocess)...")
+        final["reconstruct_batched"] = rb = _run_batched_child()
+        final["host_cpus"] = os.cpu_count()
+        if "error" in rb:
+            log(f"batched A/B FAILED ({rb['error']})")
+        else:
+            log(f"reconstruct_batched: per-view {rb['per_view_s']}s vs "
+                f"batched {rb['batched_s']}s (x{rb['speedup']}, identical="
+                f"{rb['outputs_identical']}, {rb['views_dispatched']} views "
+                f"in {rb['launches']} launches, "
+                f"{rb['device_count']} device(s))")
 
         # fused scan-to-print vs the discrete command chain (host-only)
         try:
@@ -1150,11 +1314,15 @@ if __name__ == "__main__":
         # injected cold-IO latency (the latency-hiding the executor is for)
         line = {"metric": "batch_reconstruct_pipeline_wall", "unit": "s",
                 "value": None, "error": None}
+        line["host_cpus"] = os.cpu_count()
         try:
             line.update(bench_reconstruct_pipeline())
             line["value"] = line.get("pipelined_s")
             line["cold_io"] = bench_reconstruct_pipeline(
                 inject_io_latency_s=PIPE_COLD_IO_S)
+            # view-batched A/B runs jax in a cpu-pinned subprocess so this
+            # entry stays accelerator-lock-free end to end
+            line["reconstruct_batched"] = _run_batched_child()
             line["pipeline_e2e"] = bench_pipeline_e2e()
             line["pipeline_faults"] = bench_pipeline_faults()
             fused = line["pipeline_e2e"].get("fused_s")
@@ -1164,6 +1332,28 @@ if __name__ == "__main__":
                 # can eyeball against run-to-run noise
                 line["pipeline_faults"]["overhead_vs_e2e"] = round(
                     disabled / fused, 3)
+        except Exception as e:
+            line["error"] = f"{type(e).__name__}: {e}"[:200]
+        emit(line)
+        sys.exit(0)
+    if "--batched-only" in sys.argv[1:]:
+        # standalone record of the view-batched reconstruct A/B: one JSON
+        # line on stdout. This arm REQUIRES jax; unless the caller already
+        # chose a platform it pins itself to CPU so a bare invocation can
+        # never claim an accelerator by accident (run with
+        # JAX_PLATFORMS=tpu explicitly for an on-chip line).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        views, compute_batch = BATCHED_VIEWS, BATCHED_COMPUTE
+        for a in sys.argv[1:]:
+            if a.startswith("--views="):
+                views = int(a.split("=")[1])
+            elif a.startswith("--compute-batch="):
+                compute_batch = int(a.split("=")[1])
+        line = {"metric": "batch_reconstruct_batched_wall", "unit": "s",
+                "value": None, "error": None}
+        try:
+            line.update(bench_reconstruct_batched(views, compute_batch))
+            line["value"] = line.get("batched_s")
         except Exception as e:
             line["error"] = f"{type(e).__name__}: {e}"[:200]
         emit(line)

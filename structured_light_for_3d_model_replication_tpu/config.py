@@ -201,6 +201,19 @@ class ParallelConfig:
     # override: SL3D_PREFETCH_DEPTH.
     prefetch_depth: int = field(
         default_factory=lambda: int(os.environ.get("SL3D_PREFETCH_DEPTH", "2")))
+    # views per device launch for batch reconstruct: the pipelined executor
+    # accumulates prefetched stacks into bucket-padded batches of this many
+    # views and dispatches each batch as ONE jitted forward_views program
+    # (ragged tails land on a power-of-two bucket ladder, so at most
+    # log2(compute_batch)+1 programs compile per shape/config). <=1 keeps
+    # the per-view dispatch loop — also the numpy-backend / bitexact
+    # behavior, which never batch. Env override: SL3D_COMPUTE_BATCH.
+    compute_batch: int = field(
+        default_factory=lambda: int(os.environ.get("SL3D_COMPUTE_BATCH", "8")))
+    # shard each view batch's leading axis across every attached device
+    # (shard_map, the register_pairs_sharded mechanism) whenever >1 device
+    # is present; single-device hosts and the numpy backend are unaffected
+    shard_views: bool = True
 
 
 @dataclass

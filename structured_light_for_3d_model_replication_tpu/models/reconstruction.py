@@ -122,6 +122,11 @@ def compact_views_device(points, valid, colors) -> DeviceClouds:
     pts = jnp.asarray(points)
     v = jnp.asarray(valid)
     c = jnp.asarray(colors)
+    if c.shape[-1] == 1:
+        # scanner paths ship one gray channel; the DeviceClouds contract is
+        # RGB. Replicating BEFORE compaction keeps the gathers shared, and
+        # on device the repeat costs bandwidth only over the bucket prefix
+        c = jnp.repeat(c, 3, axis=-1)
     if pts.shape[1] <= (1 << _COMPACT_IOTA_BITS):
         order, cnts_dev = _compact_order_counts_jit(v)
         cnts = np.asarray(cnts_dev).astype(int)           # one small sync

@@ -11,7 +11,9 @@ folder-by-folder (processing.py:314-334) becomes one device launch.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -146,8 +148,9 @@ class SLScanner:
             self.poly_col, self.poly_row, self.epipolar_tol,
             n_cols=n_cols, n_rows=n_rows, n_use_col=n_sets_col,
             n_use_row=n_sets_row, row_mode=row_mode, downsample=downsample)
-        colors = jnp.repeat(tex[..., None], 3, axis=-1)
-        return CloudResult(pts, colors, valid)
+        # single gray channel over the wire; RGB replication happens host-
+        # side at the export boundary (compact_cloud / compact_views_device)
+        return CloudResult(pts, tex[..., None], valid)
 
     def forward(self, frames, thresh_mode: str = "otsu",
                 shadow_val: float = 40.0, contrast_val: float = 10.0) -> CloudResult:
@@ -210,6 +213,51 @@ class SLScanner:
                                    jnp.float32(self.epipolar_tol),
                                    cfg=self._static)
 
+    def forward_views_batched(self, frames_v, thresh_mode: str = "otsu",
+                              shadow_val: float = 40.0,
+                              contrast_val: float = 10.0,
+                              mesh=None) -> CloudResult:
+        """The batch executor's compute lane: uint8 [V, F, H, W] -> one
+        device launch with the frame buffer DONATED (the executor never
+        reuses a dispatched bucket, so XLA may recycle its HBM in place).
+
+        ``mesh``: a jax.sharding.Mesh shards the leading view axis across
+        every mesh device (``shard_map`` with replication checking off, the
+        ``register_pairs_sharded`` mechanism — views are independent, zero
+        collectives on the hot path); V must be a multiple of the mesh's
+        device count (the executor's bucket padding guarantees it). None
+        runs the single-device program (auto-dispatching the fused Mosaic
+        kernel exactly like ``forward_views``).
+
+        Numerically identical to per-view ``forward``: the batched program
+        lax.map's the same ``_forward_math`` body, and the sharded program
+        runs that same lax.map per device shard.
+        """
+        frames_v = jnp.asarray(frames_v)
+        ss, cs = graycode.resolve_thresholds_views(frames_v, thresh_mode,
+                                                   shadow_val, contrast_val)
+        args = (jnp.asarray(ss, jnp.float32), jnp.asarray(cs, jnp.float32),
+                self.rays, self.oc, self.plane_col, self.plane_row,
+                self.poly_col, self.poly_row,
+                jnp.float32(self.epipolar_tol))
+        if mesh is not None:
+            n_dev = int(mesh.devices.size)
+            if frames_v.shape[0] % n_dev:
+                raise ValueError(
+                    f"sharded view batch: {frames_v.shape[0]} views not a "
+                    f"multiple of the {n_dev}-device mesh (the executor's "
+                    f"bucket padding must round to the device count)")
+            with _quiet_donation():
+                pts, cols, valid = _sharded_views_fn(mesh, self._static)(
+                    frames_v, *args)
+            return CloudResult(pts, cols, valid)
+        if self._can_fuse(frames_v):
+            return self._fused_views(frames_v, np.asarray(ss, np.float32),
+                                     np.asarray(cs, np.float32))
+        with _quiet_donation():
+            return _scan_forward_views_donated(frames_v, *args,
+                                               cfg=self._static)
+
 
 def _forward_math(frames, shadow, contrast, rays, oc, plane_col, plane_row,
                   poly_col, poly_row, epipolar_tol, cfg):
@@ -219,7 +267,10 @@ def _forward_math(frames, shadow, contrast, rays, oc, plane_col, plane_row,
     )
 
     n_cols, n_rows, n_sets_col, n_sets_row, downsample, row_mode, use_poly = cfg
-    texture = jnp.repeat(frames[0][..., None], 3, axis=-1).astype(jnp.uint8)
+    # one gray channel, not an on-device x3 repeat: the texture IS frame 0,
+    # so the device program ships [H*W, 1] u8 and the host replicates to RGB
+    # at compaction — a third of the color transfer for identical bytes
+    texture = frames[0][..., None].astype(jnp.uint8)
     dec = _decode_impl(frames, texture, shadow, contrast,
                        n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col,
                        n_sets_row=n_sets_row, downsample=downsample, xp=jnp)
@@ -238,9 +289,20 @@ def _scan_forward(frames, shadow, contrast, rays, oc, plane_col, plane_row,
                          plane_row, poly_col, poly_row, epipolar_tol, cfg)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def _scan_forward_views(frames_v, shadow_v, contrast_v, rays, oc, plane_col,
-                        plane_row, poly_col, poly_row, epipolar_tol, *, cfg):
+@contextlib.contextmanager
+def _quiet_donation():
+    """Donating the uint8 frame bucket is a free HBM-recycling hint where
+    XLA can use it (TPU) and a per-compile UserWarning where it cannot
+    (CPU: u8 inputs alias no f32/bool output). The hint is intentional
+    either way — silence just that warning, just around the dispatch."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+def _views_math(frames_v, shadow_v, contrast_v, rays, oc, plane_col,
+                plane_row, poly_col, poly_row, epipolar_tol, cfg):
     # lax.map (= scan), NOT vmap: one compiled single-view body executed V
     # times back-to-back. Each body is ~2 Mpix of data parallelism (plenty to
     # fill the chip), while live intermediates stay one view's worth — the
@@ -251,3 +313,55 @@ def _scan_forward_views(frames_v, shadow_v, contrast_v, rays, oc, plane_col,
                                    plane_col, plane_row, poly_col, poly_row,
                                    epipolar_tol, cfg),
         (frames_v, shadow_v, contrast_v))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _scan_forward_views(frames_v, shadow_v, contrast_v, rays, oc, plane_col,
+                        plane_row, poly_col, poly_row, epipolar_tol, *, cfg):
+    return _views_math(frames_v, shadow_v, contrast_v, rays, oc, plane_col,
+                       plane_row, poly_col, poly_row, epipolar_tol, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("frames_v",))
+def _scan_forward_views_donated(frames_v, shadow_v, contrast_v, rays, oc,
+                                plane_col, plane_row, poly_col, poly_row,
+                                epipolar_tol, *, cfg):
+    # the batch executor's single-device lane: same program as
+    # _scan_forward_views, but the bucket's frame buffer is donated — the
+    # executor assembles a fresh stack per bucket, so XLA reuses its HBM
+    # instead of holding frames + outputs live simultaneously
+    return _views_math(frames_v, shadow_v, contrast_v, rays, oc, plane_col,
+                       plane_row, poly_col, poly_row, epipolar_tol, cfg)
+
+
+@functools.cache
+def _sharded_views_fn(mesh, cfg):
+    """Jitted view-axis-sharded forward program for (mesh, static config),
+    built once per pair (the jit object then caches one executable per
+    bucket shape). The view axis spreads data-major over EVERY mesh axis,
+    calibration tensors are replicated (KB-scale), and replication/VMA
+    checking is off for the same reason register_pairs_sharded disables it:
+    nothing here is replicated across the sharded axis, and the checker has
+    no rule for the decode's control flow on older jax."""
+    from jax.sharding import PartitionSpec
+
+    from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (
+        shard_map_unchecked,
+    )
+
+    axes = tuple(mesh.axis_names)
+    vspec = PartitionSpec(axes)
+    rep = PartitionSpec()
+
+    def local(frames_v, shadow_v, contrast_v, rays, oc, plane_col, plane_row,
+              poly_col, poly_row, epipolar_tol):
+        return tuple(_views_math(frames_v, shadow_v, contrast_v, rays, oc,
+                                 plane_col, plane_row, poly_col, poly_row,
+                                 epipolar_tol, cfg))
+
+    return jax.jit(shard_map_unchecked(
+        mesh=mesh,
+        in_specs=(vspec, vspec, vspec) + (rep,) * 7,
+        out_specs=(vspec, vspec, vspec),
+    )(local), donate_argnums=(0,))

@@ -52,10 +52,16 @@ __all__ = ["CloudResult", "pixel_rays", "triangulate", "triangulate_np", "compac
 
 
 class CloudResult(NamedTuple):
-    """Fixed-shape point cloud: one slot per camera pixel (x2 for row_mode=2)."""
+    """Fixed-shape point cloud: one slot per camera pixel (x2 for row_mode=2).
+
+    ``colors`` is uint8 ``[N, 3]`` RGB on the host/NumPy paths; the device
+    scanner paths carry ``[N, 1]`` — the gray texture IS frame 0, so the
+    channel replication happens host-side at the export boundary
+    (``compact_cloud`` / ``compact_views_device``) instead of tripling every
+    device->host color transfer."""
 
     points: jax.Array | np.ndarray  # float32 [N, 3] camera-frame mm
-    colors: jax.Array | np.ndarray  # uint8   [N, 3] RGB
+    colors: jax.Array | np.ndarray  # uint8   [N, 3] RGB (or [N, 1] gray)
     valid: jax.Array | np.ndarray   # bool    [N]
 
 
@@ -115,7 +121,9 @@ def _triangulate_impl(
     n = h * w
     cols = xp.clip(col_map.reshape(n), 0, plane_col.shape[0] - 1)
     valid = mask.reshape(n)
-    tex = texture.reshape(n, 3)
+    # texture is [H, W, 3] RGB on the host paths, [H, W, 1] gray on the
+    # device scanner paths (replicated to RGB host-side at compaction)
+    tex = texture.reshape(n, -1)
 
     if poly is None:
         pc = plane_col[cols]  # [N, 4] gather of column-plane equations
@@ -283,8 +291,13 @@ def triangulate(
 
 def compact_cloud(cloud: CloudResult) -> tuple[np.ndarray, np.ndarray]:
     """Host-side compaction: drop invalid slots. The only data-dependent-shape
-    step, deliberately outside jit (export boundary)."""
+    step, deliberately outside jit (export boundary). Single-channel colors
+    (the device paths ship the gray frame-0 texture, one byte per slot) are
+    replicated to RGB here, AFTER masking — the cheap end of the wire."""
     pts = np.asarray(cloud.points)
     col = np.asarray(cloud.colors)
     ok = np.asarray(cloud.valid)
-    return pts[ok], col[ok]
+    pts, col = pts[ok], col[ok]
+    if col.ndim == 2 and col.shape[-1] == 1:
+        col = np.repeat(col, 3, axis=1)
+    return pts, col

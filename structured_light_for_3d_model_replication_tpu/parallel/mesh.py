@@ -20,7 +20,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["AXIS_DATA", "AXIS_MODEL", "make_mesh", "merge_mesh",
-           "view_sharding", "P"]
+           "views_mesh", "view_sharding", "batch_sharding", "P"]
 
 AXIS_DATA = "data"
 AXIS_MODEL = "model"
@@ -69,3 +69,25 @@ def merge_mesh(parallel_cfg=None) -> Mesh | None:
 def view_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a [V, F, H, W] view-batch: views over data, rows over model."""
     return NamedSharding(mesh, P(AXIS_DATA, None, AXIS_MODEL, None))
+
+
+def views_mesh(parallel_cfg=None) -> Mesh | None:
+    """The mesh for view-batched reconstruct, resolved in ONE place (the
+    merge_mesh pattern) so the batch executor, warmup's cache priming, and
+    bench compile the same sharded program: a full-device make_mesh() when
+    ``parallel.shard_views`` is on and >1 device is attached, else None —
+    single-device hosts and the numpy backend run the unsharded lane."""
+    if parallel_cfg is not None and not getattr(parallel_cfg, "shard_views",
+                                                True):
+        return None
+    if len(jax.devices()) < 2:
+        return None
+    return make_mesh()
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for the batch executor's [V, F, H, W] bucket: the view axis
+    data-major over EVERY mesh axis (matching _sharded_views_fn's in_specs),
+    so the host->device transfer lands each shard on its device directly
+    instead of uploading to one chip and resharding."""
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))

@@ -44,7 +44,9 @@ def build_sharded_scan_step(mesh, *, proj_size, n_sets_col: int = 11,
 
     def _local(frames_v, rays_hw, oc, plane_col, plane_row, shadow_v, contrast_v):
         def one_view(frames, shadow, contrast):
-            texture = jnp.repeat(frames[0][..., None], 3, axis=-1).astype(jnp.uint8)
+            # gray frame-0 texture, replicated to RGB host-side at the
+            # export boundary (same contract as SLScanner._forward_math)
+            texture = frames[0][..., None].astype(jnp.uint8)
             dec = _decode_impl(frames, texture, shadow, contrast,
                                n_cols=pw, n_rows=ph, n_sets_col=n_sets_col,
                                n_sets_row=n_sets_row, downsample=downsample, xp=jnp)

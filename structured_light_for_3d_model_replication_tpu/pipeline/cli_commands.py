@@ -105,6 +105,12 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("--prefetch-depth", type=int, default=None,
                    help="frame stacks the prefetcher may hold ahead of "
                         "compute (default: parallel.prefetch_depth)")
+    p.add_argument("--compute-batch", type=int, default=None,
+                   help="views per device launch for the view-batched "
+                        "executor (bucket-padded forward_views programs, "
+                        "sharded across devices when >1 is attached; <=1 "
+                        "forces the per-view dispatch loop; default: "
+                        "parallel.compute_batch)")
     add_config_args(p)
 
     p = sub.add_parser("clean",
@@ -149,6 +155,9 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("--io-workers", type=int, default=None,
                    help="host I/O threads for the pipelined executor")
     p.add_argument("--prefetch-depth", type=int, default=None)
+    p.add_argument("--compute-batch", type=int, default=None,
+                   help="views per device launch for the reconstruct stage "
+                        "(default: parallel.compute_batch)")
     add_config_args(p)
 
     p = sub.add_parser("merge-360",
@@ -244,6 +253,12 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("--proj", default="1920x1080", help="projector WxH to warm")
     p.add_argument("--views", type=int, default=24,
                    help="batched view count for the forward_views program")
+    p.add_argument("--compute-batch", type=int, default=None,
+                   help="also pre-compile the batch executor's bucket-"
+                        "ladder programs (full bucket + power-of-two tail "
+                        "buckets, donated buffers, sharded when >1 device "
+                        "is attached) for this compute_batch (default: "
+                        "parallel.compute_batch; 0 skips)")
     p.add_argument("--merge-views", type=int, default=24,
                    help="turntable views for the merge-chain programs "
                         "(0 skips the merge warm)")
@@ -326,6 +341,8 @@ def _cmd_reconstruct(args) -> int:
         cfg.parallel.io_workers = args.io_workers
     if args.prefetch_depth is not None:
         cfg.parallel.prefetch_depth = args.prefetch_depth
+    if args.compute_batch is not None:
+        cfg.parallel.compute_batch = args.compute_batch
     report = stages.reconstruct(args.calib, args.target, mode=args.mode,
                                 output=args.output, cfg=cfg)
     if report.overlap:
@@ -333,6 +350,12 @@ def _cmd_reconstruct(args) -> int:
         print(f"[reconstruct] pipeline overlap: load {o['load_s']}s + "
               f"compute {o['compute_s']}s + write {o['write_s']}s in "
               f"{o['critical_path_s']}s wall (x{o['overlap_ratio']})")
+        if o.get("launches"):
+            print(f"[reconstruct] batched compute: {o['views_dispatched']} "
+                  f"views in {o['launches']} launches (mean "
+                  f"{o['mean_views_per_launch']}/launch, "
+                  f"{o['shard_devices']} device(s), buckets "
+                  f"{list(o['bucket_first_dispatch_s'])})")
     return 0 if report.outputs and not report.failed else (2 if report.outputs else 1)
 
 
@@ -370,6 +393,8 @@ def _cmd_pipeline(args) -> int:
         cfg.parallel.io_workers = args.io_workers
     if args.prefetch_depth is not None:
         cfg.parallel.prefetch_depth = args.prefetch_depth
+    if args.compute_batch is not None:
+        cfg.parallel.compute_batch = args.compute_batch
     if args.no_cache:
         cfg.pipeline.cache = False
     if args.view_plys:
@@ -707,6 +732,35 @@ def _cmd_warmup(args) -> int:
             sc.forward_views(stack, thresh_mode="manual").points)
         print(f"[warmup] forward_views[{args.views}]: "
               f"{time.perf_counter() - t0:.1f}s")
+
+    # batch-executor bucket ladder: the batched lane runs DIFFERENT
+    # programs from forward_views (donated frame buffers; shard_map when
+    # >1 device) — warming only the plain program would leave the first
+    # real batch paying its compile inside the measured hot path
+    cb = (args.compute_batch if args.compute_batch is not None
+          else cfg.parallel.compute_batch)
+    if cb > 1:
+        from structured_light_for_3d_model_replication_tpu.parallel import (
+            mesh as meshlib,
+        )
+        from structured_light_for_3d_model_replication_tpu.pipeline.stages import (
+            _view_bucket,
+        )
+
+        mesh = meshlib.views_mesh(cfg.parallel)
+        n_dev = int(mesh.devices.size) if mesh is not None else 1
+        buckets = sorted({_view_bucket(v, cb, n_dev)
+                          for v in range(1, cb + 1)})
+        frames_np = np.asarray(frames)
+        for b in buckets:
+            bucket_stack = np.stack([np.roll(frames_np, 7 * i, axis=2)
+                                     for i in range(b)])
+            t0 = time.perf_counter()
+            jax.block_until_ready(sc.forward_views_batched(
+                bucket_stack, thresh_mode="manual", mesh=mesh).points)
+            print(f"[warmup] forward_views_batched[bucket={b}"
+                  f"{f', {n_dev} devices' if mesh is not None else ''}]: "
+                  f"{time.perf_counter() - t0:.1f}s")
 
     if args.merge_views > 0:
         from structured_light_for_3d_model_replication_tpu.models.reconstruction import (

@@ -109,6 +109,29 @@ class StageCache:
         h.update(config_json.encode())
         return h.hexdigest()
 
+    def keys_parallel(self, stage: str, file_lists: list[list[str]],
+                      config_json: str = "", io_workers: int = 1) -> list[str]:
+        """Per-item ``key(stage, files=...)`` for a whole batch, hashed on a
+        thread pool (``key`` is pure, so order-preserving ``pool.map`` is
+        safe). Keying a 24-view 1080p run reads ~2 GB of frame bytes; doing
+        it serially stalls the batched executor's first launch behind the
+        hash wall. NOTE: executor/batching knobs (``parallel.compute_batch``,
+        ``shard_views``, ``io_workers``) must NEVER enter ``config_json`` —
+        every execution schedule produces identical bytes, so cached views
+        must hit across schedule changes."""
+        if io_workers > 1 and len(file_lists) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(io_workers, len(file_lists)),
+                    thread_name_prefix="sl3d-cachekey") as pool:
+                return list(pool.map(
+                    lambda fl: self.key(stage, files=fl,
+                                        config_json=config_json),
+                    file_lists))
+        return [self.key(stage, files=fl, config_json=config_json)
+                for fl in file_lists]
+
     @staticmethod
     def digest_arrays(**arrays) -> str:
         """Content digest of a stage OUTPUT — what downstream keys chain on."""

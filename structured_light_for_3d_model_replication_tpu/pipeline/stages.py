@@ -60,6 +60,12 @@ class BatchReport:
     retries: int = 0
     elapsed_s: float = 0.0
     overlap: dict | None = None
+    # execution-regime stamps so batched-vs-serial lines are legible when
+    # reports land in bench records / logs: host CPU count always; attached
+    # device count only on the jax lanes (never probed on the numpy
+    # backend, which must not initialize a jax backend)
+    host_cpus: int | None = None
+    device_count: int | None = None
 
     @property
     def summary(self) -> str:
@@ -473,6 +479,337 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
                             stats.summary())
 
 
+def _view_bucket(count: int, batch: int, n_dev: int = 1) -> int:
+    """Bucket size for one view batch: full batches run at ``batch`` slots;
+    a ragged tail lands on the next power of two >= its count (capped at
+    ``batch``), so at most log2(batch)+1 programs compile per (shape,
+    config) — the clean_chain bucket idiom on the view axis. When sharding,
+    the bucket rounds up to a multiple of the device count so the leading
+    axis splits evenly."""
+    if count >= batch:
+        b = batch
+    else:
+        b = 1
+        while b < count:
+            b *= 2
+        b = min(b, batch)
+    if n_dev > 1:
+        b = -(-b // n_dev) * n_dev
+    return b
+
+
+def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
+                         log, clean_steps=None, collect=None,
+                         write_plys=True) -> None:
+    """View-batched executor: the default compute lane when a device scanner
+    is available and ``parallel.compute_batch > 1``. Same overlapped stages
+    as ``_reconstruct_pipelined``, but the compute stage dispatches
+    ``compute_batch`` views as ONE jitted ``forward_views`` program:
+
+      load      — frame stacks prefetched on the ``io_workers`` pool
+                  (window: compute_batch + prefetch_depth stacks), then
+                  accumulated into bucket-padded batches (``_view_bucket``
+                  ladder — a ragged tail pads to a smaller bucket, padding
+                  repeats the last view and is sliced off on device)
+      transfer  — each assembled bucket is ``device_put`` as one [V,F,H,W]
+                  upload (sharded placement when a mesh is active) while
+                  the PREVIOUS batch is still computing/draining — the
+                  double buffer: at most 2 dispatched-but-undrained batches
+                  exist, so transfer k+1 overlaps compute k
+      compute   — ``SLScanner.forward_views_batched``: one donated device
+                  launch per bucket; with >1 device and
+                  ``parallel.shard_views`` the view axis shards across the
+                  full mesh (shard_map, the register_pairs_sharded
+                  mechanism)
+      clean/write — per VIEW, unchanged: the drain worker syncs the batch,
+                  splits it back into per-view compact clouds, and runs the
+                  same clean/writeback/collect hooks as the per-view lanes
+
+    Per-view semantics are preserved end to end. Fault containment: the
+    ``compute.view`` injection site fires per view at batch-assembly time,
+    and ANY batch-level failure (injected or real — a poisoned input, a
+    compile error) re-runs that batch's views individually through the
+    per-view retry/quarantine lane, so one poisoned view can never
+    quarantine its batchmates. Outputs are byte-identical to the per-view
+    loop (the batched program lax.map's the same per-view math), which
+    remains the ``compute_batch <= 1`` arm and the numpy/bitexact fallback.
+    """
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+
+    from structured_light_for_3d_model_replication_tpu.parallel import (
+        mesh as meshlib,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (
+        is_backend_init_error,
+    )
+
+    stats = prof.OverlapStats()
+    policy = _retry_policy(cfg)
+    batch_n = max(1, cfg.parallel.compute_batch)
+    workers = max(1, cfg.parallel.io_workers)
+    depth = batch_n + max(1, cfg.parallel.prefetch_depth)
+    dcfg = cfg.decode
+    fwd_kw = dict(thresh_mode=dcfg.thresh_mode, shadow_val=dcfg.shadow_val,
+                  contrast_val=dcfg.contrast_val)
+
+    mesh = meshlib.views_mesh(cfg.parallel)
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    if mesh is not None:
+        log(f"[reconstruct] sharding view batches over {n_dev} devices "
+            f"(parallel.shard_views)")
+
+    def lane_retry(lane):
+        def on_retry(n, e):
+            stats.add_retry(lane)
+            log(f"[reconstruct] transient {type(e).__name__} in {lane} "
+                f"lane ({e}); retry {n}/{policy.max_retries}")
+        return on_retry
+
+    # idx -> ("fail", src, exc) | ("batch", drain_future, j-within-batch)
+    results: dict[int, tuple] = {}
+    load_pool = ThreadPoolExecutor(max_workers=workers,
+                                   thread_name_prefix="sl3d-prefetch")
+    drain_pool = ThreadPoolExecutor(max_workers=1,
+                                    thread_name_prefix="sl3d-drain")
+    wbq = ply.WritebackQueue(
+        on_write=lambda _path, dt: stats.add("write", dt),
+        retry=policy,
+        on_retry=lambda _path, n, e: lane_retry("write")(n, e))
+
+    def load_one(src):
+        t0 = time.perf_counter()
+        out = _retry_stage("load", lambda: _load_fired(src, cfg), policy,
+                           lane_retry("load"))
+        stats.add("load", time.perf_counter() - t0)
+        return out
+
+    def finish_view(idx, src, pts, cols):
+        """Clean + write/collect ONE compacted view (drain thread) — the
+        per-view tail every executor shares."""
+        if clean_steps is not None:
+            t0 = time.perf_counter()
+            pts, cols, _ = _clean_arrays(pts, cols, cfg, clean_steps)
+            stats.add("clean", time.perf_counter() - t0)
+        out_path = (_out_path_for(src, mode, output) if write_plys
+                    else _item_name(src))
+        wfut = wbq.submit(out_path, pts, cols) if write_plys else None
+        if collect is not None:
+            collect(idx, src, pts, cols)
+        return ("ok", out_path, len(pts), wfut)
+
+    def run_view_fallback(item):
+        """The per-view lane a poisoned batch degrades to: identical
+        retry/quarantine semantics to the serial/pipelined executors."""
+        idx, src, frames, texture = item
+        try:
+            t0 = time.perf_counter()
+            cloud = _retry_stage(
+                "compute",
+                lambda: _compute_fired(frames, texture, calib, cfg, scanner,
+                                       src),
+                policy, lane_retry("compute"))
+            pts, cols = tri.compact_cloud(cloud)
+            stats.add("compute", time.perf_counter() - t0, items=1)
+            return finish_view(idx, src, pts, cols)
+        except Exception as e:
+            if is_backend_init_error(e):
+                raise
+            return ("fail", src, e)
+
+    def drain_batch(items, cloud):
+        """Sync one batched launch (the device wait lives HERE, off the
+        dispatch thread) and fan back out into per-view artifacts; any
+        failure re-runs the batch's views individually."""
+        try:
+            t0 = time.perf_counter()
+            pts_v = np.asarray(cloud.points)      # one sync, whole batch
+            cols_v = np.asarray(cloud.colors)
+            val_v = np.asarray(cloud.valid)
+            stats.add("compute", time.perf_counter() - t0, items=len(items))
+            outs = []
+            for j, (idx, src, _frames, _texture) in enumerate(items):
+                # per-view compaction through the SAME export helper the
+                # per-view lanes use (incl. gray->RGB replication)
+                pts, cols = tri.compact_cloud(
+                    tri.CloudResult(pts_v[j], cols_v[j], val_v[j]))
+                outs.append(finish_view(idx, src, pts, cols))
+            return outs
+        except faults.InjectedCrash:
+            raise
+        except Exception as e:
+            if is_backend_init_error(e):
+                raise
+            log(f"[reconstruct] batched launch of {len(items)} view(s) "
+                f"failed ({type(e).__name__}: {e}); re-running views "
+                f"individually")
+            return [run_view_fallback(it) for it in items]
+
+    def dispatch_batch(items):
+        """Main thread: assemble, transfer, and launch one bucket; returns
+        the drain future. Never raises per-item errors — a poisoned batch
+        degrades to the per-view lane inside the drain worker."""
+        poisoned = None
+        for _idx, src, _f, _t in items:
+            # the per-view injection site fires at assembly time so chaos
+            # semantics survive batching; a hit poisons the WHOLE batch into
+            # the per-view lane, where retry/quarantine are exact
+            try:
+                faults.fire("compute.view", item=src)
+            except faults.InjectedCrash:
+                raise
+            except Exception as e:
+                poisoned = e
+                break
+        if poisoned is None:
+            try:
+                t0 = time.perf_counter()
+                fv = np.stack([f for _, _, f, _ in items])
+                v = len(items)
+                bucket = _view_bucket(v, batch_n, n_dev)
+                if bucket > v:
+                    fv = np.concatenate(
+                        [fv, np.repeat(fv[-1:], bucket - v, axis=0)])
+                if mesh is not None:
+                    fv_d = jax.device_put(fv, meshlib.batch_sharding(mesh))
+                else:
+                    fv_d = jax.device_put(fv)
+                stats.add("transfer", time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                cloud = scanner.forward_views_batched(fv_d, mesh=mesh,
+                                                      **fwd_kw)
+                stats.add_launch(v, bucket, time.perf_counter() - t0)
+                cloud = tri.CloudResult(cloud.points[:v], cloud.colors[:v],
+                                        cloud.valid[:v])
+                return drain_pool.submit(drain_batch, items, cloud)
+            except faults.InjectedCrash:
+                raise
+            except Exception as e:
+                if is_backend_init_error(e):
+                    raise
+                poisoned = e
+        if faults.is_transient(poisoned):
+            # the assembly-time firing consumed a transient's budget; the
+            # per-view re-run below is its successful retry
+            stats.add_retry("compute")
+        log(f"[reconstruct] batch of {len(items)} view(s) degraded to "
+            f"per-view compute ({type(poisoned).__name__}: {poisoned})")
+        return drain_pool.submit(
+            lambda its=list(items): [run_view_fallback(it) for it in its])
+
+    t_wall = time.perf_counter()
+    try:
+        with prof.trace():
+            inflight: deque = deque()
+            pending = list(enumerate(sources))
+            next_i = 0
+            while next_i < len(pending) and len(inflight) < depth:
+                idx, src = pending[next_i]
+                inflight.append((idx, src, load_pool.submit(load_one, src)))
+                next_i += 1
+
+            batch_items: list[tuple] = []
+            batch_futs: deque = deque()
+
+            def flush():
+                if not batch_items:
+                    return
+                # double buffer: at most 2 dispatched-but-undrained batches
+                # (each holds bucket x stack on device + its results), so
+                # batch size bounds peak memory instead of multiplying it.
+                # Future.exception() blocks without raising — per-item
+                # errors stay with the in-order assembly below.
+                while len(batch_futs) >= 2:
+                    batch_futs.popleft().exception()
+                dfut = dispatch_batch(list(batch_items))
+                batch_futs.append(dfut)
+                for j, (idx, _src, _f, _t) in enumerate(batch_items):
+                    results[idx] = ("batch", dfut, j)
+                batch_items.clear()
+
+            while inflight:
+                idx, src, lfut = inflight.popleft()
+                stats.sample_queue(len(inflight))
+                if next_i < len(pending):     # keep the prefetch window full
+                    j, s = pending[next_i]
+                    inflight.append((j, s, load_pool.submit(load_one, s)))
+                    next_i += 1
+                try:
+                    frames, texture = lfut.result()
+                except faults.InjectedCrash:
+                    raise
+                except Exception as e:
+                    results[idx] = ("fail", src, e)
+                    continue
+                if batch_items and frames.shape != batch_items[0][2].shape:
+                    flush()       # heterogeneous stacks cannot share a batch
+                batch_items.append((idx, src, frames, texture))
+                if len(batch_items) >= batch_n:
+                    flush()
+            flush()               # ragged tail -> smaller bucket
+
+            # ---- in-order assembly: identical report to the other lanes --
+            for idx, src in pending:
+                name = _item_name(src)
+                kind, *rest = results[idx]
+                err: BaseException
+                if kind == "batch":
+                    dfut, j = rest
+                    try:
+                        out = dfut.result()[j]
+                    except Exception as e:
+                        if is_backend_init_error(e):
+                            raise
+                        err = e
+                    else:
+                        if out[0] == "ok":
+                            _, out_path, n_pts, wfut = out
+                            try:
+                                if wfut is not None:
+                                    try:
+                                        wfut.result()
+                                    except faults.InjectedCrash:
+                                        raise
+                                    except Exception as e:
+                                        faults.annotate(e, stage="write")
+                                        raise
+                                log(f"[reconstruct] {name}: {n_pts:,} "
+                                    f"points -> "
+                                    f"{out_path if wfut is not None else 'in-memory handoff'}")
+                                report.outputs.append(out_path)
+                                continue
+                            except Exception as e:
+                                if is_backend_init_error(e):
+                                    raise
+                                err = e
+                        else:
+                            err = out[2]
+                else:
+                    err = rest[-1]
+                _record_failure(report, src, name, err, log, stats=stats)
+    finally:
+        load_pool.shutdown(wait=False, cancel_futures=True)
+        drain_pool.shutdown(wait=False, cancel_futures=True)
+        wbq.close(wait=True)
+    stats.finish(time.perf_counter() - t_wall)
+    report.overlap = stats.as_dict()
+    report.overlap["compute_batch"] = batch_n
+    report.overlap["shard_devices"] = n_dev
+    report.retries += report.overlap.get("retry_total", 0)
+    prof.get_logger().debug("reconstruct batched overlap: %s",
+                            stats.summary())
+
+
+def _use_batched(cfg: Config, scanner, n_sources: int) -> bool:
+    """One predicate for both call sites (reconstruct, run_pipeline): the
+    view-batched lane needs a device scanner (numpy backend and bitexact
+    export triangulate per view on host), >1 view, and compute_batch > 1."""
+    return (scanner is not None and cfg.parallel.compute_batch > 1
+            and n_sources > 1)
+
+
 def _build_scanner(sources, calib, cfg: Config):
     """SLScanner for the fused device program, or None for the NumPy /
     bitexact paths (which triangulate through the host twin). Shared by
@@ -506,11 +843,13 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
     ``output``: for single mode a .ply path (default: <target>.ply); for
     batch/files a directory (default: alongside each source).
 
-    Multi-view batches run on the pipelined executor (prefetch + async
-    device dispatch + background writeback — ``_reconstruct_pipelined``)
-    when ``cfg.parallel.io_workers > 1``; outputs and the report are
-    identical to the serial loop, which remains the ``io_workers <= 1``
-    fallback and the single-view path.
+    Multi-view batches with a device scanner run on the VIEW-BATCHED
+    executor (``_reconstruct_batched``: bucket-padded ``forward_views``
+    launches of ``parallel.compute_batch`` views, sharded across devices
+    when >1 is attached); ``compute_batch <= 1``, the numpy backend, and
+    bitexact export fall back to the pipelined per-view executor
+    (``cfg.parallel.io_workers > 1``) and then the serial loop. Outputs and
+    the report are identical across all three — only the schedule differs.
     """
     cfg = cfg or Config()
     calib = matfile.load_calibration(calib_path)
@@ -523,10 +862,20 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
     scanner = _build_scanner(sources, calib, cfg)
 
     report = BatchReport()
+    report.host_cpus = os.cpu_count()
+    if scanner is not None:
+        # the scanner's construction already initialized the jax backend;
+        # the numpy lane must never probe devices (it would claim one)
+        import jax
+
+        report.device_count = jax.device_count()
     if output and mode != "single":
         os.makedirs(output, exist_ok=True)
     t0 = time.monotonic()
-    if cfg.parallel.io_workers > 1 and len(sources) > 1:
+    if _use_batched(cfg, scanner, len(sources)):
+        _reconstruct_batched(sources, calib, cfg, scanner, mode, output,
+                             report, log)
+    elif cfg.parallel.io_workers > 1 and len(sources) > 1:
         _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output,
                                report, log)
     else:
@@ -939,14 +1288,16 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     view_cfg = config_subtree(cfg, ("decode", "triangulate", "projector",
                                     "clean")) + json.dumps(
         {"steps": list(steps), "backend": cfg.parallel.backend})
-    view_keys: list[str] = []
     collected: dict[int, tuple[np.ndarray, np.ndarray]] = {}
     missing: list[tuple[int, str]] = []
+    # per-view content keys hashed on the I/O pool — the serial hash wall
+    # otherwise delays the batched executor's first launch
+    view_keys = cache.keys_parallel(
+        "view",
+        [[calib_path] + imio.list_frame_files(src) for src in sources],
+        config_json=view_cfg, io_workers=cfg.parallel.io_workers)
     for i, src in enumerate(sources):
-        key = cache.key("view", files=[calib_path] + imio.list_frame_files(src),
-                        config_json=view_cfg)
-        view_keys.append(key)
-        hit = cache.get("view", key)
+        hit = cache.get("view", view_keys[i])
         if hit is not None:
             collected[i] = (np.asarray(hit["points"], np.float32),
                             np.asarray(hit["colors"], np.uint8))
@@ -972,7 +1323,9 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
                     batch, log)
         kw = dict(clean_steps=steps, collect=collect,
                   write_plys=cfg.pipeline.write_view_plys)
-        if cfg.parallel.io_workers > 1 and len(miss_sources) > 1:
+        if _use_batched(cfg, scanner, len(miss_sources)):
+            _reconstruct_batched(*run_args, **kw)
+        elif cfg.parallel.io_workers > 1 and len(miss_sources) > 1:
             _reconstruct_pipelined(*run_args, **kw)
         else:
             _reconstruct_serial(*run_args, **kw)

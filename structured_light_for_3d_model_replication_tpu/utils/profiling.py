@@ -132,7 +132,7 @@ class OverlapStats:
     ahead and the bound is doing its job).
     """
 
-    _STAGES = ("load", "compute", "clean", "write")
+    _STAGES = ("load", "transfer", "compute", "clean", "write")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -141,6 +141,14 @@ class OverlapStats:
         self._failures = {s: 0 for s in self._STAGES}
         self._items = 0
         self._queue_samples: list[int] = []
+        # batch-launch accounting (the view-batched executor): how many
+        # device launches carried how many real views, and the first
+        # dispatch wall per bucket size (the compile-cost proxy — later
+        # launches of the same bucket reuse the executable)
+        self._launches = 0
+        self._views_dispatched = 0
+        self._batch_views: list[int] = []
+        self._bucket_first_s: dict[int, float] = {}
         self.critical_path_s = 0.0
 
     def add(self, stage: str, elapsed_s: float, items: int = 0) -> None:
@@ -168,6 +176,19 @@ class OverlapStats:
         with self._lock:
             self._failures[stage] += 1
 
+    def add_launch(self, n_views: int, bucket: int,
+                   dispatch_s: float) -> None:
+        """Record one batched device launch carrying ``n_views`` real views
+        padded to ``bucket`` slots; ``dispatch_s`` is the (async) dispatch
+        wall — dominated by trace+compile the first time a bucket is seen,
+        near-zero after (the no-retrace gauge)."""
+        with self._lock:
+            self._launches += 1
+            self._views_dispatched += int(n_views)
+            self._batch_views.append(int(n_views))
+            if bucket not in self._bucket_first_s:
+                self._bucket_first_s[int(bucket)] = round(dispatch_s, 4)
+
     def sample_queue(self, depth: int) -> None:
         with self._lock:
             self._queue_samples.append(int(depth))
@@ -194,21 +215,44 @@ class OverlapStats:
         out["failures"] = dict(self._failures)
         out["retry_total"] = sum(self._retries.values())
         out["failure_total"] = sum(self._failures.values())
+        # batched-launch gauges (zeros/None on the per-view executors);
+        # the per-item normalizations make batched and per-view lines
+        # directly comparable
+        bv = self._batch_views
+        out["launches"] = self._launches
+        out["views_dispatched"] = self._views_dispatched
+        out["mean_views_per_launch"] = (round(sum(bv) / len(bv), 2)
+                                        if bv else 0.0)
+        out["min_views_per_launch"] = min(bv) if bv else 0
+        out["max_views_per_launch"] = max(bv) if bv else 0
+        out["bucket_first_dispatch_s"] = {
+            str(k): v for k, v in sorted(self._bucket_first_s.items())}
+        items = self._items
+        out["compute_per_item_s"] = (round(self._stage_s["compute"] / items, 4)
+                                     if items else None)
+        out["transfer_per_item_s"] = (
+            round(self._stage_s["transfer"] / items, 4) if items else None)
         return out
 
     def summary(self) -> str:
         d = self.as_dict()
         clean = (f" + clean {d['clean_s']}s" if d.get("clean_s") else "")
+        xfer = (f" + transfer {d['transfer_s']}s" if d.get("transfer_s")
+                else "")
         resil = ""
         if d["retry_total"] or d["failure_total"]:
             resil = (f", {d['retry_total']} retries / "
                      f"{d['failure_total']} failures")
-        return (f"load {d['load_s']}s + compute {d['compute_s']}s{clean}"
-                f" + write {d['write_s']}s = {d['serial_sum_s']}s "
+        batched = ""
+        if d["launches"]:
+            batched = (f", {d['views_dispatched']} views in {d['launches']} "
+                       f"launches (mean {d['mean_views_per_launch']}/launch)")
+        return (f"load {d['load_s']}s{xfer} + compute {d['compute_s']}s"
+                f"{clean} + write {d['write_s']}s = {d['serial_sum_s']}s "
                 f"serial-equivalent in {d['critical_path_s']}s wall "
                 f"(overlap x{d['overlap_ratio']}, queue depth "
                 f"max {d['max_queue_depth']} mean {d['mean_queue_depth']}"
-                f"{resil})")
+                f"{batched}{resil})")
 
 
 @contextlib.contextmanager

@@ -102,3 +102,21 @@ def test_disabled_cache_is_all_misses_no_files(tmp_path):
     c.put("view", key, **_arrays())
     assert c.get("view", key) is None
     assert not os.path.isdir(root)
+
+
+def test_keys_parallel_matches_serial_keys(tmp_path):
+    """The batched executor hashes per-view keys on the I/O pool; the keys
+    must be exactly what the serial key() computes, in item order."""
+    c = StageCache(str(tmp_path / "cache"))
+    lists = []
+    for i in range(5):
+        f = tmp_path / f"frame_{i}.bin"
+        f.write_bytes(os.urandom(64) + bytes([i]))
+        lists.append([str(f)])
+    lists[3] = [str(tmp_path / "frame_0.bin"), str(tmp_path / "frame_1.bin")]
+    serial = [c.key("view", files=fl, config_json='{"a":1}') for fl in lists]
+    assert c.keys_parallel("view", lists, config_json='{"a":1}',
+                           io_workers=4) == serial
+    assert c.keys_parallel("view", lists, config_json='{"a":1}',
+                           io_workers=1) == serial
+    assert len(set(serial)) == len(serial)  # distinct inputs, distinct keys

@@ -2,8 +2,8 @@
 # Tier-1 verify — the ROADMAP.md command, verbatim. Run from the repo root.
 # Prints DOTS_PASSED=<n> after the pytest summary; exit code is pytest's.
 # Afterwards: records DOTS_PASSED into a log artifact (tools/_ci/tier1_dots.log)
-# and runs the pipeline bench smoke (`python bench.py --pipeline-only`) — no
-# thresholds, just "completes and the fused/serial outputs are identical".
+# and runs the pipeline, batched-reconstruct, and chaos smokes — no
+# thresholds, just "completes and the outputs are identical/recovered".
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}
 dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
@@ -24,6 +24,21 @@ if [ $smoke_rc -eq 0 ] \
   echo "PIPELINE_SMOKE=ok"
 else
   echo "PIPELINE_SMOKE=FAIL (rc=$smoke_rc; see tools/_ci/pipeline_smoke.json)"
+  [ $rc -eq 0 ] && rc=1
+fi
+
+# ---- batched reconstruct smoke: one 2-view forward_views launch must be
+# byte-identical to the per-view dispatch loop (ISSUE 4) ----
+batched_rc=0
+batched=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python bench.py --batched-only --views=2 --compute-batch=2 2>/dev/null) || batched_rc=$?
+echo "$batched" > tools/_ci/batched_smoke.json
+if [ $batched_rc -eq 0 ] \
+   && echo "$batched" | grep -q '"outputs_identical": true' \
+   && echo "$batched" | grep -q '"launches": 1' \
+   && echo "$batched" | grep -q '"views_dispatched": 2'; then
+  echo "BATCHED_SMOKE=ok"
+else
+  echo "BATCHED_SMOKE=FAIL (rc=$batched_rc; see tools/_ci/batched_smoke.json)"
   [ $rc -eq 0 ] && rc=1
 fi
 
