@@ -102,6 +102,19 @@ class MergeConfig:
     sample_after: int = 0
     final_voxel: float = 0.5
     method: str = "sequential"   # 'sequential' (A18) | 'posegraph' (Old/360Merge.py loop closure)
+    # streaming merge (the fused pipeline only): register pair (i, i+1) the
+    # moment both views are cleaned, overlapping registration with the
+    # reconstruction of later views; the accumulate + final voxel/outlier
+    # pass stays the only barrier. false = the monolithic barrier merge
+    # (also the arm method='posegraph' always takes, with a logged notice).
+    # Both arms produce byte-identical merged PLY/STL — stream/pair_batch
+    # are SCHEDULE knobs and never enter stage-cache key material.
+    stream: bool = True
+    # ready pairs per register-lane launch: pairs group into bucket-padded
+    # batches of this many (ragged tails land on a power-of-two ladder, so
+    # at most log2(pair_batch)+1 programs compile per cloud bucket); with
+    # >1 device the group dispatches through register_pairs_sharded
+    pair_batch: int = 4
 
 
 @dataclass

@@ -31,7 +31,8 @@ from structured_light_for_3d_model_replication_tpu.ops import (
 
 __all__ = ["merge_360", "merge_360_posegraph", "preprocess_for_registration",
            "chamfer_distance", "DeviceClouds", "compact_views_device",
-           "stack_views_device"]
+           "stack_views_device", "prep_view", "register_prep_pairs",
+           "finalize_chain"]
 
 
 @dataclass
@@ -445,6 +446,201 @@ def _register_chain_batched(preps, cfg: MergeConfig, voxel: float,
             np.asarray(ifit, np.float32), np.asarray(irmse, np.float32))
 
 
+# ---------------------------------------------------------------------------
+# Canonical per-view / per-pair registration (the streaming-merge contract)
+# ---------------------------------------------------------------------------
+#
+# The streaming pipeline registers pair (i, i+1) the moment both views are
+# cleaned, while the barrier arm registers every pair at once — and the two
+# must produce BYTE-IDENTICAL merged output. f32 reductions are not
+# associative, so bit-parity demands every pair be computed at shapes that
+# are a function of the pair alone, never of its launch-mates:
+#
+#   - prep_view: per-view shapes (8192-multiple raw pad, 2048-multiple
+#     survivor bucket) derived from that view's own counts
+#   - pair bucket: max of the two views' buckets; the smaller prep is
+#     zero-padded (invalid rows contribute exact zeros to every masked
+#     reduction, and the shape — hence XLA's tiling — is schedule-invariant)
+#   - RANSAC key: folds the pair's explicit chain id (register_pairs
+#     pair_ids), not its position in whatever launch carried it
+#   - launches group same-bucket pairs on the _pair_group_bucket ladder;
+#     lax.map applies the same compiled body per pair, so group composition
+#     cannot change a pair's numbers
+#
+# merge_360's host path routes through exactly this machinery, which is what
+# makes `merge.stream=false` (barrier) and the streamed pipeline two
+# schedules of one computation.
+
+@jax.jit
+def _voxel_view_jit(pts, valid, vs):
+    p, _, v = pc.voxel_downsample(pts, jnp.zeros(pts.shape, jnp.uint8),
+                                  valid, vs)
+    return p, v
+
+
+def prep_view(points, voxel: float, sample_before: int = 0) -> _Prep:
+    """Canonical per-view registration prep: voxel downsample -> normals ->
+    FPFH at shapes derived from THIS view alone. A view prepped as it
+    streams out of the reconstruct executor is bit-identical to the same
+    view prepped inside a barrier merge — the invariant the
+    streamed≡barrier byte-parity contract rests on."""
+    p = np.asarray(points, np.float32)
+    if sample_before and sample_before > 1:
+        p = p[::sample_before]
+    n = len(p)
+    n_raw = -(-max(n, 1) // 8192) * 8192
+    pts = np.full((n_raw, 3), 1e9, np.float32)
+    pts[:n] = p
+    valid = np.zeros(n_raw, bool)
+    valid[:n] = True
+    p_all, v_all = _voxel_view_jit(jnp.asarray(pts), jnp.asarray(valid),
+                                   jnp.float32(voxel))
+    cnt = int(np.asarray(v_all.sum()))            # one small sync
+    bucket = _bucket_pad(cnt, n_raw)
+    # survivors occupy a contiguous slot prefix (pinned by
+    # test_voxel_downsample_survivor_prefix), so the bucket slice is sound
+    p_c = p_all[:bucket]
+    v_c = jnp.arange(bucket, dtype=jnp.int32) < cnt
+    nr, feat = _prep_features_jit(p_c, v_c,
+                                  jnp.float32(FEAT_RADIUS_SCALE * voxel))
+    return _Prep(p_c, v_c, nr, feat)
+
+
+def _pair_group_bucket(count: int, batch: int, n_dev: int = 1) -> int:
+    """Launch-group size for ready pairs: full groups run at ``batch``
+    slots; a ragged tail lands on the next power of two (the _view_bucket
+    ladder on the pair axis), so at most log2(batch)+1 programs compile per
+    cloud bucket. Sharded groups round up to the device count."""
+    if count >= batch:
+        b = batch
+    else:
+        b = 1
+        while b < count:
+            b *= 2
+        b = min(b, batch)
+    if n_dev > 1:
+        b = -(-b // n_dev) * n_dev
+    return b
+
+
+def _prep_to_bucket(prep: _Prep, bucket: int):
+    """Zero-pad one view's prep arrays to a pair bucket (pad rows invalid —
+    they contribute exact zeros to every masked reduction)."""
+    b = prep.points.shape[0]
+    if b == bucket:
+        return prep.points, prep.valid, prep.normals, prep.features
+    pad = bucket - b
+    return (jnp.concatenate([prep.points,
+                             jnp.zeros((pad, 3), jnp.float32)]),
+            jnp.concatenate([prep.valid, jnp.zeros(pad, bool)]),
+            jnp.concatenate([prep.normals,
+                             jnp.zeros((pad, 3), jnp.float32)]),
+            jnp.concatenate([prep.features,
+                             jnp.zeros((pad, prep.features.shape[1]),
+                                       jnp.float32)]))
+
+
+def register_prep_pairs(pairs, pair_ids, cfg: MergeConfig, voxel: float,
+                        mesh=None, feat_bf16: bool | None = None,
+                        batch: int | None = None):
+    """Register (prep_src, prep_dst) pairs through the canonical fixed-shape
+    program: pairs group by pair bucket (max of the two views' buckets),
+    each group launches at the ``_pair_group_bucket`` ladder size (padded
+    with duplicates of the last pair, dropped on return) via
+    ``register_pairs`` — or ``register_pairs_sharded`` over ``mesh`` with
+    >1 device. ``pair_ids`` are each pair's GLOBAL chain position (the
+    RANSAC key id). Returns host (T [P,4,4], gfit, ifit, irmse) in input
+    order; results are invariant to how pairs were grouped into launches."""
+    n_pairs = len(pairs)
+    batch = max(1, int(batch if batch is not None
+                       else getattr(cfg, "pair_batch", 4)))
+    n_dev = (int(np.prod(list(mesh.shape.values())))
+             if mesh is not None else 1)
+    T = np.zeros((n_pairs, 4, 4), np.float32)
+    gf = np.zeros(n_pairs, np.float32)
+    fi = np.zeros(n_pairs, np.float32)
+    ir = np.zeros(n_pairs, np.float32)
+    kw = dict(max_dist=voxel * 1.5,
+              icp_max_dist=voxel * float(cfg.icp_dist_ratio),
+              trials=cfg.ransac_trials, icp_iters=cfg.icp_iters,
+              feat_bf16=feat_bf16)
+    by_bucket: dict[int, list[int]] = {}
+    for i, (s, d) in enumerate(pairs):
+        b = max(s.points.shape[0], d.points.shape[0])
+        by_bucket.setdefault(b, []).append(i)
+    for bucket in sorted(by_bucket):
+        idxs = by_bucket[bucket]
+        for s0 in range(0, len(idxs), batch):
+            chunk = idxs[s0:s0 + batch]
+            pb = _pair_group_bucket(len(chunk), batch, n_dev)
+            launch = chunk + [chunk[-1]] * (pb - len(chunk))
+            stacks = [[] for _ in range(7)]
+            for i in launch:
+                sp, sv, sn, sf = _prep_to_bucket(pairs[i][0], bucket)
+                dp, dv, dn, df = _prep_to_bucket(pairs[i][1], bucket)
+                for k, a in enumerate((sp, sv, sf, dp, dv, df, dn)):
+                    stacks[k].append(a)
+            args = tuple(jnp.stack(s) for s in stacks)
+            ids = np.asarray([pair_ids[i] for i in launch], np.int32)
+            if mesh is not None:
+                out = reg.register_pairs_sharded(mesh, *args, pair_ids=ids,
+                                                 **kw)
+            else:
+                out = reg.register_pairs(*args, pair_ids=ids, **kw)
+            T_l, gf_l, fi_l, ir_l = jax.device_get(out)
+            for j, i in enumerate(chunk):
+                T[i] = T_l[j]
+                gf[i] = gf_l[j]
+                fi[i] = fi_l[j]
+                ir[i] = ir_l[j]
+    return T, gf, fi, ir
+
+
+def finalize_chain(clouds, T_pairs, gfit_all, ifit_all, irmse_all,
+                   cfg: MergeConfig | None = None, log=print,
+                   step_callback=None, mesh=None, timings: dict | None = None):
+    """Chain-accumulate per-pair transforms and run the final voxel/outlier
+    postprocess — the barrier tail shared by merge_360's host path and the
+    streaming pipeline. Given the same per-pair transforms it produces
+    byte-identical merged output, whichever schedule registered the pairs."""
+    import time as _time
+
+    cfg = cfg or MergeConfig()
+    tm = timings if timings is not None else {}
+    n = len(clouds)
+    transforms = [np.eye(4, dtype=np.float32)]
+    merged_p = [np.asarray(clouds[0][0], np.float32)]
+    merged_c = [np.asarray(clouds[0][1], np.uint8)]
+    t0 = _time.perf_counter()
+    t_accum = np.eye(4, dtype=np.float32)
+    for i in range(1, n):
+        gfit = float(gfit_all[i - 1])
+        if gfit < 0.05:
+            log(f"[merge_360] WARNING view {i}: global fitness "
+                f"{gfit:.3f} < 0.05 — alignment may fail "
+                f"(processing.py:566-569 semantics)")
+        log(f"[merge_360] view {i}: global fit {gfit:.3f} | "
+            f"ICP fit {float(ifit_all[i - 1]):.3f} "
+            f"rmse {float(irmse_all[i - 1]):.3f}")
+        t_accum = (t_accum @ np.asarray(T_pairs[i - 1],
+                                        np.float32)).astype(np.float32)
+        transforms.append(t_accum.copy())
+        cur_p_full = np.asarray(clouds[i][0], np.float32)
+        moved = cur_p_full @ t_accum[:3, :3].T + t_accum[:3, 3]
+        merged_p.append(moved.astype(np.float32))
+        merged_c.append(np.asarray(clouds[i][1], np.uint8))
+        if step_callback is not None:
+            # per-view array LISTS, not a concatenated copy (O(V) per step)
+            step_callback(i, merged_p, merged_c)
+    tm["accumulate_s"] = round(_time.perf_counter() - t0, 3)
+    t0 = _time.perf_counter()
+    points = np.concatenate(merged_p)
+    colors = np.concatenate(merged_c)
+    points, colors = _postprocess_dispatch(points, colors, cfg, tm, mesh, log)
+    tm["postprocess_s"] = round(_time.perf_counter() - t0, 3)
+    return points, colors, transforms
+
+
 def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
               step_callback=None, timings: dict | None = None, mesh=None,
               feat_bf16: bool | None = None):
@@ -509,6 +705,25 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
         n_actual = sum(len(p) for p, _ in clouds)
         device_acc = _device_accumulate_ok(cfg, mesh, step_callback, n,
                                            n_raw_est, n_actual)
+        if not device_acc:
+            # host path: the canonical per-view/per-pair machinery — the
+            # SAME programs and key schedule the streaming pipeline uses,
+            # so the barrier merge and a streamed merge of these clouds
+            # are two schedules of one computation (byte-identical output)
+            t0 = _time.perf_counter()
+            preps = [prep_view(p, voxel, cfg.sample_before)
+                     for p, _ in clouds]
+            tm["preprocess_s"] = round(_time.perf_counter() - t0, 3)
+            t0 = _time.perf_counter()
+            T_all, gfit_all, ifit_all, irmse_all = register_prep_pairs(
+                [(preps[i], preps[i - 1]) for i in range(1, n)],
+                list(range(n - 1)), cfg, voxel, mesh=mesh,
+                feat_bf16=feat_bf16)
+            tm["register_s"] = round(_time.perf_counter() - t0, 3)
+            return finalize_chain(clouds, T_all, gfit_all, ifit_all,
+                                  irmse_all, cfg, log=log,
+                                  step_callback=step_callback, mesh=mesh,
+                                  timings=tm)
     t0 = _time.perf_counter()
     if dc is not None:
         preps, raw = _preprocess_views_device(dc, voxel)

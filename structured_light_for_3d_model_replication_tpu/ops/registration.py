@@ -678,10 +678,16 @@ def ransac_global_registration(src_pts, src_feat, src_valid,
     "feat_bf16"))
 def _register_pairs_jit(src_pts, src_valid, src_feat,
                         dst_pts, dst_valid, dst_feat, dst_normals,
-                        max_dist, icp_max_dist, edge_sim, key, *,
+                        max_dist, icp_max_dist, edge_sim, key, pair_ids, *,
                         trials: int, icp_iters: int, mutual: bool,
                         refine_iters: int, nn_mode: str,
                         feat_bf16: bool = False):
+    # pair_ids [P] i32: the RANSAC key folds each pair's EXPLICIT id, not
+    # its position in this launch — so a pair's transform is a pure
+    # function of (its two padded clouds, its id, the knobs), invariant to
+    # how pairs are grouped into launches or sharded across devices. The
+    # streaming merge depends on this: pairs registered one batch at a
+    # time must be bit-identical to the all-pairs barrier launch.
     def one(args):
         i, sp, sv, sf, dp, dv, df, dn = args
         corr_j, corr_ok = _feature_correspondences(sf, df, sv, dv, mutual,
@@ -695,8 +701,7 @@ def _register_pairs_jit(src_pts, src_valid, src_feat,
                                  icp_iters, nn_mode)
         return T, gfit, fit, rmse
 
-    idx = jnp.arange(src_pts.shape[0], dtype=jnp.int32)
-    return jax.lax.map(one, (idx, src_pts, src_valid, src_feat,
+    return jax.lax.map(one, (pair_ids, src_pts, src_valid, src_feat,
                              dst_pts, dst_valid, dst_feat, dst_normals))
 
 
@@ -706,7 +711,7 @@ def register_pairs(src_pts, src_valid, src_feat,
                    trials: int = 4096, icp_iters: int = 30,
                    edge_sim: float = 0.9, seed: int = 0,
                    mutual: bool = True, refine_iters: int = 3,
-                   feat_bf16: bool | None = None):
+                   feat_bf16: bool | None = None, pair_ids=None):
     """Register P independent (src, dst) cloud pairs — FPFH correspondence +
     RANSAC global init + point-to-plane ICP refine per pair — in ONE jitted
     launch (lax.map over pairs; every stage inside is fixed-shape device
@@ -721,18 +726,27 @@ def register_pairs(src_pts, src_valid, src_feat,
     src_valid [P, N], src_feat [P, N, 33], dst_* likewise, dst_normals
     [P, M, 3]. Returns (T [P, 4, 4], global_fitness [P], icp_fitness [P],
     icp_rmse [P]) as device arrays.
+
+    ``pair_ids``: optional [P] i32 RANSAC-key ids (default ``arange(P)`` —
+    the historical schedule). Each pair's result depends only on its own
+    (padded clouds, id, knobs), never on its launch-mates, so callers that
+    split one logical pair set across several launches (the streaming
+    merge) pass each pair's GLOBAL id and get bit-identical transforms.
     """
     from structured_light_for_3d_model_replication_tpu.ops import (
         pallas_kernels as pk,
     )
 
+    p = src_pts.shape[0]
+    ids = (jnp.arange(p, dtype=jnp.int32) if pair_ids is None
+           else jnp.asarray(pair_ids, jnp.int32))
     args = (jnp.asarray(src_pts, jnp.float32), jnp.asarray(src_valid),
             jnp.asarray(src_feat, jnp.float32),
             jnp.asarray(dst_pts, jnp.float32), jnp.asarray(dst_valid),
             jnp.asarray(dst_feat, jnp.float32),
             jnp.asarray(dst_normals, jnp.float32),
             jnp.float32(max_dist), jnp.float32(icp_max_dist),
-            jnp.float32(edge_sim), jax.random.PRNGKey(seed))
+            jnp.float32(edge_sim), jax.random.PRNGKey(seed), ids)
     kw = dict(trials=trials, icp_iters=icp_iters, mutual=mutual,
               refine_iters=refine_iters,
               feat_bf16=_resolve_feat_bf16(feat_bf16))
@@ -753,7 +767,7 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
                            trials: int = 4096, icp_iters: int = 30,
                            edge_sim: float = 0.9, seed: int = 0,
                            mutual: bool = True, refine_iters: int = 3,
-                           feat_bf16: bool | None = None):
+                           feat_bf16: bool | None = None, pair_ids=None):
     """register_pairs distributed over a device mesh: the pair axis shards
     across every device (pairs are independent — zero collectives on the hot
     path), each device lax.map's its local chunk. A 24-view turntable merge
@@ -762,6 +776,11 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
     ``mesh`` is a jax.sharding.Mesh; the pair axis spreads over ALL its
     axes (data-major). P is padded to a multiple of the device count with
     duplicate rows, which are dropped from the returned arrays.
+
+    ``pair_ids`` shard alongside the pairs and feed each pair's RANSAC key
+    directly (default ``arange(P)``) — the key schedule follows the pair,
+    not the device, so a sharded launch returns the same transforms as
+    ``register_pairs`` on one device given the same padded shapes.
     """
     from jax.sharding import PartitionSpec
 
@@ -785,10 +804,13 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
 
     arrays = [_pad(a) for a in (src_pts, src_valid, src_feat, dst_pts,
                                 dst_valid, dst_feat, dst_normals)]
+    ids = (jnp.arange(p, dtype=jnp.int32) if pair_ids is None
+           else jnp.asarray(pair_ids, jnp.int32))
+    ids = _pad(ids)
     key = jax.random.PRNGKey(seed)
-    # one independent key per device shard (pairs inside a shard fold in
-    # their local index on top)
-    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(n_dev))
+    # every device shard sees the same base key; each pair folds in its own
+    # global id inside the body (device-independent key schedule)
+    keys = jnp.tile(key[None, :], (n_dev, 1))
     from structured_light_for_3d_model_replication_tpu.ops import (
         pallas_kernels as pk,
     )
@@ -804,9 +826,9 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
     imd = jnp.float32(icp_max_dist)
     es = jnp.float32(edge_sim)
 
-    def local(sp, sv, sf, dp, dv, df, dn, k):
+    def local(sp, sv, sf, dp, dv, df, dn, ids_l, k):
         return _register_pairs_jit(sp, sv, sf, dp, dv, df, dn,
-                                   md, imd, es, k[0], **kw)
+                                   md, imd, es, k[0], ids_l, **kw)
 
     # replication/VMA checking OFF: _icp_core's lax.while_loop has no
     # replication rule in the shard_map checker (jax<=0.4.x raises
@@ -814,16 +836,16 @@ def register_pairs_sharded(mesh, src_pts, src_valid, src_feat,
     # every in/out spec shards the pair axis, nothing is replicated
     fn = jax.jit(shard_map_unchecked(
         mesh=mesh,
-        in_specs=(spec,) * 8,
+        in_specs=(spec,) * 9,
         out_specs=(spec, spec, spec, spec),
     )(local))
     inputs = arrays
     try:
-        T, gfit, ifit, irmse = fn(*inputs, keys)
+        T, gfit, ifit, irmse = fn(*inputs, ids, keys)
     except Exception:
         if kw["nn_mode"] == "brute":
             raise
         # Mosaic compile failure at this shape: degrade like register_pairs
         kw["nn_mode"] = "brute"
-        T, gfit, ifit, irmse = fn(*inputs, keys)
+        T, gfit, ifit, irmse = fn(*inputs, ids, keys)
     return T[:p], gfit[:p], ifit[:p], irmse[:p]

@@ -158,6 +158,18 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("--compute-batch", type=int, default=None,
                    help="views per device launch for the reconstruct stage "
                         "(default: parallel.compute_batch)")
+    p.add_argument("--stream", dest="stream", action="store_true",
+                   default=None,
+                   help="streaming merge (default: merge.stream): register "
+                        "pair (i, i+1) the moment both views are cleaned, "
+                        "overlapped with reconstruction; byte-identical to "
+                        "the barrier merge")
+    p.add_argument("--no-stream", dest="stream", action="store_false",
+                   help="force the monolithic barrier merge "
+                        "(merge.stream=false)")
+    p.add_argument("--pair-batch", type=int, default=None,
+                   help="ready pairs per register-lane launch "
+                        "(default: merge.pair_batch)")
     add_config_args(p)
 
     p = sub.add_parser("merge-360",
@@ -401,15 +413,27 @@ def _cmd_pipeline(args) -> int:
         cfg.pipeline.write_view_plys = True
     if args.ascii:
         cfg.pipeline.ascii_output = True
+    if args.stream is not None:
+        cfg.merge.stream = args.stream
+    if args.pair_batch is not None:
+        cfg.merge.pair_batch = args.pair_batch
     steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
     report = stages.run_pipeline(args.calib, args.target, args.out, cfg=cfg,
                                  steps=steps, stl_name=args.stl_name)
+    print(f"[pipeline] merge mode: {report.merge_mode} "
+          f"({report.merge_status})")
     if report.overlap:
         o = report.overlap
         clean = (f" + clean {o['clean_s']}s" if o.get("clean_s") else "")
         print(f"[pipeline] overlap: load {o['load_s']}s + compute "
               f"{o['compute_s']}s{clean} + write {o['write_s']}s in "
               f"{o['critical_path_s']}s wall (x{o['overlap_ratio']})")
+        if o.get("pair_launches"):
+            print(f"[pipeline] streamed merge: {o['pairs_dispatched']} "
+                  f"pair(s) in {o['pair_launches']} register launch(es) "
+                  f"(mean {o['mean_pairs_per_launch']}/launch, register "
+                  f"{o['register_s']}s vs critical path "
+                  f"{o['critical_path_s']}s)")
     if report.cache:
         print(f"[pipeline] stage cache: {report.cache['hits']} hits, "
               f"{report.cache['misses']} misses")
@@ -803,6 +827,32 @@ def _cmd_warmup(args) -> int:
         merge_360(clouds, cfg=cfg.merge, log=lambda m: None,
                   mesh=meshlib.merge_mesh(cfg.parallel))
         print(f"[warmup] merge chain: {time.perf_counter() - t0:.1f}s")
+
+        # streaming-merge register ladder: the pipeline's register lane
+        # dispatches ready pairs in _pair_group_bucket-sized groups (full
+        # pair_batch + power-of-two ragged tails), each a DISTINCT program
+        # from the all-pairs chain launch above — warm every rung so the
+        # first streamed scan pays no compile inside the overlapped lane
+        if cfg.merge.stream and len(clouds) >= 2:
+            from structured_light_for_3d_model_replication_tpu.models.reconstruction import (
+                _pair_group_bucket, prep_view, register_prep_pairs,
+            )
+
+            pb = max(1, cfg.merge.pair_batch)
+            voxel = float(cfg.merge.voxel_size)
+            preps = [prep_view(p, voxel, cfg.merge.sample_before)
+                     for p, _ in clouds[:2]]
+            mesh_m = meshlib.merge_mesh(cfg.parallel)
+            n_dev = int(mesh_m.devices.size) if mesh_m is not None else 1
+            for size in sorted({_pair_group_bucket(n, pb, n_dev)
+                                for n in range(1, pb + 1)}):
+                t0 = time.perf_counter()
+                register_prep_pairs([(preps[1], preps[0])] * size,
+                                    list(range(size)), cfg.merge, voxel,
+                                    mesh=mesh_m, batch=size)
+                print(f"[warmup] register ladder[group={size}"
+                      f"{f', {n_dev} devices' if mesh_m is not None else ''}"
+                      f"]: {time.perf_counter() - t0:.1f}s")
     print("[warmup] done — subsequent processes reuse these executables "
           "via the persistent cache")
     return 0

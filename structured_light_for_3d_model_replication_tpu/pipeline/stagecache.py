@@ -11,6 +11,13 @@ Key scheme (sha256, hex):
   view stage   H(schema | stage | frame-file names+bytes | calib bytes |
                  json(decode+triangulate+projector+clean config, steps,
                  backend))
+  pair stage   H(schema | stage | the two views' cleaned-cloud OUTPUT
+                 digests | json(merge cfg numerics, chain pair id)) — one
+                 entry per registered pair, so a rerun with ONE dirty view
+                 re-registers only its <=2 adjacent pairs. Schedule knobs
+                 (merge.stream, merge.pair_batch) never enter the key:
+                 streamed and barrier runs produce identical bytes and
+                 share entries.
   merge stage  H(schema | stage | per-view OUTPUT digests | json(merge cfg))
   mesh stage   H(schema | stage | merged OUTPUT digest | json(mesh cfg))
 
@@ -241,5 +248,6 @@ class StageCache:
     def stats(self) -> dict:
         return {"hits": len(self.hits), "misses": len(self.misses),
                 "hit_stages": list(self.hits),
+                "miss_stages": list(self.misses),
                 "evicted": len(self.evicted),
                 "put_errors": len(self.put_errors)}

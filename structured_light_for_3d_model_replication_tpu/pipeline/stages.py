@@ -310,7 +310,7 @@ def _reconstruct_serial(sources, calib, cfg, scanner, mode, output, report,
 
 def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
                            log, clean_steps=None, collect=None,
-                           write_plys=True) -> None:
+                           write_plys=True, stats=None) -> None:
     """Pipelined batch executor: three (or four) overlapped stages per view.
 
       load     — frame stacks prefetched on an ``io_workers`` thread pool,
@@ -344,7 +344,10 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
         is_backend_init_error,
     )
 
-    stats = prof.OverlapStats()
+    # `stats` may be shared with the caller (run_pipeline's streaming merge
+    # adds its register lane to the same object so overlap reads as one
+    # schedule); standalone runs own a private one
+    stats = stats if stats is not None else prof.OverlapStats()
     policy = _retry_policy(cfg)
     depth = max(1, cfg.parallel.prefetch_depth)
     workers = cfg.parallel.io_workers
@@ -500,7 +503,7 @@ def _view_bucket(count: int, batch: int, n_dev: int = 1) -> int:
 
 def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
                          log, clean_steps=None, collect=None,
-                         write_plys=True) -> None:
+                         write_plys=True, stats=None) -> None:
     """View-batched executor: the default compute lane when a device scanner
     is available and ``parallel.compute_batch > 1``. Same overlapped stages
     as ``_reconstruct_pipelined``, but the compute stage dispatches
@@ -546,7 +549,7 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
         is_backend_init_error,
     )
 
-    stats = prof.OverlapStats()
+    stats = stats if stats is not None else prof.OverlapStats()
     policy = _retry_policy(cfg)
     batch_n = max(1, cfg.parallel.compute_batch)
     workers = max(1, cfg.parallel.io_workers)
@@ -1178,16 +1181,29 @@ class PipelineReport:
     degraded: bool = False          # merged with fewer views than captured
     manifest_path: str | None = None  # failure manifest next to the STL
     merge_status: str = ""          # 'computed' | 'cache-hit'
+    # which merge arm actually ran: 'streamed' (register lane overlapped
+    # with reconstruction) | 'barrier' (monolithic merge_360) | 'posegraph'
+    # (always a barrier; merge.stream is ignored with a logged notice).
+    # Stamped into the failure manifest and every bench line so records
+    # are attributable to an arm.
+    merge_mode: str = ""
     mesh_status: str = ""
     merged_points: int = 0
-    overlap: dict | None = None     # executor lanes incl. the clean lane
+    overlap: dict | None = None     # executor lanes incl. clean + register
     cache: dict | None = None       # StageCache.stats()
     elapsed_s: float = 0.0
 
     @property
     def summary(self) -> str:
-        deg = (f" DEGRADED ({len(self.failed)} view(s) quarantined)"
-               if self.degraded else "")
+        deg = ""
+        if self.degraded:
+            parts = []
+            if self.failed:
+                parts.append(f"{len(self.failed)} view(s) quarantined")
+            pair_fails = len(self.failures) - len(self.failed)
+            if pair_fails > 0:
+                parts.append(f"{pair_fails} pair(s) identity-fallback")
+            deg = " DEGRADED (" + ", ".join(parts or ["see manifest"]) + ")"
         return (f"{self.views_computed} views computed + "
                 f"{self.views_cached} cached, merge {self.merge_status}, "
                 f"mesh {self.mesh_status}, {self.merged_points:,} points "
@@ -1227,11 +1243,269 @@ def _failure_manifest(out_dir: str, report: "PipelineReport",
         "degraded": report.degraded,
         "aborted": aborted,
         "retries": report.retries,
+        "merge_mode": report.merge_mode,
         "failures": [r.as_dict() for r in report.failures],
         "injected_faults": plan.counts() if plan is not None else {},
     })
     log(f"[pipeline] failure manifest -> {path}")
     return path
+
+
+# merge.stream / merge.pair_batch are SCHEDULE knobs: the streamed and the
+# barrier arm produce byte-identical merged output, so neither may dirty a
+# merge or pair cache entry — they are stripped from all merge-key material
+_MERGE_SCHEDULE_KNOBS = ("stream", "pair_batch")
+
+
+def _merge_numeric_json(cfg: Config) -> str:
+    """The merge config subtree minus its schedule knobs — the key material
+    shared by the merge-stage entry and every per-pair entry."""
+    import dataclasses
+
+    d = dataclasses.asdict(cfg.merge)
+    for k in _MERGE_SCHEDULE_KNOBS:
+        d.pop(k, None)
+    return json.dumps({"merge": d}, sort_keys=True)
+
+
+class _StreamRegistrar:
+    """The ``register`` drain lane of the streaming 360 merge.
+
+    ``run_pipeline`` feeds each view's CLEANED compact cloud here the moment
+    the executor's drain lane produces it (or straight from the view cache);
+    a single register worker thread preps the view
+    (``models.reconstruction.prep_view`` — the canonical per-view program)
+    and, as soon as views i and i+1 are both present with every earlier view
+    accounted for, registers pair i -> i+1 through ``register_prep_pairs``,
+    overlapping feature-prep + RANSAC + ICP with the reconstruction/clean of
+    later views. Cache-miss pairs dispatch in groups of ``merge.pair_batch``
+    (sharded over the merge mesh when one is up); each pair owns a
+    stage-cache entry keyed on the two views' cleaned-cloud OUTPUT digests +
+    the merge config numerics + its chain id, so a rerun with one dirty view
+    re-registers only its <=2 adjacent pairs.
+
+    Pair ids are CHAIN POSITIONS over the surviving views — exactly the ids
+    the barrier ``merge_360`` assigns — so streamed transforms are
+    bit-identical to the barrier arm's. While every view so far has arrived
+    in order, a pair's chain position is just its first view's index; the
+    shifts a quarantined view causes are only final once the executor
+    returns, so any pair not streamable under that rule (everything past the
+    first failed view, including the (k-1) -> (k+1) re-pair around a
+    quarantined view k) registers in ``finish``'s catch-up — correctness
+    never depends on the overlap.
+
+    Failure containment (PR-3 semantics): a failing pair registration
+    retries under the pipeline retry policy, then falls back to the IDENTITY
+    transform with a prominent warning + a structured ``FailureRecord`` —
+    the run completes DEGRADED instead of losing the whole merge. Identity
+    results are never published to the pair cache, and a merge containing
+    one is never published to the merge cache, so a rerun re-attempts the
+    real registration.
+    """
+
+    def __init__(self, cfg: Config, cache, stats: "prof.OverlapStats",
+                 mesh, log):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from structured_light_for_3d_model_replication_tpu.models import (
+            reconstruction as recon,
+        )
+
+        self._recon = recon
+        self.cfg = cfg
+        self.cache = cache
+        self.stats = stats
+        self.mesh = mesh
+        self.log = log
+        self.voxel = float(cfg.merge.voxel_size)
+        self.fb16 = True if cfg.parallel.force_bf16_features else None
+        self.pair_batch = max(1, cfg.merge.pair_batch)
+        self.policy = _retry_policy(cfg)
+        self._pair_cfg = _merge_numeric_json(cfg) + json.dumps(
+            {"backend": cfg.parallel.backend,
+             "force_bf16": cfg.parallel.force_bf16_features})
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="sl3d-register")
+        self._futs: list = []
+        self._closed = False
+        # all state below is mutated only on the register worker until
+        # close() drains it; finish()'s catch-up then owns it single-threaded
+        self._digests: dict[int, str] = {}
+        self._clouds: dict[int, tuple] = {}
+        self._preps: dict[int, object] = {}
+        self._frontier = 0            # first view index not yet collected
+        self._chain: list[int] = []   # contiguous prefix of collected views
+        self._seen: set[tuple] = set()
+        self._done: dict[tuple, tuple] = {}
+        self._pending: list[tuple] = []
+        self.failures: list[faults.FailureRecord] = []
+
+    # ---- public API (any thread) ----------------------------------------
+
+    def feed(self, i: int, pts, cols) -> None:
+        """Hand view ``i``'s cleaned compact cloud to the lane. Safe from
+        the executor's drain thread — all work happens on the register
+        worker, so cleaning view N+1 never blocks on registering pair N."""
+        self._futs.append(self._pool.submit(self._note, i, pts, cols))
+
+    def close(self) -> None:
+        """Drain the worker and surface injected crashes. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._pool.shutdown(wait=True)
+        for f in self._futs:
+            e = f.exception()
+            if isinstance(e, faults.InjectedCrash):
+                raise e
+            if e is not None:
+                self.log(f"[pipeline] WARNING: register lane error "
+                         f"({type(e).__name__}: {e}); the affected pairs "
+                         f"fall to the merge-time catch-up")
+
+    def finish(self, order: list[int], collected: dict):
+        """Barrier the lane, register every remaining survivor pair (the
+        degraded-adjacency re-pairs land here), and return host
+        ``(T [P,4,4], gfit [P], ifit [P], irmse [P])`` aligned to
+        consecutive pairs of ``order``."""
+        self.close()
+        for i in order:     # backfill anything a lost feed never recorded
+            if i not in self._digests:
+                pts, cols = collected[i]
+                self._clouds[i] = (pts, cols)
+                self._digests[i] = _stagecache_digest(points=pts, colors=cols)
+        pairs = [(p, order[p + 1], order[p]) for p in range(len(order) - 1)]
+        for t in pairs:
+            if t not in self._seen:
+                if t[1] - t[2] > 1:
+                    self.log(f"[pipeline] re-pairing around quarantined "
+                             f"view(s): pair {t[2]}->{t[1]} (chain "
+                             f"position {t[0]}) closes the ring")
+                self._enqueue(*t)
+        self._dispatch()
+        if not pairs:
+            z = np.zeros(0, np.float32)
+            return np.zeros((0, 4, 4), np.float32), z, z, z
+        T = np.stack([self._done[t][0] for t in pairs])
+        gf = np.asarray([self._done[t][1] for t in pairs], np.float32)
+        fi = np.asarray([self._done[t][2] for t in pairs], np.float32)
+        ir = np.asarray([self._done[t][3] for t in pairs], np.float32)
+        return T, gf, fi, ir
+
+    # ---- register-worker internals ---------------------------------------
+
+    def _note(self, i, pts, cols):
+        self._digests[i] = _stagecache_digest(points=pts, colors=cols)
+        self._clouds[i] = (pts, cols)
+        while self._frontier in self._clouds:
+            self._chain.append(self._frontier)
+            self._frontier += 1
+            if len(self._chain) >= 2:
+                # pair readiness rule: both ends collected AND every earlier
+                # view resolved — its chain position (the RANSAC key id) is
+                # then final, so the streamed transform is the barrier's
+                self._enqueue(len(self._chain) - 2,
+                              self._chain[-1], self._chain[-2])
+
+    def _enqueue(self, pid: int, src: int, dst: int) -> None:
+        t = (pid, src, dst)
+        self._seen.add(t)
+        key = self.cache.key(
+            "pair", digests=[self._digests[dst], self._digests[src]],
+            config_json=self._pair_cfg + json.dumps({"pair": pid}))
+        hit = self.cache.get("pair", key)
+        if hit is not None:
+            self._done[t] = (np.asarray(hit["T"], np.float32),
+                             float(hit["gfit"]), float(hit["ifit"]),
+                             float(hit["irmse"]))
+            return
+        self._pending.append((t, key))
+        if len(self._pending) >= self.pair_batch:
+            self._dispatch()
+
+    def _prep(self, i: int):
+        p = self._preps.get(i)
+        if p is None:
+            t0 = time.perf_counter()
+            p = self._recon.prep_view(self._clouds[i][0], self.voxel,
+                                      self.cfg.merge.sample_before)
+            self.stats.add("register", time.perf_counter() - t0)
+            self._preps[i] = p
+        return p
+
+    def _identity(self, t: tuple, exc: BaseException) -> None:
+        pid, src, dst = t
+        self.log(f"[pipeline] WARNING: registration of pair {dst}->{src} "
+                 f"failed permanently ({type(exc).__name__}: {exc}); "
+                 f"falling back to the IDENTITY transform — the merge "
+                 f"completes DEGRADED with view {src} left in its "
+                 f"neighbor's frame")
+        self.failures.append(faults.FailureRecord.from_exception(
+            "register", f"pair_{dst}_{src}", exc))
+        self.stats.add_failure("register")
+        self._done[t] = (np.eye(4, dtype=np.float32), 0.0, 0.0, 0.0)
+
+    def _dispatch(self) -> None:
+        group, self._pending = self._pending, []
+        if not group:
+            return
+
+        def on_retry(n, e):
+            self.stats.add_retry("register")
+            self.log(f"[pipeline] transient {type(e).__name__} in register "
+                     f"lane ({e}); retry {n}/{self.policy.max_retries}")
+
+        live = []
+        for t, key in group:
+            pid, src, dst = t
+            try:
+                # the per-pair injection site, behind the same bounded
+                # backoff budget as every other lane
+                faults.retry_call(
+                    lambda d=dst, s=src: faults.fire("register.pair",
+                                                     item=f"{d}->{s}"),
+                    self.policy, on_retry=on_retry)
+                live.append((t, key))
+            except faults.InjectedCrash:
+                raise
+            except Exception as e:
+                self._identity(t, e)
+        if not live:
+            return
+        pairs = [(self._prep(src), self._prep(dst))
+                 for (_pid, src, dst), _ in live]
+        ids = [t[0] for t, _ in live]
+
+        def run():
+            return self._recon.register_prep_pairs(
+                pairs, ids, self.cfg.merge, self.voxel, mesh=self.mesh,
+                feat_bf16=self.fb16, batch=self.pair_batch)
+
+        t0 = time.perf_counter()
+        try:
+            T, gf, fi, ir = faults.retry_call(run, self.policy,
+                                              on_retry=on_retry)
+        except faults.InjectedCrash:
+            raise
+        except Exception as e:
+            for t, _ in live:
+                self._identity(t, e)
+            return
+        self.stats.add_pair_launch(len(live), time.perf_counter() - t0)
+        for j, (t, key) in enumerate(live):
+            self._done[t] = (np.asarray(T[j], np.float32), float(gf[j]),
+                             float(fi[j]), float(ir[j]))
+            self.cache.put("pair", key, T=np.asarray(T[j], np.float32),
+                           gfit=np.float32(gf[j]), ifit=np.float32(fi[j]),
+                           irmse=np.float32(ir[j]))
+
+
+def _stagecache_digest(**arrays) -> str:
+    from structured_light_for_3d_model_replication_tpu.pipeline.stagecache import (
+        StageCache,
+    )
+
+    return StageCache.digest_arrays(**arrays)
 
 
 def run_pipeline(calib_path: str, target: str, out_dir: str,
@@ -1305,6 +1579,47 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
             missing.append((i, src))
     report.views_cached = len(collected)
 
+    # ---- merge mode: streamed register lane vs barrier vs posegraph -----
+    if cfg.merge.method == "posegraph":
+        if cfg.merge.stream:
+            log("[pipeline] NOTICE: merge.method='posegraph' has no "
+                "streaming arm — merge.stream is ignored and the barrier "
+                "pose-graph merge runs after reconstruction")
+        report.merge_mode = "posegraph"
+    else:
+        report.merge_mode = "streamed" if cfg.merge.stream else "barrier"
+
+    def merge_mesh_grid():
+        if not cfg.parallel.merge_mesh:
+            return None
+        from structured_light_for_3d_model_replication_tpu.parallel import (
+            mesh as meshlib,
+        )
+
+        return meshlib.merge_mesh(cfg.parallel)
+
+    stream = None
+    stream_stats = None
+    t_stream0 = time.perf_counter()
+
+    def arm_stream():
+        nonlocal stream, stream_stats
+        stream_stats = prof.OverlapStats()
+        stream = _StreamRegistrar(cfg, cache, stream_stats,
+                                  merge_mesh_grid(), log)
+        log(f"[pipeline] merge: streaming register lane armed "
+            f"(pair_batch={cfg.merge.pair_batch})")
+        for i in sorted(collected):
+            stream.feed(i, *collected[i])
+
+    if report.merge_mode == "streamed" and missing:
+        # views will stream out of the executor below: pair (i, i+1)
+        # registers the moment both are cleaned, overlapped with the
+        # reconstruction/clean of later views. (With every view cached the
+        # lane is only armed lazily, on a merge-cache miss — a fully-warm
+        # rerun stays zero-lookup/zero-compute.)
+        arm_stream()
+
     if missing:
         miss_sources = [s for _, s in missing]
         scanner = _build_scanner(miss_sources, calib, cfg)
@@ -1317,6 +1632,8 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
             i = missing[j][0]
             collected[i] = (pts, cols)
             cache.put("view", view_keys[i], points=pts, colors=cols)
+            if stream is not None:
+                stream.feed(i, pts, cols)
 
         batch = BatchReport()
         run_args = (miss_sources, calib, cfg, scanner, "batch", view_dir,
@@ -1324,9 +1641,11 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
         kw = dict(clean_steps=steps, collect=collect,
                   write_plys=cfg.pipeline.write_view_plys)
         if _use_batched(cfg, scanner, len(miss_sources)):
-            _reconstruct_batched(*run_args, **kw)
+            # the register lane shares the executor's OverlapStats so
+            # overlap reads as ONE schedule (register_s vs critical_path_s)
+            _reconstruct_batched(*run_args, **kw, stats=stream_stats)
         elif cfg.parallel.io_workers > 1 and len(miss_sources) > 1:
-            _reconstruct_pipelined(*run_args, **kw)
+            _reconstruct_pipelined(*run_args, **kw, stats=stream_stats)
         else:
             _reconstruct_serial(*run_args, **kw)
         report.failed = batch.failed
@@ -1341,6 +1660,11 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     if report.failures:
         _quarantine_failures(out_dir, report.failures, log)
     if len(collected) < floor:
+        if stream is not None:
+            try:        # the abort is the headline; drain quietly
+                stream.close()
+            except BaseException:
+                pass
         report.manifest_path = _failure_manifest(
             out_dir, report, len(sources), len(collected), aborted=True,
             log=log)
@@ -1362,7 +1686,7 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     view_digests = [StageCache.digest_arrays(points=collected[i][0],
                                              colors=collected[i][1])
                     for i in order]
-    merge_cfg = config_subtree(cfg, ("merge",)) + json.dumps(
+    merge_cfg = _merge_numeric_json(cfg) + json.dumps(
         {"backend": cfg.parallel.backend,
          "force_bf16": cfg.parallel.force_bf16_features,
          "merge_mesh": cfg.parallel.merge_mesh})
@@ -1375,33 +1699,68 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
         colors = np.asarray(hit["colors"], np.uint8)
         transforms = [t for t in np.asarray(hit["transforms"])]
         report.merge_status = "cache-hit"
+        if stream is not None:
+            # identical view bytes -> every streamed pair was a cache hit;
+            # drain the lane and keep the published entries
+            stream.close()
+            stream_stats.finish(time.perf_counter() - t_stream0)
     else:
         clouds = [collected[i] for i in order]
-        mesh_grid = None
-        if cfg.parallel.merge_mesh:
-            from structured_light_for_3d_model_replication_tpu.parallel import (
-                mesh as meshlib,
-            )
-
-            mesh_grid = meshlib.merge_mesh(cfg.parallel)
         fb16 = True if cfg.parallel.force_bf16_features else None
-        with prof.trace():
-            if cfg.merge.method == "posegraph":
-                points, colors, transforms = recon.merge_360_posegraph(
-                    clouds, cfg.merge, log=log, mesh=mesh_grid,
-                    feat_bf16=fb16)
-            else:
-                # DeviceClouds: the per-view clean -> merge handoff stays
-                # in accelerator memory (one compact upload on a host
-                # executor; zero re-upload when the views are resident)
-                dcv = recon.stack_views_device(clouds)
-                points, colors, transforms = recon.merge_360(
-                    dcv, cfg.merge, log=log, mesh=mesh_grid, feat_bf16=fb16)
+        cacheable = True
+        if report.merge_mode == "streamed":
+            if stream is None:
+                # every view was cached but the merge is dirty (a merge
+                # config edit): run the register lane synchronously — the
+                # per-pair cache makes every unchanged pair free
+                arm_stream()
+            with prof.trace():
+                T_all, gf_all, fi_all, ir_all = stream.finish(order,
+                                                              collected)
+                stream_stats.finish(time.perf_counter() - t_stream0)
+                # the ONLY remaining barrier: chain-accumulate + final
+                # voxel/outlier postprocess (slab-sharded over the mesh
+                # when one is up)
+                points, colors, transforms = recon.finalize_chain(
+                    clouds, T_all, gf_all, fi_all, ir_all, cfg.merge,
+                    log=log, mesh=stream.mesh)
+            if stream.failures:
+                report.failures.extend(stream.failures)
+                report.degraded = True
+                cacheable = False   # a rerun must re-attempt the real pair
+                log(f"[pipeline] WARNING: {len(stream.failures)} pair "
+                    f"registration(s) fell back to identity; the merged "
+                    f"model is DEGRADED at those seams")
+        else:
+            mesh_grid = merge_mesh_grid()
+            with prof.trace():
+                if cfg.merge.method == "posegraph":
+                    points, colors, transforms = recon.merge_360_posegraph(
+                        clouds, cfg.merge, log=log, mesh=mesh_grid,
+                        feat_bf16=fb16)
+                else:
+                    # DeviceClouds: the per-view clean -> merge handoff
+                    # stays in accelerator memory (one compact upload on a
+                    # host executor; zero re-upload when resident)
+                    dcv = recon.stack_views_device(clouds)
+                    points, colors, transforms = recon.merge_360(
+                        dcv, cfg.merge, log=log, mesh=mesh_grid,
+                        feat_bf16=fb16)
         points = np.asarray(points, np.float32)
         colors = np.asarray(colors, np.uint8)
-        cache.put("merge", merge_key, points=points, colors=colors,
-                  transforms=np.stack([np.asarray(t) for t in transforms]))
+        if cacheable:
+            cache.put("merge", merge_key, points=points, colors=colors,
+                      transforms=np.stack([np.asarray(t)
+                                           for t in transforms]))
         report.merge_status = "computed"
+    if stream_stats is not None:
+        # one schedule, one record: the executor lanes plus the register
+        # lane (pair launches, register_s vs critical_path_s)
+        snap = stream_stats.as_dict()
+        for k in ("compute_batch", "shard_devices"):
+            if report.overlap and k in report.overlap:
+                snap[k] = report.overlap[k]
+        report.overlap = snap
     ply.write_ply(merged_path, points, colors,
                   binary=not cfg.pipeline.ascii_output)
     log(f"[pipeline] merged cloud -> {merged_path} ({len(points):,} points)")

@@ -18,6 +18,9 @@ supplies the two halves of making that chain resilient:
    ``ply.write``         every PLY/STL artifact write (io/ply.py, io/stl.py)
    ``cache.get``         stage-cache lookup (pipeline/stagecache.py)
    ``cache.put``         stage-cache publish
+   ``register.pair``     streamed-merge pair registration (item is
+                         ``"<dst>-><src>"`` view indices; an exhausted or
+                         permanent hit falls back to the identity transform)
    ``http.capture``      phone HTTP frame capture (acquire/android.py)
    ``serial.rotate``     turntable rotate+wait (acquire/turntable.py)
    ====================  ====================================================

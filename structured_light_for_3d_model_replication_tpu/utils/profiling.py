@@ -132,7 +132,7 @@ class OverlapStats:
     ahead and the bound is doing its job).
     """
 
-    _STAGES = ("load", "transfer", "compute", "clean", "write")
+    _STAGES = ("load", "transfer", "compute", "clean", "write", "register")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -149,6 +149,11 @@ class OverlapStats:
         self._views_dispatched = 0
         self._batch_views: list[int] = []
         self._bucket_first_s: dict[int, float] = {}
+        # register-lane launch accounting (the streaming merge): how many
+        # pair-registration launches carried how many real pairs
+        self._pair_launches = 0
+        self._pairs_dispatched = 0
+        self._pair_batches: list[int] = []
         self.critical_path_s = 0.0
 
     def add(self, stage: str, elapsed_s: float, items: int = 0) -> None:
@@ -189,6 +194,17 @@ class OverlapStats:
             if bucket not in self._bucket_first_s:
                 self._bucket_first_s[int(bucket)] = round(dispatch_s, 4)
 
+    def add_pair_launch(self, n_pairs: int, dispatch_s: float) -> None:
+        """Record one register-lane launch carrying ``n_pairs`` real pairs
+        (group padding excluded); ``dispatch_s`` accumulates into the
+        ``register`` lane as well, so register_s vs critical_path_s reads
+        directly as how much pair registration the stream hid."""
+        with self._lock:
+            self._pair_launches += 1
+            self._pairs_dispatched += int(n_pairs)
+            self._pair_batches.append(int(n_pairs))
+            self._stage_s["register"] += dispatch_s
+
     def sample_queue(self, depth: int) -> None:
         with self._lock:
             self._queue_samples.append(int(depth))
@@ -227,6 +243,12 @@ class OverlapStats:
         out["max_views_per_launch"] = max(bv) if bv else 0
         out["bucket_first_dispatch_s"] = {
             str(k): v for k, v in sorted(self._bucket_first_s.items())}
+        # register-lane gauges (zeros on runs without a streaming merge)
+        pb = self._pair_batches
+        out["pair_launches"] = self._pair_launches
+        out["pairs_dispatched"] = self._pairs_dispatched
+        out["mean_pairs_per_launch"] = (round(sum(pb) / len(pb), 2)
+                                        if pb else 0.0)
         items = self._items
         out["compute_per_item_s"] = (round(self._stage_s["compute"] / items, 4)
                                      if items else None)
@@ -247,6 +269,10 @@ class OverlapStats:
         if d["launches"]:
             batched = (f", {d['views_dispatched']} views in {d['launches']} "
                        f"launches (mean {d['mean_views_per_launch']}/launch)")
+        if d["pair_launches"]:
+            batched += (f", {d['pairs_dispatched']} pairs in "
+                        f"{d['pair_launches']} register launches "
+                        f"(register {d['register_s']}s)")
         return (f"load {d['load_s']}s{xfer} + compute {d['compute_s']}s"
                 f"{clean} + write {d['write_s']}s = {d['serial_sum_s']}s "
                 f"serial-equivalent in {d['critical_path_s']}s wall "

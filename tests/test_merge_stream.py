@@ -1,0 +1,372 @@
+"""Streaming 360 merge (ISSUE 5): the register drain lane.
+
+Contract under test (pipeline/stages._StreamRegistrar + run_pipeline):
+  - streamed merge output is BYTE-IDENTICAL to the barrier arm
+    (merge.stream=false) on the merged PLY and the STL — on the single
+    device and on the 8-virtual-device CPU mesh the conftest forces
+  - every pair owns a stage-cache entry keyed on the two views'
+    cleaned-cloud digests + merge numerics + chain id: a rerun with ONE
+    dirty view re-registers exactly its <=2 adjacent pairs, with no
+    register-program retrace
+  - a quarantined view re-pairs its neighbors (k-1)->(k+1) so degraded
+    runs still close the ring, byte-identical to a clean run on the
+    surviving views
+  - a poisoned pair registration retries, then falls back to the identity
+    transform: the run completes DEGRADED with a structured FailureRecord
+  - merge.stream / --stream / --pair-batch are SCHEDULE knobs: both arms
+    share merge-cache entries and the CLI plumbs them through
+"""
+import glob
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.cli import main as cli_main
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.ops import (
+    registration as reg,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import faults
+from structured_light_for_3d_model_replication_tpu.utils import (
+    profiling as prof,
+)
+
+VIEWS = 5
+PROJ = (64, 32)
+STEPS = ("statistical",)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("streamds"))
+    rc = cli_main(["synth", root, "--views", str(VIEWS),
+                   "--cam", "96x72", "--proj", f"{PROJ[0]}x{PROJ[1]}"])
+    assert rc == 0
+    return root
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.reset()
+
+
+def _cfg(stream: bool, pair_batch: int = 2, mesh: bool = False) -> Config:
+    cfg = Config()
+    cfg.decode.n_cols, cfg.decode.n_rows = PROJ
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 256
+    cfg.merge.icp_iters = 6
+    cfg.merge.stream = stream
+    cfg.merge.pair_batch = pair_batch
+    cfg.parallel.merge_mesh = mesh
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    return cfg
+
+
+def _copy_cache(src_out: str, dst_out: str, stages_=("view",)) -> None:
+    """Seed a fresh out dir with another run's cache entries (keys are
+    content-addressed, so entries are valid across out dirs)."""
+    dst = os.path.join(dst_out, ".slscan-cache")
+    os.makedirs(dst, exist_ok=True)
+    for stage in stages_:
+        for p in glob.glob(os.path.join(src_out, ".slscan-cache",
+                                        f"{stage}-*.npz")):
+            shutil.copy(p, dst)
+
+
+@pytest.fixture(scope="module")
+def barrier_run(dataset, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("barrier"))
+    rep = stages.run_pipeline(os.path.join(dataset, "calib.mat"), dataset,
+                              out, cfg=_cfg(stream=False), steps=STEPS,
+                              log=lambda m: None)
+    assert rep.failed == [] and rep.merge_mode == "barrier"
+    return out, rep
+
+
+@pytest.fixture(scope="module")
+def stream_run(dataset, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("stream"))
+    logs = []
+    rep = stages.run_pipeline(os.path.join(dataset, "calib.mat"), dataset,
+                              out, cfg=_cfg(stream=True), steps=STEPS,
+                              log=logs.append)
+    assert rep.failed == [] and rep.merge_mode == "streamed"
+    return out, rep, logs
+
+
+def test_streamed_matches_barrier_byte_identical(barrier_run, stream_run):
+    """The acceptance A/B on one device: same merged PLY bytes, same STL
+    bytes — the streamed schedule is the barrier computation re-ordered."""
+    _, rb = barrier_run
+    _, rs, logs = stream_run
+    assert open(rb.merged_ply, "rb").read() == open(rs.merged_ply, "rb").read()
+    with open(rb.stl_path, "rb") as fa, open(rs.stl_path, "rb") as fb:
+        assert fa.read() == fb.read()
+    assert any("streaming register lane armed" in m for m in logs)
+    # register-lane launch accounting: 4 pairs in groups of pair_batch=2
+    o = rs.overlap
+    assert o["pairs_dispatched"] == VIEWS - 1
+    assert o["pair_launches"] == 2
+    assert o["mean_pairs_per_launch"] == 2.0
+    assert o["register_s"] > 0
+    # the barrier arm ran no register lane
+    assert (rb.overlap or {}).get("pair_launches", 0) == 0
+
+
+def test_streamed_sharded_matches_single_device(dataset, barrier_run,
+                                                stream_run, tmp_path):
+    """The 8-virtual-device mesh arm: ready pairs dispatch through
+    register_pairs_sharded and the final postprocess runs slab-sharded —
+    bytes must still equal the single-device barrier output (the global
+    pair-id key schedule makes sharded == unsharded bitwise)."""
+    import jax
+
+    assert jax.device_count() == 8          # the conftest mesh
+    out_b, rb = barrier_run
+    out = str(tmp_path / "sharded")
+    _copy_cache(out_b, out)                 # views are schedule-invariant
+    rep = stages.run_pipeline(os.path.join(dataset, "calib.mat"), dataset,
+                              out, cfg=_cfg(stream=True, pair_batch=4,
+                                            mesh=True),
+                              steps=STEPS, log=lambda m: None)
+    assert rep.failed == []
+    assert rep.views_cached == VIEWS and rep.views_computed == 0
+    assert rep.overlap["pairs_dispatched"] == VIEWS - 1
+    assert open(rep.merged_ply, "rb").read() == \
+        open(rb.merged_ply, "rb").read()
+    with open(rep.stl_path, "rb") as fa, open(rb.stl_path, "rb") as fb:
+        assert fa.read() == fb.read()
+
+
+def test_dirty_view_rerun_reregisters_two_pairs(dataset, stream_run,
+                                                tmp_path):
+    """Acceptance: one dirty view -> exactly 2 pair registrations
+    re-execute (its adjacent pairs), every other pair is a cache hit, and
+    the rerun retraces no register program."""
+    out_s, _, _ = stream_run
+    ds2 = str(tmp_path / "ds2")
+    shutil.copytree(dataset, ds2)
+    out = str(tmp_path / "out")
+    _copy_cache(out_s, out, stages_=("view", "pair", "merge", "mesh"))
+
+    # dirty the MIDDLE view: flip a corner of its first frame
+    from structured_light_for_3d_model_replication_tpu.io import (
+        images as imio,
+    )
+
+    victim = sorted(d for d in os.listdir(ds2)
+                    if os.path.isdir(os.path.join(ds2, d)))[2]
+    frame0 = sorted(glob.glob(os.path.join(ds2, victim, "*")))[0]
+    img = imio.load_gray(frame0).copy()
+    img[:8, :8] = 255 - img[:8, :8]
+    imio.save_image(frame0, img)
+
+    before = reg._register_pairs_jit._cache_size()
+    rep = stages.run_pipeline(os.path.join(ds2, "calib.mat"), ds2, out,
+                              cfg=_cfg(stream=True), steps=STEPS,
+                              log=lambda m: None)
+    after = reg._register_pairs_jit._cache_size()
+    assert rep.failed == []
+    assert rep.views_computed == 1 and rep.views_cached == VIEWS - 1
+    pair_misses = [s for s in rep.cache["miss_stages"] if s == "pair"]
+    assert len(pair_misses) == 2, rep.cache
+    assert rep.overlap["pairs_dispatched"] == 2
+    # hits cover the untouched pairs (plus the view entries)
+    assert rep.cache["hit_stages"].count("pair") == VIEWS - 3
+    assert after - before == 0, (
+        f"dirty-view rerun retraced the register program: {before}->{after}")
+
+
+def test_quarantined_view_repairs_adjacency_ring(dataset, stream_run,
+                                                 tmp_path):
+    """Satellite: view k quarantined -> the (k-1)->(k+1) re-pair registers
+    in the catch-up, the chain closes, and the degraded merge is
+    byte-identical to a clean run over the surviving views."""
+    calib = os.path.join(dataset, "calib.mat")
+    victim = sorted(d for d in os.listdir(dataset)
+                    if os.path.isdir(os.path.join(dataset, d)))[2]
+
+    out_deg = str(tmp_path / "degraded")
+    faults.configure(f"compute.view~{victim}:permanent", seed=0)
+    logs = []
+    try:
+        rep = stages.run_pipeline(calib, dataset, out_deg,
+                                  cfg=_cfg(stream=True), steps=STEPS,
+                                  log=logs.append)
+    finally:
+        faults.reset()
+    assert rep.degraded and len(rep.failed) == 1
+    assert rep.merge_mode == "streamed"
+    assert any("re-pairing around quarantined" in m for m in logs)
+    assert any("pair 1->3" in m for m in logs), \
+        [m for m in logs if "pair" in m]
+
+    # clean run over the 4 surviving views (same content, so the copied
+    # view/pair caches hit — only the quarantined view's entries are gone)
+    ds4 = str(tmp_path / "ds4")
+    shutil.copytree(dataset, ds4)
+    shutil.rmtree(os.path.join(ds4, victim))
+    out_clean = str(tmp_path / "clean")
+    _copy_cache(out_deg, out_clean, stages_=("view", "pair"))
+    rep4 = stages.run_pipeline(calib, ds4, out_clean, cfg=_cfg(stream=True),
+                               steps=STEPS, log=lambda m: None)
+    assert rep4.failed == [] and not rep4.degraded
+    with open(rep.merged_ply, "rb") as fa, \
+            open(rep4.merged_ply, "rb") as fb:
+        assert fa.read() == fb.read(), "degraded merge != clean survivors"
+    with open(rep.stl_path, "rb") as fa, open(rep4.stl_path, "rb") as fb:
+        assert fa.read() == fb.read()
+
+
+def test_fault_in_pair_falls_back_to_identity(dataset, stream_run, tmp_path):
+    """Satellite: a permanently-failing pair registration retries, then
+    falls back to the identity transform — the run completes DEGRADED with
+    a structured register-lane FailureRecord, and the degraded merge is
+    NOT published to the merge cache (a rerun re-attempts the real
+    registration)."""
+    import json
+
+    out_s, _, _ = stream_run
+    out = str(tmp_path / "out")
+    _copy_cache(out_s, out)     # views hit; pairs recompute -> the site fires
+    faults.configure("register.pair~1->2:permanent", seed=0)
+    logs = []
+    try:
+        rep = stages.run_pipeline(os.path.join(dataset, "calib.mat"),
+                                  dataset, out, cfg=_cfg(stream=True),
+                                  steps=STEPS, log=logs.append)
+    finally:
+        faults.reset()
+    assert rep.degraded and rep.failed == []      # no view was lost
+    recs = [r for r in rep.failures if r.stage == "register"]
+    assert len(recs) == 1 and "pair_1_2" in recs[0].view
+    assert any("IDENTITY transform" in m for m in logs)
+    assert os.path.exists(rep.stl_path) and rep.merged_points > 0
+    with open(rep.manifest_path) as f:
+        man = json.load(f)
+    assert man["merge_mode"] == "streamed" and man["degraded"]
+    # the poisoned merge must not have been cached: a faultless rerun
+    # recomputes and repairs the seam
+    rep2 = stages.run_pipeline(os.path.join(dataset, "calib.mat"), dataset,
+                               out, cfg=_cfg(stream=True), steps=STEPS,
+                               log=lambda m: None)
+    assert not rep2.degraded and rep2.merge_status == "computed"
+
+
+def test_registrar_streams_ready_pairs_and_repairs_gaps(tmp_path,
+                                                        monkeypatch):
+    """Unit: pair-readiness rule + degraded adjacency remap. Views fed out
+    of order stream pairs only once every earlier view is accounted for
+    (chain ids final); a gap (quarantined view) defers to finish()'s
+    catch-up, which registers (k-1)->(k+1) with the surviving-chain id."""
+    from structured_light_for_3d_model_replication_tpu.models import (
+        reconstruction as recon,
+    )
+    from structured_light_for_3d_model_replication_tpu.pipeline.stagecache import (
+        StageCache,
+    )
+
+    calls = []
+
+    def fake_register(pairs, ids, cfg, voxel, mesh=None, feat_bf16=None,
+                      batch=None):
+        calls.append((list(ids), [(s, d) for s, d in pairs]))
+        n = len(pairs)
+        return (np.stack([np.eye(4, np.float32)] * n),
+                np.ones(n, np.float32), np.ones(n, np.float32),
+                np.zeros(n, np.float32))
+
+    monkeypatch.setattr(recon, "prep_view", lambda pts, voxel, sb: pts)
+    monkeypatch.setattr(recon, "register_prep_pairs", fake_register)
+
+    cfg = _cfg(stream=True, pair_batch=4)
+    cache = StageCache(str(tmp_path / "c"), enabled=False)
+    r = stages._StreamRegistrar(cfg, cache, prof.OverlapStats(), None,
+                                lambda m: None)
+    clouds = {i: (np.full((4, 3), i, np.float32),
+                  np.full((4, 3), i, np.uint8)) for i in (0, 1, 3, 4)}
+    # out-of-order feed; view 2 never arrives (quarantined)
+    for i in (1, 0, 4, 3):
+        r.feed(i, *clouds[i])
+    order = [0, 1, 3, 4]
+    T, gf, fi, ir = r.finish(order, clouds)
+    assert T.shape == (3, 4, 4) and len(gf) == 3
+    all_ids = [i for ids, _ in calls for i in ids]
+    all_pairs = [p for _, ps in calls for p in ps]
+    assert sorted(all_ids) == [0, 1, 2]          # surviving-chain positions
+    # pair 0: 1->0, pair 1: 3 re-paired onto 1 (the gap), pair 2: 4->3
+    assert [(int(s[0, 0]), int(d[0, 0])) for s, d in all_pairs] == \
+        [(1, 0), (3, 1), (4, 3)]
+
+
+def test_pair_group_bucket_ladder():
+    """Full groups run at pair_batch slots; ragged tails land on the next
+    power of two; sharded groups round up to the device count."""
+    from structured_light_for_3d_model_replication_tpu.models.reconstruction import (
+        _pair_group_bucket,
+    )
+
+    assert _pair_group_bucket(4, 4) == 4
+    assert _pair_group_bucket(9, 4) == 4        # >= batch: full group
+    assert _pair_group_bucket(3, 4) == 4
+    assert _pair_group_bucket(2, 4) == 2
+    assert _pair_group_bucket(1, 4) == 1
+    assert _pair_group_bucket(1, 8) == 1
+    assert _pair_group_bucket(3, 4, n_dev=8) == 8
+    assert _pair_group_bucket(2, 2, n_dev=8) == 8
+
+
+def test_cli_stream_flags_share_merge_cache(dataset, stream_run, tmp_path,
+                                            capsys):
+    """CLI plumbing: --no-stream runs the barrier arm, --stream the lane —
+    and because stream/pair_batch never enter key material, BOTH arms hit
+    the merge entry a streamed run published."""
+    out_s, _, _ = stream_run
+    out = str(tmp_path / "cli")
+    _copy_cache(out_s, out, stages_=("view", "pair", "merge", "mesh"))
+    common = ["--calib", os.path.join(dataset, "calib.mat"), "--out", out,
+              "--steps", "statistical",
+              "--set", f"decode.n_cols={PROJ[0]}",
+              "--set", f"decode.n_rows={PROJ[1]}",
+              "--set", "decode.thresh_mode=manual",
+              "--set", "merge.voxel_size=4.0",
+              "--set", "merge.ransac_trials=256",
+              "--set", "merge.icp_iters=6",
+              "--set", "mesh.depth=5",
+              "--set", "mesh.density_trim_quantile=0"]
+    assert cli_main(["pipeline", dataset, "--no-stream"] + common) == 0
+    out_txt = capsys.readouterr().out
+    assert "merge mode: barrier (cache-hit)" in out_txt
+    assert cli_main(["pipeline", dataset, "--stream",
+                     "--pair-batch", "3"] + common) == 0
+    out_txt = capsys.readouterr().out
+    assert "merge mode: streamed (cache-hit)" in out_txt
+
+
+def test_posegraph_method_logs_fallback_notice(dataset, stream_run,
+                                               tmp_path):
+    """Satellite: merge.method='posegraph' ignores streaming with a logged
+    one-line notice, and the report/manifest stamp merge_mode."""
+    out_s, _, _ = stream_run
+    out = str(tmp_path / "pg")
+    _copy_cache(out_s, out)                     # views hit; merge recomputes
+    cfg = _cfg(stream=True)
+    cfg.merge.method = "posegraph"
+    cfg.merge.ransac_trials = 64
+    cfg.merge.icp_iters = 3
+    logs = []
+    rep = stages.run_pipeline(os.path.join(dataset, "calib.mat"), dataset,
+                              out, cfg=cfg, steps=STEPS, log=logs.append)
+    assert rep.merge_mode == "posegraph"
+    assert any("posegraph" in m and "merge.stream is ignored" in m
+               for m in logs)
+    assert rep.merge_status == "computed" and rep.merged_points > 0
+    # no register lane ran
+    assert (rep.overlap or {}).get("pair_launches", 0) == 0

@@ -117,6 +117,10 @@ def test_second_run_hits_every_stage_cache(dataset, fused_out, monkeypatch):
     monkeypatch.setattr(stages, "_mesh_arrays", boom)
     monkeypatch.setattr(recon, "merge_360", boom)
     monkeypatch.setattr(recon, "merge_360_posegraph", boom)
+    # the streamed register lane must not even spin up on a full-hit rerun
+    monkeypatch.setattr(recon, "prep_view", boom)
+    monkeypatch.setattr(recon, "register_prep_pairs", boom)
+    monkeypatch.setattr(recon, "finalize_chain", boom)
 
     logs = []
     rep2 = stages.run_pipeline(os.path.join(dataset, "calib.mat"), dataset,
@@ -140,14 +144,19 @@ def test_interrupted_run_resumes_from_view_cache(dataset, tmp_path,
         reconstruction as recon,
     )
 
+    # the streamed default merges through finalize_chain; patch the barrier
+    # twin too so the simulated interrupt fires whichever arm runs
     real_merge = recon.merge_360
-    monkeypatch.setattr(recon, "merge_360",
-                        lambda *a, **k: (_ for _ in ()).throw(
-                            RuntimeError("simulated interrupt")))
+    real_chain = recon.finalize_chain
+    boom = lambda *a, **k: (_ for _ in ()).throw(  # noqa: E731
+        RuntimeError("simulated interrupt"))
+    monkeypatch.setattr(recon, "merge_360", boom)
+    monkeypatch.setattr(recon, "finalize_chain", boom)
     with pytest.raises(RuntimeError, match="simulated interrupt"):
         stages.run_pipeline(calib, dataset, out, cfg=_cfg(), steps=STEPS,
                             log=lambda m: None)
     monkeypatch.setattr(recon, "merge_360", real_merge)
+    monkeypatch.setattr(recon, "finalize_chain", real_chain)
 
     # views must NOT recompute on resume
     monkeypatch.setattr(stages, "_compute_cloud",

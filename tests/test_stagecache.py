@@ -32,6 +32,7 @@ def test_roundtrip_and_stats(tmp_path):
     out = c.get("view", key)
     np.testing.assert_array_equal(out["points"], _arrays()["points"])
     assert c.stats() == {"hits": 1, "misses": 1, "hit_stages": ["view"],
+                         "miss_stages": ["view"],
                          "evicted": 0, "put_errors": 0}
 
 

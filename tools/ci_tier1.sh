@@ -42,6 +42,21 @@ else
   [ $rc -eq 0 ] && rc=1
 fi
 
+# ---- streaming-merge smoke: the streamed register lane must produce
+# byte-identical merged PLY + STL vs the barrier arm (ISSUE 5) ----
+stream_rc=0
+stream=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python bench.py --stream-only --views=4 2>/dev/null) || stream_rc=$?
+echo "$stream" > tools/_ci/stream_smoke.json
+if [ $stream_rc -eq 0 ] \
+   && echo "$stream" | grep -q '"merged_identical": true' \
+   && echo "$stream" | grep -q '"stl_identical": true' \
+   && echo "$stream" | grep -q '"merge_mode_streamed": "streamed"'; then
+  echo "STREAM_SMOKE=ok"
+else
+  echo "STREAM_SMOKE=FAIL (rc=$stream_rc; see tools/_ci/stream_smoke.json)"
+  [ $rc -eq 0 ] && rc=1
+fi
+
 # ---- chaos smoke: seeded fault plan (1 transient + 1 permanent over 5
 # views) must retry, quarantine, and still ship the STL with exit 0 ----
 chaos_rc=0
