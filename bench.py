@@ -737,6 +737,113 @@ def bench_pipeline_faults(views: int = PIPE_VIEWS) -> dict:
     return out
 
 
+def bench_pipeline_trace(views: int = PIPE_VIEWS) -> dict:
+    """Flight-recorder cost on the fused pipeline (ISSUE 6 acceptance).
+
+    Arm A (``disabled_s``): telemetry wired through every lane/cache/fault
+    site but NO tracer active — each instrumentation point is a single
+    module-global None check. Must sit within run-to-run noise of the
+    ``pipeline_e2e`` fused arm (the <= 1.02x disabled-overhead contract;
+    the --pipeline-only record carries the ratio as ``overhead_vs_e2e``).
+
+    Arm B (``traced_s``): the same run with ``observability.trace`` on —
+    records the journal size, validates it against the schema, derives the
+    per-lane walls from the journal and cross-checks them against the
+    run's ``OverlapStats`` (<= 1% drift), and exports the Chrome trace."""
+    import shutil
+    import tempfile
+
+    from structured_light_for_3d_model_replication_tpu.config import Config
+    from structured_light_for_3d_model_replication_tpu.io import images as imio
+    from structured_light_for_3d_model_replication_tpu.io import matfile
+    from structured_light_for_3d_model_replication_tpu.pipeline import (
+        report as replib,
+    )
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        synthetic as syn,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils import telemetry
+
+    out: dict = {"views": views, "backend": "numpy",
+                 "host_cpus": os.cpu_count()}
+    tmp = tempfile.mkdtemp(prefix="slbench_trace_")
+    try:
+        rig = syn.default_rig(cam_size=PIPE_CAM, proj_size=PIPE_PROJ)
+        scene = syn.sphere_on_background()
+        obj, background = scene.objects
+        calib_path = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(calib_path, rig.calibration())
+        root = os.path.join(tmp, "scans")
+        os.makedirs(root)
+        step = 360.0 / views
+        pivot = np.array([0.0, 0.0, 420.0])
+        for i, (R, t) in enumerate(syn.turntable_poses(views, step, pivot)):
+            frames, _ = syn.render_scene(
+                rig, syn.Scene([obj.transformed(R, t), background]))
+            imio.save_stack(
+                os.path.join(root, f"scan_{int(round(i * step)):03d}deg_scan"),
+                frames)
+
+        def cfg(trace: bool):
+            c = Config()
+            c.parallel.backend = "numpy"
+            c.decode.n_cols, c.decode.n_rows = PIPE_PROJ
+            c.decode.thresh_mode = "manual"
+            c.merge.voxel_size = 4.0
+            c.merge.ransac_trials = 512
+            c.merge.icp_iters = 10
+            c.mesh.depth = 5
+            c.mesh.density_trim_quantile = 0.0
+            c.observability.trace = trace
+            return c
+
+        steps = ("statistical",)
+        # ---- arm A: telemetry wired, tracer off (the default) ----
+        t0 = time.perf_counter()
+        rep = stages.run_pipeline(calib_path, root,
+                                  os.path.join(tmp, "off"), cfg=cfg(False),
+                                  steps=steps, log=lambda m: None)
+        out["disabled_s"] = round(time.perf_counter() - t0, 4)
+        assert not rep.failed, rep.failed
+        assert not os.path.exists(os.path.join(tmp, "off", "trace.jsonl"))
+
+        # ---- arm B: flight recorder on ----
+        tdir = os.path.join(tmp, "on")
+        t0 = time.perf_counter()
+        rep2 = stages.run_pipeline(calib_path, root, tdir, cfg=cfg(True),
+                                   steps=steps, log=lambda m: None)
+        out["traced_s"] = round(time.perf_counter() - t0, 4)
+        out["run_id"] = rep2.run_id
+        journal = os.path.join(tdir, "trace.jsonl")
+        errors = replib.validate_journal(journal)
+        out["journal_valid"] = not errors
+        if errors:
+            out["journal_errors"] = errors[:5]
+        a = replib.analyze_run(tdir)
+        out["journal_events"] = a.events
+        out["lanes"] = sorted(a.lane_walls)
+        # cross-check: journal-derived lane walls vs the executor's own
+        # OverlapStats, within 1% (they come from the same calls)
+        drift = 0.0
+        for lane, wall in a.lane_walls.items():
+            stat = rep2.overlap.get(f"{lane}_s") if rep2.overlap else None
+            if stat:
+                drift = max(drift, abs(wall - stat) / stat)
+        out["lane_wall_max_drift"] = round(drift, 5)
+        out["lane_walls_match"] = drift <= 0.01
+        chrome = telemetry.export_chrome_trace(
+            journal, os.path.join(tdir, "trace.json"))
+        out["chrome_lanes"] = chrome["lanes"]
+        out["metrics_json"] = os.path.exists(
+            os.path.join(tdir, "metrics.json"))
+        out["trace_overhead_s"] = round(out["traced_s"] - out["disabled_s"],
+                                        4)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # child: all jax work, per-phase persisted results
 # ---------------------------------------------------------------------------
@@ -1168,6 +1275,14 @@ def _wait_for_accelerator(preflight, window: float, gap: float):
 
 
 def emit(final: dict) -> None:
+    # every bench line carries a run_id (ISSUE-6: joinable against flight-
+    # recorder journals and reports without log archaeology)
+    if "run_id" not in final:
+        from structured_light_for_3d_model_replication_tpu.utils import (
+            telemetry,
+        )
+
+        final["run_id"] = telemetry.new_run_id()
     # every emitted line carries the execution regime (ISSUE-4 satellite):
     # host_cpus always; device_count only when this process ALREADY holds an
     # initialized jax backend — the numpy-backend parent must never claim an
@@ -1290,6 +1405,21 @@ def main() -> None:
                 "error": f"{type(e).__name__}: {e}"[:200]}
             log(f"pipeline faults arm FAILED "
                 f"({final['pipeline_faults']['error']})")
+
+        # flight-recorder overhead + journal validity (host-only)
+        try:
+            log("pipeline trace arm (disabled overhead + traced run)...")
+            final["pipeline_trace"] = bench_pipeline_trace()
+            pt = final["pipeline_trace"]
+            log(f"pipeline_trace: disabled {pt['disabled_s']}s vs traced "
+                f"{pt['traced_s']}s ({pt['journal_events']} events, "
+                f"journal_valid={pt['journal_valid']}, lane walls match="
+                f"{pt['lane_walls_match']})")
+        except Exception as e:
+            final["pipeline_trace"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+            log(f"pipeline trace arm FAILED "
+                f"({final['pipeline_trace']['error']})")
 
         # one TPU client at a time, repo-wide: if a validation session (or
         # any other tool) holds the claim lock, QUEUE behind it — racing it
@@ -1440,6 +1570,7 @@ if __name__ == "__main__":
             line["pipeline_e2e"] = bench_pipeline_e2e()
             line["merge_stream"] = bench_merge_stream()
             line["pipeline_faults"] = bench_pipeline_faults()
+            line["pipeline_trace"] = bench_pipeline_trace()
             fused = line["pipeline_e2e"].get("fused_s")
             disabled = line["pipeline_faults"].get("disabled_s")
             if fused and disabled:
@@ -1447,6 +1578,12 @@ if __name__ == "__main__":
                 # can eyeball against run-to-run noise
                 line["pipeline_faults"]["overhead_vs_e2e"] = round(
                     disabled / fused, 3)
+            trace_off = line["pipeline_trace"].get("disabled_s")
+            if fused and trace_off:
+                # the flight recorder's twin of the same contract (<=1.02x
+                # disabled overhead; CI's TRACE_SMOKE asserts it)
+                line["pipeline_trace"]["overhead_vs_e2e"] = round(
+                    trace_off / fused, 3)
         except Exception as e:
             line["error"] = f"{type(e).__name__}: {e}"[:200]
         emit(line)

@@ -263,6 +263,29 @@ class PipelineConfig:
     verify_cache: bool = True
 
 
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes",
+                                                        "on")
+
+
+@dataclass
+class ObservabilityConfig:
+    """Run-scoped flight recorder (utils/telemetry.py). Off by default —
+    the disabled path is one module-global None check per instrumentation
+    point (benched <= 1.02x vs pipeline_e2e, the fault layer's contract).
+    When on, ``sl3d pipeline`` writes an append-only crash-safe
+    ``trace.jsonl`` event journal plus a ``metrics.json`` registry snapshot
+    into the run's out dir; ``sl3d report <out>`` renders them and
+    ``--chrome-trace`` exports a Perfetto-loadable timeline."""
+
+    # arm the tracer for pipeline runs; env override SL3D_TRACE=1 (the
+    # config-free switch, like SL3D_FAULTS)
+    trace: bool = field(default_factory=lambda: _env_flag("SL3D_TRACE"))
+    # journal / metrics filenames inside the run's out dir
+    trace_file: str = "trace.jsonl"
+    metrics_file: str = "metrics.json"
+
+
 @dataclass
 class FaultsConfig:
     """Deterministic fault injection (utils/faults.py). Disabled by default
@@ -290,6 +313,8 @@ class Config:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig)
     scan_root: str = ""  # dated scan folder; empty = ./scans/<date>
 
     def to_dict(self) -> dict[str, Any]:

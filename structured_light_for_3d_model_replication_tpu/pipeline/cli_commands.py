@@ -23,6 +23,10 @@ implementation.
   pipeline      (new)    fused scan-to-print: reconstruct -> clean -> merge ->
       (alias: print)     mesh in one process with device-resident handoff and
                          a content-addressed stage cache (resume on rerun)
+  report        (new)    render a traced run's flight-recorder artifacts
+                         (lane timeline, stage walls, cache ratios, fault
+                         ledger) from <out>/trace.jsonl + metrics.json;
+                         --chrome-trace exports a Perfetto-loadable timeline
 """
 from __future__ import annotations
 
@@ -170,6 +174,34 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("--pair-batch", type=int, default=None,
                    help="ready pairs per register-lane launch "
                         "(default: merge.pair_batch)")
+    p.add_argument("--trace", action="store_true",
+                   help="arm the flight recorder (observability.trace; env "
+                        "SL3D_TRACE=1): write an append-only crash-safe "
+                        "trace.jsonl event journal + metrics.json into "
+                        "<out>; inspect with 'sl3d report <out>'")
+    add_config_args(p)
+
+    p = sub.add_parser(
+        "report",
+        help="render a traced pipeline run's flight-recorder artifacts: "
+             "lane timeline, per-stage walls, cache hit ratios, launch/"
+             "bucket table, fault ledger — works on clean, degraded, and "
+             "interrupted runs")
+    p.add_argument("out_dir", help="a pipeline out dir containing "
+                                   "trace.jsonl (run with --trace)")
+    p.add_argument("--width", type=int, default=60,
+                   help="timeline width in columns")
+    p.add_argument("--chrome-trace", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="also export a Chrome/Perfetto trace-event JSON "
+                        "(default: <out_dir>/trace.json); load it at "
+                        "ui.perfetto.dev to SEE the lane overlap")
+    p.add_argument("--prometheus", action="store_true",
+                   help="print metrics.json as Prometheus exposition text "
+                        "instead of the human report")
+    p.add_argument("--validate", action="store_true",
+                   help="schema-validate the journal and exit non-zero on "
+                        "any problem (the CI TRACE_SMOKE check)")
     add_config_args(p)
 
     p = sub.add_parser("merge-360",
@@ -417,6 +449,8 @@ def _cmd_pipeline(args) -> int:
         cfg.merge.stream = args.stream
     if args.pair_batch is not None:
         cfg.merge.pair_batch = args.pair_batch
+    if args.trace:
+        cfg.observability.trace = True
     steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
     report = stages.run_pipeline(args.calib, args.target, args.out, cfg=cfg,
                                  steps=steps, stl_name=args.stl_name)
@@ -445,6 +479,59 @@ def _cmd_pipeline(args) -> int:
         print(f"[pipeline] WARNING: completed DEGRADED — "
               f"{len(report.failed)} view(s) quarantined; see "
               f"{report.manifest_path}", file=sys.stderr)
+    return 0
+
+
+@_runner("report")
+def _cmd_report(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.pipeline import (
+        report as replib,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        telemetry,
+    )
+
+    cfg = _cfg(args)
+    trace_file = cfg.observability.trace_file
+    journal = os.path.join(args.out_dir, trace_file)
+    if not os.path.exists(journal):
+        print(f"[report] no {trace_file} under {args.out_dir} — run the "
+              f"pipeline with --trace (or SL3D_TRACE=1) first",
+              file=sys.stderr)
+        return 1
+
+    if args.validate:
+        errors = replib.validate_journal(journal)
+        for e in errors:
+            print(f"[report] INVALID: {e}", file=sys.stderr)
+        print(f"[report] journal {'INVALID' if errors else 'valid'}: "
+              f"{journal}")
+        if errors:
+            return 1
+
+    if args.prometheus:
+        mpath = os.path.join(args.out_dir, cfg.observability.metrics_file)
+        if not os.path.exists(mpath):
+            print(f"[report] no {cfg.observability.metrics_file} under "
+                  f"{args.out_dir} (interrupted run?)", file=sys.stderr)
+            return 1
+        with open(mpath, encoding="utf-8") as f:
+            print(telemetry.prometheus_text(json.load(f)), end="")
+        return 0
+
+    analysis = replib.analyze_run(
+        args.out_dir, trace_file=trace_file,
+        metrics_file=cfg.observability.metrics_file)
+    if not args.validate:
+        print(replib.render_report(analysis, width=args.width))
+
+    if args.chrome_trace is not None:
+        out_path = args.chrome_trace or os.path.join(args.out_dir,
+                                                     "trace.json")
+        info = telemetry.export_chrome_trace(journal, out_path)
+        print(f"[report] chrome trace -> {out_path} ({info['events']} "
+              f"events, {info['lanes']} lane(s) on {info['tracks']} "
+              f"track(s)); open at ui.perfetto.dev or chrome://tracing")
     return 0
 
 

@@ -1,0 +1,348 @@
+"""``sl3d report``: render a run's flight-recorder artifacts.
+
+Reads the journal (``trace.jsonl``), the metrics snapshot (``metrics.json``),
+and the failure manifest (``failures.json``) from a pipeline out dir and
+renders, on a terminal:
+
+  - the lane timeline — per-lane busy intervals over the run wall, so the
+    overlap the executor claims is *visible* (a register bar nested inside
+    the compute bar IS the streaming merge working)
+  - per-stage walls (cache.keys / reconstruct / merge / mesh / writes)
+  - per-lane walls + span counts, derived purely from journal events (the
+    cross-check twin of ``OverlapStats`` — same instrumentation calls, so
+    the report reproduces the executor's numbers from artifacts alone)
+  - cache hit/miss/evict ratios per stage
+  - the launch/bucket table (views per launch, pair launches)
+  - the fault ledger: retries, failures, injected faults, quarantined
+    views — merged from journal events and failures.json
+
+Degraded and interrupted runs are first-class: a journal with no ``end``
+marker (crash/kill) reports as INTERRUPTED, torn trailing lines are
+tolerated (counted, never fatal), and a missing metrics.json (written at
+close) downgrades to journal-only analysis.
+
+``--chrome-trace`` exports the Perfetto-loadable ``trace.json`` via
+:func:`~.utils.telemetry.export_chrome_trace`; ``--prometheus`` re-emits
+``metrics.json`` as Prometheus exposition text (the serving-process format).
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from structured_light_for_3d_model_replication_tpu.utils import telemetry
+
+__all__ = ["RunAnalysis", "analyze_run", "render_report", "validate_journal"]
+
+_LANES = telemetry.LANE_ORDER
+
+
+# ---------------------------------------------------------------------------
+# journal validation (the TRACE_SMOKE contract)
+# ---------------------------------------------------------------------------
+
+_REQUIRED = {
+    "meta": ("schema", "run_id", "t0_unix"),
+    "span": ("ev", "t", "dur"),
+    "instant": ("ev", "t"),
+    "end": ("t",),
+}
+
+
+def validate_journal(path: str) -> list[str]:
+    """Schema-check a journal; returns a list of human-readable problems
+    (empty == valid). A missing ``end`` marker is NOT an error — that is
+    what an interrupted run looks like — but a missing/late meta line, an
+    unknown event type, or a span without a duration is."""
+    errors: list[str] = []
+    j = telemetry.read_journal(path)
+    for s, seg in enumerate(j["segments"]):
+        meta = seg["meta"]
+        if meta is None:
+            errors.append(f"segment {s}: no meta header line")
+        else:
+            for k in _REQUIRED["meta"]:
+                if k not in meta:
+                    errors.append(f"segment {s}: meta line missing {k!r}")
+            if meta.get("schema") not in (telemetry.SCHEMA,):
+                errors.append(f"segment {s}: unknown schema "
+                              f"{meta.get('schema')!r} "
+                              f"(expected {telemetry.SCHEMA})")
+        for i, ev in enumerate(seg["events"]):
+            kind = ev.get("type")
+            if kind not in _REQUIRED:
+                errors.append(f"segment {s} event {i}: unknown type {kind!r}")
+                continue
+            for k in _REQUIRED[kind]:
+                if k not in ev:
+                    errors.append(f"segment {s} event {i} "
+                                  f"({kind}/{ev.get('ev')}): missing {k!r}")
+            if kind == "span" and ev.get("ev") == "lane" and "lane" not in ev:
+                errors.append(f"segment {s} event {i}: lane span without "
+                              f"a lane")
+            t = ev.get("t")
+            if isinstance(t, (int, float)) and t < -1e-6:
+                errors.append(f"segment {s} event {i}: negative "
+                              f"timestamp {t}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# analysis
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RunAnalysis:
+    out_dir: str
+    run_id: str | None = None
+    meta: dict = field(default_factory=dict)
+    wall_s: float = 0.0
+    ended: bool = False            # end marker present (clean close)
+    runs_in_journal: int = 1       # appended segments (reruns keep history)
+    truncated_lines: int = 0
+    events: int = 0
+    lane_walls: dict[str, float] = field(default_factory=dict)
+    lane_spans: dict[str, int] = field(default_factory=dict)
+    lane_intervals: dict[str, list[tuple[float, float]]] = \
+        field(default_factory=dict)
+    stage_walls: dict[str, float] = field(default_factory=dict)
+    cache: dict[str, dict[str, int]] = field(default_factory=dict)
+    launches: list[dict] = field(default_factory=list)
+    pair_launches: list[dict] = field(default_factory=list)
+    retries: dict[str, int] = field(default_factory=dict)
+    failures: dict[str, int] = field(default_factory=dict)
+    injected: dict[str, int] = field(default_factory=dict)
+    quarantined: list[dict] = field(default_factory=list)
+    critical_path_s: float | None = None
+    manifest: dict | None = None   # failures.json payload
+    metrics: dict | None = None    # metrics.json payload
+
+
+def _merge_intervals(iv: list[tuple[float, float]]) -> list[tuple[float, float]]:
+    out: list[tuple[float, float]] = []
+    for a, b in sorted(iv):
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def analyze_run(out_dir: str, trace_file: str = "trace.jsonl",
+                metrics_file: str = "metrics.json") -> RunAnalysis:
+    """Build a :class:`RunAnalysis` from whatever artifacts the out dir
+    holds. Requires the journal; metrics.json and failures.json are
+    optional (interrupted runs have no metrics, clean runs no manifest)."""
+    path = os.path.join(out_dir, trace_file)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no {trace_file} under {out_dir!r} — run the pipeline with "
+            f"observability.trace=true (--trace / SL3D_TRACE=1) to record "
+            f"one")
+    j = telemetry.read_journal(path)
+    # meta/events are the journal's LATEST segment: reruns append a fresh
+    # run header, so analysis is always run-scoped while history survives
+    a = RunAnalysis(out_dir=out_dir, meta=j["meta"] or {},
+                    runs_in_journal=j["runs"],
+                    truncated_lines=j["truncated"],
+                    events=len(j["events"]))
+    a.run_id = a.meta.get("run_id")
+    t_max = 0.0
+    for ev in j["events"]:
+        t = float(ev.get("t", 0.0))
+        dur = float(ev.get("dur", 0.0) or 0.0)
+        t_max = max(t_max, t + max(dur, 0.0))
+        kind = ev.get("type")
+        name = ev.get("ev")
+        if kind == "end":
+            a.ended = True
+        elif kind == "span" and name == "lane":
+            lane = ev.get("lane", "?")
+            a.lane_walls[lane] = a.lane_walls.get(lane, 0.0) + dur
+            a.lane_spans[lane] = a.lane_spans.get(lane, 0) + 1
+            a.lane_intervals.setdefault(lane, []).append((t, t + dur))
+        elif kind == "span" and name == "stage":
+            st = ev.get("stage", "?")
+            a.stage_walls[st] = a.stage_walls.get(st, 0.0) + dur
+        elif kind == "instant":
+            if name and name.startswith("cache."):
+                st = ev.get("stage", "?")
+                a.cache.setdefault(st, {})
+                k = name[6:]
+                a.cache[st][k] = a.cache[st].get(k, 0) + 1
+            elif name == "launch":
+                a.launches.append(ev)
+            elif name == "pair_launch":
+                a.pair_launches.append(ev)
+            elif name == "lane.retry":
+                ln = ev.get("lane", "?")
+                a.retries[ln] = a.retries.get(ln, 0) + 1
+            elif name == "lane.failure":
+                ln = ev.get("lane", "?")
+                a.failures[ln] = a.failures.get(ln, 0) + 1
+            elif name == "fault.injected":
+                site = f"{ev.get('site', '?')}:{ev.get('kind', '?')}"
+                a.injected[site] = a.injected.get(site, 0) + 1
+            elif name == "quarantine":
+                a.quarantined.append(ev)
+            elif name == "executor.finish":
+                a.critical_path_s = ev.get("critical_path_s")
+    a.wall_s = t_max
+    for lane in a.lane_intervals:
+        a.lane_intervals[lane] = _merge_intervals(a.lane_intervals[lane])
+    mpath = os.path.join(out_dir, metrics_file)
+    if os.path.exists(mpath):
+        try:
+            with open(mpath, encoding="utf-8") as f:
+                a.metrics = json.load(f)
+        except (OSError, ValueError):
+            a.metrics = None
+    fpath = os.path.join(out_dir, "failures.json")
+    if os.path.exists(fpath):
+        try:
+            with open(fpath, encoding="utf-8") as f:
+                a.manifest = json.load(f)
+        except (OSError, ValueError):
+            a.manifest = None
+    return a
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _bar(intervals: list[tuple[float, float]], wall: float,
+         width: int) -> str:
+    cells = [" "] * width
+    if wall <= 0:
+        return "".join(cells)
+    for t0, t1 in intervals:
+        i0 = max(0, min(width - 1, int(t0 / wall * width)))
+        i1 = max(i0, min(width - 1, int(t1 / wall * width)))
+        for i in range(i0, i1 + 1):
+            cells[i] = "#"
+    return "".join(cells)
+
+
+def _lane_sort_key(lane: str):
+    return (_LANES.index(lane) if lane in _LANES else len(_LANES), lane)
+
+
+def render_report(a: RunAnalysis, width: int = 60) -> str:
+    """The terminal report. Pure function of the analysis — testable, and
+    the CLI just prints it."""
+    L: list[str] = []
+    m = a.meta
+    status = "clean close" if a.ended else "INTERRUPTED (no end marker)"
+    degraded = bool(a.manifest and a.manifest.get("degraded"))
+    if degraded:
+        status += ", DEGRADED"
+    L.append(f"flight recorder report — run {a.run_id or '?'}")
+    L.append(f"  out dir  : {a.out_dir}")
+    L.append(f"  status   : {status}")
+    L.append(f"  events   : {a.events} "
+             f"({a.truncated_lines} torn line(s) tolerated)"
+             + (f"; journal holds {a.runs_in_journal} run(s), showing "
+                f"the latest" if a.runs_in_journal > 1 else ""))
+    regime = (f"{m.get('host_cpus', '?')} host cpu(s), "
+              f"{m.get('device_count') if m.get('device_count') is not None else '?'} device(s), "
+              f"backend {m.get('backend', '?')}")
+    L.append(f"  regime   : {regime}")
+    L.append(f"  wall     : {a.wall_s:.2f}s"
+             + (f" (critical path {a.critical_path_s:.2f}s)"
+                if a.critical_path_s is not None else ""))
+
+    lanes = sorted(a.lane_walls, key=_lane_sort_key)
+    if lanes:
+        L.append("")
+        L.append(f"lane timeline (each column ~{a.wall_s / max(width, 1):.3f}s)")
+        for lane in lanes:
+            bar = _bar(a.lane_intervals.get(lane, []), a.wall_s, width)
+            L.append(f"  {lane:<9}|{bar}| {a.lane_walls[lane]:8.2f}s "
+                     f"{a.lane_spans.get(lane, 0):4d} span(s)")
+        busy = sum(a.lane_walls.values())
+        if a.wall_s > 0:
+            L.append(f"  serial-equivalent {busy:.2f}s in {a.wall_s:.2f}s "
+                     f"wall (overlap x{busy / a.wall_s:.2f})")
+
+    if a.stage_walls:
+        L.append("")
+        L.append("stage walls")
+        for st, w in sorted(a.stage_walls.items(), key=lambda kv: -kv[1]):
+            L.append(f"  {st:<14} {w:8.2f}s")
+
+    if a.cache:
+        L.append("")
+        L.append("stage cache")
+        for st in sorted(a.cache):
+            c = a.cache[st]
+            hits, misses = c.get("hit", 0), c.get("miss", 0)
+            total = hits + misses
+            ratio = f"{hits / total * 100:.0f}%" if total else "-"
+            extra = "".join(
+                f", {k} {v}" for k, v in sorted(c.items())
+                if k not in ("hit", "miss"))
+            L.append(f"  {st:<6} {hits} hit / {misses} miss ({ratio} hit "
+                     f"ratio{extra})")
+
+    if a.launches or a.pair_launches:
+        L.append("")
+        L.append("device launches")
+        if a.launches:
+            views = sum(e.get("views", 0) for e in a.launches)
+            buckets: dict[int, int] = {}
+            for e in a.launches:
+                b = e.get("bucket", 0)
+                buckets[b] = buckets.get(b, 0) + 1
+            L.append(f"  view batches : {views} view(s) in "
+                     f"{len(a.launches)} launch(es), mean "
+                     f"{views / len(a.launches):.1f}/launch")
+            for b in sorted(buckets):
+                first = next((e.get("dispatch_s") for e in a.launches
+                              if e.get("bucket") == b), None)
+                L.append(f"    bucket {b:<4} x{buckets[b]} "
+                         f"(first dispatch {first}s)")
+        if a.pair_launches:
+            pairs = sum(e.get("pairs", 0) for e in a.pair_launches)
+            L.append(f"  pair batches : {pairs} pair(s) in "
+                     f"{len(a.pair_launches)} register launch(es), mean "
+                     f"{pairs / len(a.pair_launches):.1f}/launch")
+
+    if (a.retries or a.failures or a.injected or a.quarantined
+            or (a.manifest and a.manifest.get("failures"))):
+        L.append("")
+        L.append("fault ledger")
+        if a.injected:
+            for site, n in sorted(a.injected.items()):
+                L.append(f"  injected   {site}: x{n}")
+        if a.retries:
+            for ln, n in sorted(a.retries.items()):
+                L.append(f"  retries    {ln}: x{n}")
+        if a.failures:
+            for ln, n in sorted(a.failures.items()):
+                L.append(f"  failures   {ln}: x{n}")
+        for q in a.quarantined:
+            L.append(f"  quarantined view {q.get('view')} "
+                     f"({q.get('stage')}: {q.get('error')})")
+        if a.manifest:
+            for rec in a.manifest.get("failures", []):
+                L.append(f"  manifest   {rec.get('stage')}/{rec.get('view')}"
+                         f": {rec.get('error_type')} after "
+                         f"{rec.get('attempts')} attempt(s) "
+                         f"({'transient' if rec.get('transient') else 'permanent'})")
+            L.append(f"  manifest verdict: degraded="
+                     f"{a.manifest.get('degraded')} aborted="
+                     f"{a.manifest.get('aborted')} "
+                     f"({a.manifest.get('views_survived')}/"
+                     f"{a.manifest.get('views_total')} views survived)")
+    else:
+        L.append("")
+        L.append("fault ledger: clean (no retries, failures, or injections)")
+
+    if a.metrics is None:
+        L.append("")
+        L.append("metrics.json: absent (interrupted before close, or "
+                 "observability.metrics_file renamed) — journal-only "
+                 "analysis above")
+    return "\n".join(L)

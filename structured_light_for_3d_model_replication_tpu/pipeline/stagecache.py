@@ -51,6 +51,7 @@ import numpy as np
 
 from structured_light_for_3d_model_replication_tpu.io.atomic import sweep_tmp
 from structured_light_for_3d_model_replication_tpu.utils import faults
+from structured_light_for_3d_model_replication_tpu.utils import telemetry
 
 __all__ = ["StageCache", "config_subtree"]
 
@@ -156,6 +157,18 @@ class StageCache:
     def _path(self, stage: str, key: str) -> str:
         return os.path.join(self.root, f"{stage}-{key[:16]}.npz")
 
+    def _miss(self, stage: str) -> None:
+        self.misses.append(stage)
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant("cache.miss", stage=stage)
+
+    def _hit(self, stage: str) -> None:
+        self.hits.append(stage)
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant("cache.hit", stage=stage)
+
     def _evict(self, path: str, stage: str, why: str) -> None:
         """Remove a bad entry so it cannot poison a later read."""
         try:
@@ -163,6 +176,9 @@ class StageCache:
         except OSError:
             pass
         self.evicted.append(stage)
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant("cache.evict", stage=stage, why=why)
         self._log(f"[cache] {stage}: evicted {os.path.basename(path)} "
                   f"({why}); recomputing")
 
@@ -171,7 +187,7 @@ class StageCache:
         unreadable, or digest-mismatched — the last two also evict the
         entry). Hits are logged — the resume trail the operator reads."""
         if not self.enabled:
-            self.misses.append(stage)
+            self._miss(stage)
             return None
         path = self._path(stage, key)
         try:
@@ -182,15 +198,15 @@ class StageCache:
             # an injected lookup failure behaves like the corrupt-entry
             # path: evict whatever is there and read as a miss
             self._evict(path, stage, "injected lookup fault")
-            self.misses.append(stage)
+            self._miss(stage)
             return None
         if not os.path.exists(path):
-            self.misses.append(stage)
+            self._miss(stage)
             return None
         try:
             with np.load(path, allow_pickle=False) as z:
                 if "__key__" not in z.files or str(z["__key__"]) != key:
-                    self.misses.append(stage)  # 16-hex-prefix collision
+                    self._miss(stage)  # 16-hex-prefix collision
                     return None
                 out = {k: z[k] for k in z.files
                        if k not in ("__key__", "__digest__")}
@@ -200,7 +216,7 @@ class StageCache:
             raise
         except Exception as e:  # half-written/corrupt entry == miss
             self._evict(path, stage, f"unreadable: {e}")
-            self.misses.append(stage)
+            self._miss(stage)
             return None
         if self.verify:
             # recorded=None is a pre-digest entry (older schema bump
@@ -208,9 +224,9 @@ class StageCache:
             if recorded is None or self.digest_arrays(**out) != recorded:
                 self._evict(path, stage, "payload digest mismatch "
                             "(bit rot or torn write)")
-                self.misses.append(stage)
+                self._miss(stage)
                 return None
-        self.hits.append(stage)
+        self._hit(stage)
         self._log(f"[cache] {stage}: hit ({os.path.basename(path)})")
         return out
 
@@ -235,6 +251,10 @@ class StageCache:
             raise
         except Exception as e:
             self.put_errors.append(stage)
+            tr = telemetry.current()
+            if tr is not None:
+                tr.instant("cache.put_error", stage=stage,
+                           error=type(e).__name__)
             self._log(f"[cache] {stage}: put failed ({e}); continuing "
                       f"uncached")
         finally:

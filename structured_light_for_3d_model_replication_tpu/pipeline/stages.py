@@ -29,6 +29,7 @@ from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
 from structured_light_for_3d_model_replication_tpu.ops import triangulate as tri
 from structured_light_for_3d_model_replication_tpu.utils import faults
 from structured_light_for_3d_model_replication_tpu.utils import profiling as prof
+from structured_light_for_3d_model_replication_tpu.utils import telemetry as tel
 
 __all__ = [
     "BatchReport", "PipelineReport", "reconstruct_source", "reconstruct",
@@ -66,6 +67,10 @@ class BatchReport:
     # backend, which must not initialize a jax backend)
     host_cpus: int | None = None
     device_count: int | None = None
+    # flight-recorder correlation id: the same run_id stamps this report,
+    # the trace.jsonl/metrics.json artifacts, failures.json, and bench
+    # lines, so any record can be joined back to its journal
+    run_id: str | None = None
 
     @property
     def summary(self) -> str:
@@ -228,6 +233,11 @@ def _record_failure(report: BatchReport, src, name: str, exc: BaseException,
         f"{rec.attempts}): {exc}")
     report.failed.append((src, str(exc)))
     report.failures.append(rec)
+    tr = tel.current()
+    if tr is not None:
+        tr.instant("failure.record", view=name, stage=rec.stage,
+                   error=rec.error_type, attempts=rec.attempts,
+                   transient=rec.transient)
     if stats is not None:
         stats.add_failure(rec.stage if rec.stage in prof.OverlapStats._STAGES
                           else default_stage)
@@ -366,7 +376,8 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
     drain_pool = ThreadPoolExecutor(max_workers=1,
                                     thread_name_prefix="sl3d-drain")
     wbq = ply.WritebackQueue(
-        on_write=lambda _path, dt: stats.add("write", dt),
+        on_write=lambda path, dt: stats.add("write", dt,
+                                            view=os.path.basename(path)),
         retry=policy,
         on_retry=lambda _path, n, e: lane_retry("write")(n, e))
 
@@ -374,7 +385,7 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
         t0 = time.perf_counter()
         out = _retry_stage("load", lambda: _load_fired(src, cfg), policy,
                            lane_retry("load"))
-        stats.add("load", time.perf_counter() - t0)
+        stats.add("load", time.perf_counter() - t0, view=_item_name(src))
         return out
 
     def drain_one(idx, src, cloud, out_path):
@@ -382,11 +393,13 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
         # np.asarray blocks until the view's program retires
         t0 = time.perf_counter()
         pts, cols = tri.compact_cloud(cloud)
-        stats.add("compute", time.perf_counter() - t0, items=1)
+        stats.add("compute", time.perf_counter() - t0, items=1,
+                  view=_item_name(src))
         if clean_steps is not None:
             t0 = time.perf_counter()
             pts, cols, _ = _clean_arrays(pts, cols, cfg, clean_steps)
-            stats.add("clean", time.perf_counter() - t0)
+            stats.add("clean", time.perf_counter() - t0,
+                      view=_item_name(src))
         wfut = wbq.submit(out_path, pts, cols) if write_plys else None
         if collect is not None:
             collect(idx, src, pts, cols)
@@ -429,7 +442,8 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
                                                scanner, src,
                                                async_dispatch=True),
                         policy, lane_retry("compute"))
-                    stats.add("compute", time.perf_counter() - t0)
+                    stats.add("compute", time.perf_counter() - t0,
+                              view=_item_name(src))
                 except Exception as e:
                     if is_backend_init_error(e):
                         raise
@@ -578,7 +592,8 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
     drain_pool = ThreadPoolExecutor(max_workers=1,
                                     thread_name_prefix="sl3d-drain")
     wbq = ply.WritebackQueue(
-        on_write=lambda _path, dt: stats.add("write", dt),
+        on_write=lambda path, dt: stats.add("write", dt,
+                                            view=os.path.basename(path)),
         retry=policy,
         on_retry=lambda _path, n, e: lane_retry("write")(n, e))
 
@@ -586,7 +601,7 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
         t0 = time.perf_counter()
         out = _retry_stage("load", lambda: _load_fired(src, cfg), policy,
                            lane_retry("load"))
-        stats.add("load", time.perf_counter() - t0)
+        stats.add("load", time.perf_counter() - t0, view=_item_name(src))
         return out
 
     def finish_view(idx, src, pts, cols):
@@ -595,7 +610,8 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
         if clean_steps is not None:
             t0 = time.perf_counter()
             pts, cols, _ = _clean_arrays(pts, cols, cfg, clean_steps)
-            stats.add("clean", time.perf_counter() - t0)
+            stats.add("clean", time.perf_counter() - t0,
+                      view=_item_name(src))
         out_path = (_out_path_for(src, mode, output) if write_plys
                     else _item_name(src))
         wfut = wbq.submit(out_path, pts, cols) if write_plys else None
@@ -615,7 +631,8 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
                                        src),
                 policy, lane_retry("compute"))
             pts, cols = tri.compact_cloud(cloud)
-            stats.add("compute", time.perf_counter() - t0, items=1)
+            stats.add("compute", time.perf_counter() - t0, items=1,
+                      view=_item_name(src))
             return finish_view(idx, src, pts, cols)
         except Exception as e:
             if is_backend_init_error(e):
@@ -865,6 +882,10 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
     scanner = _build_scanner(sources, calib, cfg)
 
     report = BatchReport()
+    # one id per run: reuse an enclosing flight recorder's (the fused
+    # pipeline threads its id through) or mint a fresh one
+    _tr = tel.current()
+    report.run_id = _tr.run_id if _tr is not None else tel.new_run_id()
     report.host_cpus = os.cpu_count()
     if scanner is not None:
         # the scanner's construction already initialized the jax backend;
@@ -1171,6 +1192,9 @@ def mesh_cloud(input_ply: str, output_path: str, cfg: Config | None = None,
 class PipelineReport:
     """Accounting for one fused scan-to-print run."""
 
+    # flight-recorder correlation id: stamps this report, failures.json,
+    # trace.jsonl/metrics.json, and any bench line built from the run
+    run_id: str | None = None
     merged_ply: str | None = None
     stl_path: str | None = None
     views_computed: int = 0
@@ -1223,9 +1247,13 @@ def _quarantine_failures(out_dir: str, failures, log) -> None:
     parsing logs."""
     qdir = os.path.join(out_dir, "quarantine")
     os.makedirs(qdir, exist_ok=True)
+    tr = tel.current()
     for rec in failures:
         _write_json_atomic(os.path.join(qdir, f"{rec.view}.json"),
                            rec.as_dict())
+        if tr is not None:
+            tr.instant("quarantine", view=rec.view, stage=rec.stage,
+                       error=rec.error_type)
     log(f"[pipeline] quarantined {len(failures)} failed view(s) -> {qdir}")
 
 
@@ -1238,6 +1266,7 @@ def _failure_manifest(out_dir: str, report: "PipelineReport",
     plan = faults.active_plan()
     path = os.path.join(out_dir, "failures.json")
     _write_json_atomic(path, {
+        "run_id": report.run_id,
         "views_total": views_total,
         "views_survived": views_survived,
         "degraded": report.degraded,
@@ -1429,7 +1458,7 @@ class _StreamRegistrar:
             t0 = time.perf_counter()
             p = self._recon.prep_view(self._clouds[i][0], self.voxel,
                                       self.cfg.merge.sample_before)
-            self.stats.add("register", time.perf_counter() - t0)
+            self.stats.add("register", time.perf_counter() - t0, view=i)
             self._preps[i] = p
         return p
 
@@ -1526,7 +1555,77 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     editing frames/calibration/config) recomputes only the stages whose
     inputs changed — a fully-warm rerun does zero decode/clean/merge/mesh
     compute and just re-emits the artifacts.
+
+    Observability (``observability.trace`` / ``SL3D_TRACE``): the run owns
+    a flight recorder — every lane span, cache hit/miss, retry, failure,
+    launch, and injected fault lands in ``<out_dir>/trace.jsonl`` (crash-
+    safe, append-only) with a ``metrics.json`` registry snapshot next to
+    the STL; ``sl3d report <out_dir>`` renders the timeline. A ``run_id``
+    correlates the report, the journal, failures.json, and bench lines.
+    The recorder closes (and persists metrics) even on a crash/interrupt.
     """
+    cfg = cfg or Config()
+    os.makedirs(out_dir, exist_ok=True)
+    run_id = tel.new_run_id()
+    tracer = prev = None
+    if cfg.observability.trace:
+        tracer = tel.Tracer(
+            os.path.join(out_dir, cfg.observability.trace_file),
+            run_id=run_id,
+            meta={"tool": "pipeline", "target": os.path.abspath(target),
+                  "backend": cfg.parallel.backend,
+                  "merge_method": cfg.merge.method,
+                  "merge_stream": cfg.merge.stream,
+                  "host_cpus": os.cpu_count(),
+                  "device_count": _initialized_device_count()})
+        prev = tel.activate(tracer)
+        log(f"[pipeline] flight recorder armed (run {run_id}) -> "
+            f"{tracer.path}")
+    try:
+        report = _run_pipeline_impl(calib_path, target, out_dir, cfg,
+                                    tuple(steps), merged_name, stl_name,
+                                    log, run_id)
+        if tracer is not None:
+            g = tracer.registry.set_gauge
+            g("sl3d_run_wall_seconds", report.elapsed_s)
+            g("sl3d_views_computed", report.views_computed)
+            g("sl3d_views_cached", report.views_cached)
+            g("sl3d_merged_points", report.merged_points)
+            g("sl3d_degraded", int(report.degraded))
+            if report.overlap:
+                g("sl3d_critical_path_seconds",
+                  report.overlap.get("critical_path_s") or 0.0)
+        return report
+    finally:
+        if tracer is not None:
+            tel.deactivate(prev)
+            metrics_path = os.path.join(out_dir,
+                                        cfg.observability.metrics_file)
+            tracer.close(metrics_path)
+            log(f"[pipeline] flight recorder -> {tracer.path} + "
+                f"{metrics_path} (inspect with: sl3d report {out_dir})")
+
+
+def _initialized_device_count():
+    """Attached-device count, ONLY if this process already initialized a
+    jax backend — the numpy-backend pipeline must never claim an
+    accelerator just to stamp a regime field (the bench emit() contract)."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if _xb._backends:
+            import jax
+
+            return jax.device_count()
+    except Exception:
+        pass
+    return None
+
+
+def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
+                       cfg: Config, steps: tuple[str, ...],
+                       merged_name: str, stl_name: str, log,
+                       run_id: str) -> PipelineReport:
     from structured_light_for_3d_model_replication_tpu.models import (
         reconstruction as recon,
     )
@@ -1534,7 +1633,6 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
         StageCache, config_subtree,
     )
 
-    cfg = cfg or Config()
     t_start = time.monotonic()
     calib = matfile.load_calibration(calib_path)
     need = gc.frames_per_view(cfg.decode.n_cols, cfg.decode.n_rows,
@@ -1552,7 +1650,7 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     # startup sweep: a kill -9 in an earlier run leaves *.tmp orphans under
     # the out tree (merged/STL/manifest staging, cache puts); none is data
     atomic.sweep_tmp(out_dir, log=log, recursive=True)
-    report = PipelineReport()
+    report = PipelineReport(run_id=run_id)
     cache = StageCache(os.path.join(out_dir, ".slscan-cache"),
                        enabled=cfg.pipeline.cache, log=log,
                        verify=cfg.pipeline.verify_cache)
@@ -1566,10 +1664,11 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     missing: list[tuple[int, str]] = []
     # per-view content keys hashed on the I/O pool — the serial hash wall
     # otherwise delays the batched executor's first launch
-    view_keys = cache.keys_parallel(
-        "view",
-        [[calib_path] + imio.list_frame_files(src) for src in sources],
-        config_json=view_cfg, io_workers=cfg.parallel.io_workers)
+    with tel.stage("cache.keys", views=len(sources)):
+        view_keys = cache.keys_parallel(
+            "view",
+            [[calib_path] + imio.list_frame_files(src) for src in sources],
+            config_json=view_cfg, io_workers=cfg.parallel.io_workers)
     for i, src in enumerate(sources):
         hit = cache.get("view", view_keys[i])
         if hit is not None:
@@ -1635,11 +1734,12 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
             if stream is not None:
                 stream.feed(i, pts, cols)
 
-        batch = BatchReport()
+        batch = BatchReport(run_id=run_id)
         run_args = (miss_sources, calib, cfg, scanner, "batch", view_dir,
                     batch, log)
         kw = dict(clean_steps=steps, collect=collect,
                   write_plys=cfg.pipeline.write_view_plys)
+        t_rec = time.perf_counter()
         if _use_batched(cfg, scanner, len(miss_sources)):
             # the register lane shares the executor's OverlapStats so
             # overlap reads as ONE schedule (register_s vs critical_path_s)
@@ -1648,6 +1748,10 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
             _reconstruct_pipelined(*run_args, **kw, stats=stream_stats)
         else:
             _reconstruct_serial(*run_args, **kw)
+        _tr = tel.current()
+        if _tr is not None:
+            _tr.span_end("reconstruct", time.perf_counter() - t_rec,
+                         views=len(miss_sources))
         report.failed = batch.failed
         report.failures = batch.failures
         report.retries = batch.retries
@@ -1690,6 +1794,7 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
         {"backend": cfg.parallel.backend,
          "force_bf16": cfg.parallel.force_bf16_features,
          "merge_mesh": cfg.parallel.merge_mesh})
+    t_merge = time.perf_counter()
     merge_key = cache.key("merge", digests=view_digests,
                           config_json=merge_cfg)
     hit = cache.get("merge", merge_key)
@@ -1753,6 +1858,11 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
                       transforms=np.stack([np.asarray(t)
                                            for t in transforms]))
         report.merge_status = "computed"
+    _tr = tel.current()
+    if _tr is not None:
+        _tr.span_end("merge", time.perf_counter() - t_merge,
+                     status=report.merge_status, mode=report.merge_mode,
+                     views=len(order))
     if stream_stats is not None:
         # one schedule, one record: the executor lanes plus the register
         # lane (pair launches, register_s vs critical_path_s)
@@ -1761,13 +1871,18 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
             if report.overlap and k in report.overlap:
                 snap[k] = report.overlap[k]
         report.overlap = snap
+    t_wm = time.perf_counter()
     ply.write_ply(merged_path, points, colors,
                   binary=not cfg.pipeline.ascii_output)
+    if _tr is not None:
+        _tr.span_end("write.merged", time.perf_counter() - t_wm,
+                     points=len(points))
     log(f"[pipeline] merged cloud -> {merged_path} ({len(points):,} points)")
     report.merged_ply = merged_path
     report.merged_points = len(points)
 
     # ---- stage 4: mesh -> STL ------------------------------------------
+    t_mesh = time.perf_counter()
     merged_digest = StageCache.digest_arrays(points=points)
     mesh_key = cache.key("mesh", digests=[merged_digest],
                          config_json=config_subtree(cfg, ("mesh",)))
@@ -1782,6 +1897,10 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
         report.mesh_status = "computed"
     stl_path = os.path.join(out_dir, stl_name)
     _write_mesh(stl_path, verts, faces, log=log)
+    if _tr is not None:
+        _tr.span_end("mesh", time.perf_counter() - t_mesh,
+                     status=report.mesh_status, verts=len(verts),
+                     faces=len(faces))
     report.stl_path = stl_path
 
     if report.failures:

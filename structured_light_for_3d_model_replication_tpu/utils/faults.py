@@ -65,6 +65,8 @@ import time
 import urllib.error
 from dataclasses import dataclass, field
 
+from structured_light_for_3d_model_replication_tpu.utils import telemetry
+
 __all__ = [
     "InjectedFault", "TransientFault", "PermanentFault", "InjectedCrash",
     "FaultRule", "FaultPlan", "configure", "configure_from", "reset", "fire",
@@ -182,6 +184,12 @@ class FaultPlan:
                 if rule.prob < 1.0 and self._rng.random() > rule.prob:
                     continue
                 rule.fired += 1
+                tr = telemetry.current()
+                if tr is not None:
+                    # chaos runs leave their injections in the journal, so
+                    # the fault ledger needs no log scraping
+                    tr.instant("fault.injected", site=site, kind=rule.kind,
+                               item=text or None)
                 rule.throw()
 
     def counts(self) -> dict[str, int]:
@@ -309,6 +317,12 @@ def retry_call(fn, policy: RetryPolicy, *, classify=is_transient,
                 raise
             if on_retry is not None:
                 on_retry(retries_done + 1, e)
+            tr = telemetry.current()
+            if tr is not None:
+                tr.instant("retry", attempt=retries_done + 1,
+                           error=type(e).__name__,
+                           backoff_s=round(policy.delay_s(retries_done + 1),
+                                           4))
             sleep(policy.delay_s(retries_done + 1))
             attempts += 1
 

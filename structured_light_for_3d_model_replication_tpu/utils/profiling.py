@@ -23,8 +23,10 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from structured_light_for_3d_model_replication_tpu.utils import telemetry
+
 __all__ = ["StageTimer", "OverlapStats", "trace", "get_logger",
-           "attach_callback"]
+           "attach_callback", "attached_callback", "detach_callback"]
 
 _LOGGER_NAME = "sl3d"
 
@@ -55,12 +57,42 @@ class _CallbackHandler(logging.Handler):
 def attach_callback(callback, level=logging.INFO) -> logging.Handler:
     """Forward the framework log to a reference-style ``log_callback(str)``
     sink (the Tk text-widget pattern, server/processing.py:272-274). Returns
-    the handler so callers can detach it."""
+    the handler so callers can detach it (``detach_callback``), and prefer
+    the context-manager form :func:`attached_callback`, which cannot leak.
+
+    Re-attaching the SAME callback replaces its previous handler instead of
+    stacking a duplicate — a caller that forgets to detach between attaches
+    (the GUI reconnect loop) no longer leaks a handler (and a duplicated
+    line) per attach."""
+    logger = get_logger()
+    for h in list(logger.handlers):
+        # == not `is`: a bound method (gui.log_box.append) is a fresh object
+        # on every attribute access, but compares equal to its twin
+        if isinstance(h, _CallbackHandler) and h._cb == callback:
+            logger.removeHandler(h)
+            h.close()
     h = _CallbackHandler(callback)
     h.setLevel(level)
     h.setFormatter(logging.Formatter("%(message)s"))
-    get_logger().addHandler(h)
+    logger.addHandler(h)
     return h
+
+
+def detach_callback(handler: logging.Handler) -> None:
+    """Remove a handler returned by :func:`attach_callback`."""
+    get_logger().removeHandler(handler)
+    handler.close()
+
+
+@contextlib.contextmanager
+def attached_callback(callback, level=logging.INFO):
+    """Scoped :func:`attach_callback`: the handler is detached on exit no
+    matter how the block leaves (the guaranteed-detach form)."""
+    h = attach_callback(callback, level)
+    try:
+        yield h
+    finally:
+        detach_callback(h)
 
 
 @dataclass
@@ -130,6 +162,19 @@ class OverlapStats:
     each scheduling step — the backpressure gauge (a queue pinned at 0
     means compute is starved by I/O; pinned at the bound means I/O is
     ahead and the bound is doing its job).
+
+    Memory is O(1) in run length: queue-depth and per-launch gauges are
+    exact running aggregates (count/sum/min/max), never retained sample
+    lists — a multi-thousand-view serving run costs the same bytes as a
+    4-view test, and the reported gauges are unchanged on runs of any
+    size because the aggregates are exact, not sampled (ISSUE-6
+    satellite).
+
+    Flight recorder: when a :mod:`~.utils.telemetry` tracer is active,
+    ``add``/``add_pair_launch`` emit the per-lane span events and the
+    retry/failure/launch accessors emit instants — journal-derived lane
+    walls and these sums come from the SAME calls, so the two layers
+    cannot drift. Disabled cost is one module-global None check.
     """
 
     _STAGES = ("load", "transfer", "compute", "clean", "write", "register")
@@ -140,30 +185,39 @@ class OverlapStats:
         self._retries = {s: 0 for s in self._STAGES}
         self._failures = {s: 0 for s in self._STAGES}
         self._items = 0
-        self._queue_samples: list[int] = []
+        # queue-depth gauge: exact running aggregates, not a sample list
+        self._q_n = 0
+        self._q_sum = 0
+        self._q_max = 0
         # batch-launch accounting (the view-batched executor): how many
         # device launches carried how many real views, and the first
         # dispatch wall per bucket size (the compile-cost proxy — later
         # launches of the same bucket reuse the executable)
         self._launches = 0
         self._views_dispatched = 0
-        self._batch_views: list[int] = []
+        self._bv_min: int | None = None
+        self._bv_max: int | None = None
         self._bucket_first_s: dict[int, float] = {}
         # register-lane launch accounting (the streaming merge): how many
         # pair-registration launches carried how many real pairs
         self._pair_launches = 0
         self._pairs_dispatched = 0
-        self._pair_batches: list[int] = []
         self.critical_path_s = 0.0
 
-    def add(self, stage: str, elapsed_s: float, items: int = 0) -> None:
-        """Accumulate ``elapsed_s`` of wall time into ``stage`` (thread-safe)."""
+    def add(self, stage: str, elapsed_s: float, items: int = 0,
+            view=None) -> None:
+        """Accumulate ``elapsed_s`` of wall time into ``stage`` (thread-safe).
+        ``view`` (a name or index) only annotates the trace span — it never
+        changes the aggregate accounting."""
         if stage not in self._stage_s:
             raise ValueError(f"unknown pipeline stage {stage!r}; "
                              f"valid: {self._STAGES}")
         with self._lock:
             self._stage_s[stage] += elapsed_s
             self._items += items
+        tr = telemetry.current()
+        if tr is not None:
+            tr.lane(stage, elapsed_s, view=view)
 
     def add_retry(self, stage: str) -> None:
         """Count one transient-fault retry in a lane (the resilience layer's
@@ -173,6 +227,9 @@ class OverlapStats:
             raise ValueError(f"unknown pipeline stage {stage!r}")
         with self._lock:
             self._retries[stage] += 1
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant("lane.retry", lane=stage)
 
     def add_failure(self, stage: str) -> None:
         """Count one exhausted/permanent per-item failure in a lane."""
@@ -180,6 +237,9 @@ class OverlapStats:
             raise ValueError(f"unknown pipeline stage {stage!r}")
         with self._lock:
             self._failures[stage] += 1
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant("lane.failure", lane=stage)
 
     def add_launch(self, n_views: int, bucket: int,
                    dispatch_s: float) -> None:
@@ -187,30 +247,51 @@ class OverlapStats:
         padded to ``bucket`` slots; ``dispatch_s`` is the (async) dispatch
         wall — dominated by trace+compile the first time a bucket is seen,
         near-zero after (the no-retrace gauge)."""
+        n = int(n_views)
         with self._lock:
             self._launches += 1
-            self._views_dispatched += int(n_views)
-            self._batch_views.append(int(n_views))
+            self._views_dispatched += n
+            self._bv_min = n if self._bv_min is None else min(self._bv_min, n)
+            self._bv_max = n if self._bv_max is None else max(self._bv_max, n)
             if bucket not in self._bucket_first_s:
                 self._bucket_first_s[int(bucket)] = round(dispatch_s, 4)
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant("launch", views=n, bucket=int(bucket),
+                       dispatch_s=round(dispatch_s, 6))
 
     def add_pair_launch(self, n_pairs: int, dispatch_s: float) -> None:
         """Record one register-lane launch carrying ``n_pairs`` real pairs
         (group padding excluded); ``dispatch_s`` accumulates into the
         ``register`` lane as well, so register_s vs critical_path_s reads
         directly as how much pair registration the stream hid."""
+        n = int(n_pairs)
         with self._lock:
             self._pair_launches += 1
-            self._pairs_dispatched += int(n_pairs)
-            self._pair_batches.append(int(n_pairs))
+            self._pairs_dispatched += n
             self._stage_s["register"] += dispatch_s
+        tr = telemetry.current()
+        if tr is not None:
+            # the register wall includes launch dispatch — mirror it as a
+            # lane span so journal-derived walls stay equal to register_s
+            tr.lane("register", dispatch_s, pairs=n)
+            tr.instant("pair_launch", pairs=n,
+                       dispatch_s=round(dispatch_s, 6))
 
     def sample_queue(self, depth: int) -> None:
+        d = int(depth)
         with self._lock:
-            self._queue_samples.append(int(depth))
+            self._q_n += 1
+            self._q_sum += d
+            if d > self._q_max:
+                self._q_max = d
 
     def finish(self, critical_path_s: float) -> None:
         self.critical_path_s = critical_path_s
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant("executor.finish",
+                       critical_path_s=round(critical_path_s, 6))
 
     @property
     def serial_sum_s(self) -> float:
@@ -218,15 +299,15 @@ class OverlapStats:
 
     def as_dict(self) -> dict:
         """The bench/report payload: per-stage walls, critical path, gauges."""
-        q = self._queue_samples
         out = {f"{s}_s": round(v, 4) for s, v in self._stage_s.items()}
         out["critical_path_s"] = round(self.critical_path_s, 4)
         out["serial_sum_s"] = round(self.serial_sum_s, 4)
         out["overlap_ratio"] = (round(self.serial_sum_s / self.critical_path_s, 3)
                                 if self.critical_path_s > 0 else None)
         out["items"] = self._items
-        out["max_queue_depth"] = max(q) if q else 0
-        out["mean_queue_depth"] = round(sum(q) / len(q), 2) if q else 0.0
+        out["max_queue_depth"] = self._q_max
+        out["mean_queue_depth"] = (round(self._q_sum / self._q_n, 2)
+                                   if self._q_n else 0.0)
         out["retries"] = dict(self._retries)
         out["failures"] = dict(self._failures)
         out["retry_total"] = sum(self._retries.values())
@@ -234,21 +315,21 @@ class OverlapStats:
         # batched-launch gauges (zeros/None on the per-view executors);
         # the per-item normalizations make batched and per-view lines
         # directly comparable
-        bv = self._batch_views
         out["launches"] = self._launches
         out["views_dispatched"] = self._views_dispatched
-        out["mean_views_per_launch"] = (round(sum(bv) / len(bv), 2)
-                                        if bv else 0.0)
-        out["min_views_per_launch"] = min(bv) if bv else 0
-        out["max_views_per_launch"] = max(bv) if bv else 0
+        out["mean_views_per_launch"] = (
+            round(self._views_dispatched / self._launches, 2)
+            if self._launches else 0.0)
+        out["min_views_per_launch"] = self._bv_min or 0
+        out["max_views_per_launch"] = self._bv_max or 0
         out["bucket_first_dispatch_s"] = {
             str(k): v for k, v in sorted(self._bucket_first_s.items())}
         # register-lane gauges (zeros on runs without a streaming merge)
-        pb = self._pair_batches
         out["pair_launches"] = self._pair_launches
         out["pairs_dispatched"] = self._pairs_dispatched
-        out["mean_pairs_per_launch"] = (round(sum(pb) / len(pb), 2)
-                                        if pb else 0.0)
+        out["mean_pairs_per_launch"] = (
+            round(self._pairs_dispatched / self._pair_launches, 2)
+            if self._pair_launches else 0.0)
         items = self._items
         out["compute_per_item_s"] = (round(self._stage_s["compute"] / items, 4)
                                      if items else None)
@@ -281,21 +362,45 @@ class OverlapStats:
                 f"{batched}{resil})")
 
 
+# jax.profiler supports exactly ONE active trace per process and raises on a
+# nested start_trace — the pipelined executor wraps its whole schedule in
+# trace() while per-view helpers (the serial fallback lane, merge_views
+# called mid-pipeline) carry their own trace() calls, so nesting is a real
+# code path, not an error. Track the active trace here and no-op inner
+# entries (reentrancy satellite, ISSUE 6).
+_TRACE_LOCK = threading.Lock()
+_TRACE_DEPTH = 0
+
+
 @contextlib.contextmanager
 def trace(trace_dir: str | None = None):
     """Device-level profiler trace around a block (TensorBoard format).
 
     No-ops unless a directory is given or ``SL3D_TRACE_DIR`` is set — safe to
-    leave in production paths.
+    leave in production paths. Reentrant: entering while a ``jax.profiler``
+    trace is already active (any thread) no-ops the inner call instead of
+    raising, so nested stage instrumentation composes; the OUTER call owns
+    the device trace and everything inside lands in its capture.
     """
+    global _TRACE_DEPTH
     trace_dir = trace_dir or os.environ.get("SL3D_TRACE_DIR")
     if not trace_dir:
         yield
         return
-    import jax
-
-    jax.profiler.start_trace(trace_dir)
+    with _TRACE_LOCK:
+        owner = _TRACE_DEPTH == 0
+        _TRACE_DEPTH += 1
     try:
-        yield
+        if not owner:
+            yield
+            return
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
     finally:
-        jax.profiler.stop_trace()
+        with _TRACE_LOCK:
+            _TRACE_DEPTH -= 1

@@ -53,3 +53,88 @@ def test_trace_writes_profile(tmp_path):
     # the profiler lays down a plugins/profile/<ts>/ tree
     found = list(tmp_path.rglob("*.xplane.pb"))
     assert found, list(tmp_path.rglob("*"))
+
+
+def test_trace_reentrant_inner_noop(tmp_path):
+    """ISSUE-6 satellite: a nested trace() while a jax.profiler trace is
+    active must no-op instead of raising — the executor wraps its whole
+    schedule while inner stages carry their own trace() calls."""
+    import jax.numpy as jnp
+
+    with prof.trace(str(tmp_path)):
+        with prof.trace(str(tmp_path)):       # would raise before the fix
+            jnp.ones((4, 4)).sum().block_until_ready()
+        # inner exit must NOT have stopped the outer trace
+        jnp.ones((4, 4)).sum().block_until_ready()
+    assert list(tmp_path.rglob("*.xplane.pb"))
+    # the depth latch fully unwound: a fresh trace still works
+    with prof.trace(str(tmp_path)):
+        pass
+
+
+def test_attached_callback_detaches_on_exit():
+    lines = []
+    logger = prof.get_logger()
+    before = len(logger.handlers)
+    with prof.attached_callback(lines.append):
+        assert len(logger.handlers) == before + 1
+        logger.info("inside scope")
+    assert len(logger.handlers) == before     # guaranteed detach
+    logger.info("outside scope")
+    assert any("inside scope" in ln for ln in lines)
+    assert not any("outside scope" in ln for ln in lines)
+
+
+def test_attached_callback_detaches_on_exception():
+    lines = []
+    before = len(prof.get_logger().handlers)
+    try:
+        with prof.attached_callback(lines.append):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert len(prof.get_logger().handlers) == before
+
+
+def test_attach_callback_same_sink_replaces_not_stacks():
+    """The leak fix: re-attaching the same callback must not accumulate
+    handlers (or duplicate every log line)."""
+    lines = []
+    logger = prof.get_logger()
+    before = len(logger.handlers)
+    h1 = prof.attach_callback(lines.append)
+    h2 = prof.attach_callback(lines.append)   # forgot to detach h1
+    assert len(logger.handlers) == before + 1
+    logger.info("once only")
+    assert sum("once only" in ln for ln in lines) == 1
+    prof.detach_callback(h2)
+    assert h1 not in logger.handlers
+    assert len(logger.handlers) == before
+
+
+def test_overlap_stats_gauges_are_bounded_memory():
+    """ISSUE-6 satellite: queue/launch gauges come from exact running
+    aggregates — identical numbers to the old sample lists, O(1) memory on
+    arbitrarily long runs."""
+    s = prof.OverlapStats()
+    for d in (0, 1, 2, 3, 2):
+        s.sample_queue(d)
+    for n, b in ((4, 4), (4, 4), (2, 2)):
+        s.add_launch(n, b, 0.01)
+    s.add_pair_launch(3, 0.05)
+    s.add_pair_launch(1, 0.01)
+    d = s.as_dict()
+    assert d["max_queue_depth"] == 3
+    assert d["mean_queue_depth"] == 1.6
+    assert d["launches"] == 3 and d["views_dispatched"] == 10
+    assert d["mean_views_per_launch"] == 3.33
+    assert d["min_views_per_launch"] == 2
+    assert d["max_views_per_launch"] == 4
+    assert d["mean_pairs_per_launch"] == 2.0
+    # no unbounded per-sample state survives on the instance
+    for attr in ("_queue_samples", "_batch_views", "_pair_batches"):
+        assert not hasattr(s, attr)
+    # a long run costs O(1): a million samples leaves only scalar gauges
+    for i in range(10000):
+        s.sample_queue(i % 4)
+    assert s.as_dict()["max_queue_depth"] == 3

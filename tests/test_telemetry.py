@@ -1,0 +1,331 @@
+"""Flight recorder contract (ISSUE 6): journal schema round-trip, crash-safe
+journals, OverlapStats<->trace cross-check, `sl3d report` on clean/degraded/
+interrupted runs, the Perfetto export, and the zero-allocation disabled path.
+"""
+import json
+import math
+import os
+
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.cli import main as cli_main
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.pipeline import report as replib
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import faults
+from structured_light_for_3d_model_replication_tpu.utils import telemetry as tel
+
+STEPS = ("statistical",)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("traceds"))
+    rc = cli_main(["synth", root, "--views", "3",
+                   "--cam", "160x120", "--proj", "128x64"])
+    assert rc == 0
+    return root
+
+
+def _cfg(trace: bool = True) -> Config:
+    cfg = Config()
+    cfg.parallel.backend = "numpy"
+    cfg.decode.n_cols, cfg.decode.n_rows = 128, 64
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 512
+    cfg.merge.icp_iters = 10
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    cfg.observability.trace = trace
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def traced_run(dataset, tmp_path_factory):
+    """One traced clean run shared by the schema/report/export tests."""
+    out = str(tmp_path_factory.mktemp("traced"))
+    calib = os.path.join(dataset, "calib.mat")
+    rep = stages.run_pipeline(calib, dataset, out, cfg=_cfg(), steps=STEPS,
+                              log=lambda m: None)
+    assert rep.failed == []
+    return out, rep
+
+
+# ---------------------------------------------------------------------------
+# journal schema + round trip
+# ---------------------------------------------------------------------------
+
+def test_journal_schema_roundtrip(traced_run):
+    out, rep = traced_run
+    journal = os.path.join(out, "trace.jsonl")
+    assert os.path.exists(journal)
+    # every line is standalone JSON (the append-only crash-safety contract)
+    with open(journal) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert lines[0]["type"] == "meta"
+    assert lines[0]["schema"] == tel.SCHEMA
+    assert lines[0]["run_id"] == rep.run_id
+    assert lines[-1]["type"] == "end"
+    assert replib.validate_journal(journal) == []
+    j = tel.read_journal(journal)
+    assert j["truncated"] == 0
+    kinds = {e["type"] for e in j["events"]}
+    assert kinds >= {"span", "instant", "end"}
+    # spans carry non-negative monotonic-clock offsets and durations
+    for e in j["events"]:
+        assert e["t"] >= -1e-6
+        if e["type"] == "span":
+            assert e["dur"] >= 0
+
+
+def test_metrics_json_written_and_prometheus(traced_run):
+    out, rep = traced_run
+    with open(os.path.join(out, "metrics.json")) as f:
+        m = json.load(f)
+    assert m["run_id"] == rep.run_id
+    names = {c["name"] for c in m["counters"]}
+    assert "sl3d_cache_events_total" in names
+    hists = {h["name"] for h in m["histograms"]}
+    assert "sl3d_lane_seconds" in hists
+    text = tel.prometheus_text(m)
+    assert "# TYPE sl3d_lane_seconds histogram" in text
+    assert "sl3d_lane_seconds_bucket" in text
+    assert 'le="+Inf"' in text
+
+
+def test_run_id_threads_through(traced_run):
+    out, rep = traced_run
+    meta = tel.read_journal(os.path.join(out, "trace.jsonl"))["meta"]
+    with open(os.path.join(out, "metrics.json")) as f:
+        m = json.load(f)
+    assert rep.run_id == meta["run_id"] == m["run_id"]
+
+
+# ---------------------------------------------------------------------------
+# OverlapStats <-> journal cross-check (the can't-drift guarantee)
+# ---------------------------------------------------------------------------
+
+def test_lane_walls_reproduce_overlap_stats(traced_run):
+    out, rep = traced_run
+    a = replib.analyze_run(out)
+    checked = 0
+    for lane, wall in a.lane_walls.items():
+        stat = rep.overlap.get(f"{lane}_s")
+        if stat:
+            assert math.isclose(wall, stat, rel_tol=0.01, abs_tol=1e-3), \
+                (lane, wall, stat)
+            checked += 1
+    assert checked >= 2  # at least load + register on the fused run
+
+
+# ---------------------------------------------------------------------------
+# sl3d report: clean / degraded / interrupted
+# ---------------------------------------------------------------------------
+
+def test_report_clean_run(traced_run, capsys):
+    out, rep = traced_run
+    rc = cli_main(["report", out])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert rep.run_id in text
+    assert "lane timeline" in text
+    assert "stage cache" in text
+    assert "clean close" in text
+    assert "fault ledger: clean" in text
+
+
+def test_report_chrome_trace_lanes_overlap(traced_run, capsys):
+    out, _rep = traced_run
+    rc = cli_main(["report", out, "--chrome-trace"])
+    assert rc == 0
+    with open(os.path.join(out, "trace.json")) as f:
+        payload = json.load(f)
+    evs = payload["traceEvents"]
+    names = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    lanes = {n.split(" [")[0] for n in names}
+    assert len(lanes) >= 4, lanes
+    # the streaming schedule must show genuine overlap: some pair of spans
+    # on DIFFERENT lanes intersects in time
+    spans = [(e["ts"], e["ts"] + e["dur"], e["tid"]) for e in evs
+             if e.get("ph") == "X"]
+    tid_lane = {}
+    for e in evs:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tid_lane[e["tid"]] = e["args"]["name"].split(" [")[0]
+    overlapping = any(
+        a0 < b1 and b0 < a1 and tid_lane.get(ta) != tid_lane.get(tb)
+        for i, (a0, a1, ta) in enumerate(spans)
+        for (b0, b1, tb) in spans[i + 1:])
+    assert overlapping
+
+
+def test_report_degraded_run(dataset, tmp_path, capsys):
+    """A permanent compute fault quarantines one view; the report must show
+    the fault ledger and failures.json must carry the run_id."""
+    out = str(tmp_path / "degraded")
+    calib = os.path.join(dataset, "calib.mat")
+    faults.configure("compute.view~120deg:permanent", seed=0)
+    try:
+        rep = stages.run_pipeline(calib, dataset, out, cfg=_cfg(),
+                                  steps=STEPS, log=lambda m: None)
+    finally:
+        faults.reset()
+    assert rep.degraded and len(rep.failed) == 1
+    with open(os.path.join(out, "failures.json")) as f:
+        manifest = json.load(f)
+    assert manifest["run_id"] == rep.run_id
+    rc = cli_main(["report", out])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "DEGRADED" in text
+    assert "fault ledger" in text
+    assert "injected" in text          # fault.injected event made the ledger
+    assert "quarantined view" in text
+    assert replib.validate_journal(os.path.join(out, "trace.jsonl")) == []
+
+
+def test_crash_mid_run_leaves_parseable_journal(dataset, tmp_path, capsys):
+    """The PR-3 crash site at the merged-cloud write: the InjectedCrash
+    escapes run_pipeline, yet the journal parses and `sl3d report` renders
+    the interrupted run (no end marker -> INTERRUPTED verdict)."""
+    out = str(tmp_path / "crashed")
+    calib = os.path.join(dataset, "calib.mat")
+    faults.configure("ply.write~merged:crash", seed=0)
+    try:
+        with pytest.raises(faults.InjectedCrash):
+            stages.run_pipeline(calib, dataset, out, cfg=_cfg(),
+                                steps=STEPS, log=lambda m: None)
+    finally:
+        faults.reset()
+    assert tel.current() is None       # the finally released the tracer
+    journal = os.path.join(out, "trace.jsonl")
+    assert replib.validate_journal(journal) == []
+    a = replib.analyze_run(out)
+    assert a.lane_walls                # real events landed before the crash
+    rc = cli_main(["report", out])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "injected" in text          # the crash is in the ledger
+
+
+def test_truncated_tail_tolerated(traced_run, tmp_path):
+    """A torn trailing line (kill mid-write) reads as truncated, never as a
+    parse failure."""
+    out, _rep = traced_run
+    src = os.path.join(out, "trace.jsonl")
+    dst = tmp_path / "torn.jsonl"
+    data = open(src).read()
+    dst.write_text(data + '{"type":"instant","ev":"torn","t":9.9')
+    j = tel.read_journal(str(dst))
+    assert j["truncated"] == 1
+    assert j["meta"] is not None and j["events"]
+    assert replib.validate_journal(str(dst)) == []
+
+
+# ---------------------------------------------------------------------------
+# disabled path
+# ---------------------------------------------------------------------------
+
+def test_disabled_run_emits_nothing(dataset, tmp_path):
+    out = str(tmp_path / "untraced")
+    calib = os.path.join(dataset, "calib.mat")
+    rep = stages.run_pipeline(calib, dataset, out, cfg=_cfg(trace=False),
+                              steps=STEPS, log=lambda m: None)
+    assert rep.failed == []
+    assert not os.path.exists(os.path.join(out, "trace.jsonl"))
+    assert not os.path.exists(os.path.join(out, "metrics.json"))
+    assert rep.run_id  # the correlation id exists even untraced
+
+
+def test_disabled_path_zero_allocation():
+    """The disabled instrumentation point is `telemetry.current()` + a None
+    check — steady state must allocate nothing (the <=1.02x overhead
+    contract's mechanism)."""
+    import tracemalloc
+
+    assert tel.current() is None
+    n = 10000
+    sentinel = [None] * n              # preallocate the loop's iterable
+    tracemalloc.start()
+    try:
+        base = tracemalloc.get_traced_memory()[0]
+        for _ in sentinel:
+            if tel.current() is not None:  # the exact guard the hot paths use
+                raise AssertionError
+        grown = tracemalloc.get_traced_memory()[0] - base
+    finally:
+        tracemalloc.stop()
+    assert grown < 512, f"disabled path allocated {grown} bytes over {n} calls"
+
+
+def test_env_flag_arms_tracing(monkeypatch):
+    monkeypatch.setenv("SL3D_TRACE", "1")
+    assert Config().observability.trace is True
+    monkeypatch.delenv("SL3D_TRACE")
+    assert Config().observability.trace is False
+
+
+# ---------------------------------------------------------------------------
+# registry unit
+# ---------------------------------------------------------------------------
+
+def test_metrics_registry_quantiles_and_text():
+    reg = tel.MetricsRegistry()
+    reg.inc("sl3d_events_total", ev="x")
+    reg.inc("sl3d_events_total", ev="x")
+    reg.set_gauge("sl3d_run_wall_seconds", 12.5)
+    for v in (0.01, 0.02, 0.03, 0.04, 5.0):
+        reg.observe("sl3d_lane_seconds", v, lane="load")
+    d = reg.as_dict()
+    h = d["histograms"][0]
+    assert h["count"] == 5 and abs(h["sum"] - 5.1) < 1e-6
+    assert h["min"] == 0.01 and h["max"] == 5.0
+    assert 0.01 <= h["p50"] <= 0.05       # bucket-interpolated median
+    assert h["p99"] <= 5.0
+    assert reg.counter_value("sl3d_events_total", ev="x") == 2
+    text = reg.to_prometheus()
+    assert 'sl3d_events_total{ev="x"} 2.0' in text
+    assert "sl3d_run_wall_seconds 12.5" in text
+    # cumulative bucket counts end at the total
+    assert f'le="+Inf"}} {h["count"]}' in text
+
+
+def test_journal_segments_keep_history_scope_latest(tmp_path):
+    """Reruns APPEND to the journal (crash evidence survives); readers see
+    one segment per run and scope meta/events to the latest."""
+    p = str(tmp_path / "t.jsonl")
+    t1 = tel.Tracer(p)
+    t1.lane("load", 0.5)
+    t1.close()
+    t2 = tel.Tracer(p)
+    t2.lane("compute", 0.25)
+    t2.close()
+    j = tel.read_journal(p)
+    assert j["runs"] == 2 and len(j["segments"]) == 2
+    assert j["meta"]["run_id"] == t2.run_id       # latest run wins
+    spans = [e for e in j["events"] if e["type"] == "span"]
+    assert [s["lane"] for s in spans] == ["compute"]
+    # the first run's evidence is intact in its own segment
+    s0 = j["segments"][0]
+    assert s0["meta"]["run_id"] == t1.run_id
+    assert any(e.get("lane") == "load" for e in s0["events"])
+    assert replib.validate_journal(p) == []
+
+
+def test_tracer_activate_restores_previous(tmp_path):
+    t1 = tel.Tracer(str(tmp_path / "a.jsonl"))
+    prev = tel.activate(t1)
+    try:
+        assert tel.current() is t1
+        t2 = tel.Tracer(str(tmp_path / "b.jsonl"))
+        p2 = tel.activate(t2)
+        assert p2 is t1 and tel.current() is t2
+        tel.deactivate(p2)
+        assert tel.current() is t1
+        t2.close()
+    finally:
+        tel.deactivate(prev)
+        t1.close()
+    assert tel.current() is None

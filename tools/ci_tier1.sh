@@ -57,6 +57,20 @@ else
   [ $rc -eq 0 ] && rc=1
 fi
 
+# ---- trace smoke: traced 2-view pipeline -> schema-valid journal, report
+# renders, Perfetto export shows >=4 lanes, journal lane walls reproduce
+# OverlapStats within 1%, and the disabled-overhead bench arm (recorded in
+# pipeline_smoke.json above) stays <= 1.02x vs pipeline_e2e (ISSUE 6) ----
+trace_rc=0
+tracesmoke=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/trace_smoke.py --overhead-json tools/_ci/pipeline_smoke.json 2>&1) || trace_rc=$?
+echo "$tracesmoke" > tools/_ci/trace_smoke.log
+if [ $trace_rc -eq 0 ] && echo "$tracesmoke" | grep -q 'TRACE_SMOKE=ok'; then
+  echo "$tracesmoke" | grep 'TRACE_SMOKE=ok'
+else
+  echo "TRACE_SMOKE=FAIL (rc=$trace_rc; see tools/_ci/trace_smoke.log)"
+  [ $rc -eq 0 ] && rc=1
+fi
+
 # ---- chaos smoke: seeded fault plan (1 transient + 1 permanent over 5
 # views) must retry, quarantine, and still ship the STL with exit 0 ----
 chaos_rc=0

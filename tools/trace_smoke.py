@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""TRACE_SMOKE: the flight-recorder CI gate (ISSUE 6).
+
+Runs a tiny 2-view fused pipeline with tracing ON, then asserts the whole
+observability chain end to end:
+
+  1. the run emits trace.jsonl + metrics.json next to the STL
+  2. the journal validates against the sl3d-trace-v1 schema
+  3. ``sl3d report`` renders it (lane timeline + stage walls + cache table)
+  4. ``sl3d report --chrome-trace`` exports a Perfetto-loadable trace.json
+     showing >= 4 distinct lanes
+  5. journal-derived lane walls reproduce the run's OverlapStats within 1%
+  6. (``--overhead-json``) the bench record's disabled-overhead ratio
+     (pipeline_trace.overhead_vs_e2e, measured by bench.py --pipeline-only)
+     stays <= 1.02x — the fault layer's zero-overhead-by-default contract
+
+Prints ``TRACE_SMOKE=ok`` and exits 0 on success; any assertion prints the
+failure and exits 1. Run with JAX_PLATFORMS=cpu (ci_tier1.sh does) — the
+pipeline runs the numpy decode backend and must not claim an accelerator.
+"""
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+import tempfile
+from contextlib import redirect_stdout
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OVERHEAD_CEILING = 1.02
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--views", type=int, default=2)
+    ap.add_argument("--overhead-json", default=None,
+                    help="a bench --pipeline-only record "
+                         "(tools/_ci/pipeline_smoke.json); asserts its "
+                         "pipeline_trace.overhead_vs_e2e <= 1.02")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir (debugging)")
+    args = ap.parse_args()
+
+    from structured_light_for_3d_model_replication_tpu.cli import (
+        main as cli_main,
+    )
+    from structured_light_for_3d_model_replication_tpu.config import Config
+    from structured_light_for_3d_model_replication_tpu.pipeline import (
+        report as replib,
+    )
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+    tmp = tempfile.mkdtemp(prefix="sl3d_trace_smoke_")
+    ds = os.path.join(tmp, "ds")
+    out = os.path.join(tmp, "out")
+    print(f"[trace_smoke] scratch dir {tmp}", file=sys.stderr)
+
+    # -- 1: traced 2-view pipeline ---------------------------------------
+    rc = cli_main(["synth", ds, "--views", str(args.views),
+                   "--cam", "160x120", "--proj", "128x64"])
+    assert rc == 0, f"synth failed rc={rc}"
+    cfg = Config()
+    cfg.parallel.backend = "numpy"
+    cfg.decode.n_cols, cfg.decode.n_rows = 128, 64
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 512
+    cfg.merge.icp_iters = 10
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    cfg.observability.trace = True
+    rep = stages.run_pipeline(os.path.join(ds, "calib.mat"), ds, out,
+                              cfg=cfg, steps=("statistical",),
+                              log=lambda m: None)
+    assert rep.failed == [], f"pipeline failed: {rep.failed}"
+    journal = os.path.join(out, "trace.jsonl")
+    metrics = os.path.join(out, "metrics.json")
+    assert os.path.exists(journal), "no trace.jsonl emitted"
+    assert os.path.exists(metrics), "no metrics.json emitted"
+    assert rep.run_id, "PipelineReport carries no run_id"
+
+    # -- 2: schema validation --------------------------------------------
+    errors = replib.validate_journal(journal)
+    assert not errors, f"journal schema errors: {errors[:5]}"
+    with open(metrics, encoding="utf-8") as f:
+        m = json.load(f)
+    assert m.get("run_id") == rep.run_id, "metrics run_id != report run_id"
+
+    # -- 3: sl3d report renders ------------------------------------------
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(["report", out])
+    text = buf.getvalue()
+    assert rc == 0, f"sl3d report rc={rc}"
+    for needle in ("lane timeline", "stage walls", "stage cache",
+                   rep.run_id):
+        assert needle in text, f"report output missing {needle!r}"
+
+    # -- 4: chrome trace export ------------------------------------------
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli_main(["report", out, "--chrome-trace", "--validate"])
+    assert rc == 0, f"sl3d report --chrome-trace rc={rc}"
+    chrome = os.path.join(out, "trace.json")
+    with open(chrome, encoding="utf-8") as f:
+        payload = json.load(f)
+    names = {e["args"]["name"] for e in payload["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    lanes = {n.split(" [")[0] for n in names}
+    exec_lanes = lanes & {"load", "transfer", "compute", "clean", "write",
+                          "register", "stage"}
+    assert len(exec_lanes) >= 4, \
+        f"expected >= 4 distinct lanes in the chrome trace, got {lanes}"
+
+    # -- 5: journal lane walls reproduce OverlapStats ---------------------
+    a = replib.analyze_run(out)
+    drift = 0.0
+    checked = 0
+    for lane, wall in a.lane_walls.items():
+        stat = (rep.overlap or {}).get(f"{lane}_s")
+        if stat:
+            drift = max(drift, abs(wall - stat) / stat)
+            checked += 1
+    assert checked >= 2, f"too few lanes to cross-check ({checked})"
+    assert drift <= 0.01, f"lane walls drifted {drift:.4f} from OverlapStats"
+
+    # -- 6: disabled-overhead contract from the bench record --------------
+    ratio = None
+    if args.overhead_json:
+        if os.path.exists(args.overhead_json):
+            with open(args.overhead_json, encoding="utf-8") as f:
+                try:
+                    rec = json.load(f)
+                except ValueError:
+                    rec = {}
+            ratio = (rec.get("pipeline_trace") or {}).get("overhead_vs_e2e")
+            if ratio is not None:
+                assert ratio <= OVERHEAD_CEILING, (
+                    f"tracing-disabled overhead {ratio}x exceeds the "
+                    f"{OVERHEAD_CEILING}x contract vs pipeline_e2e")
+            else:
+                print("[trace_smoke] WARNING: bench record carries no "
+                      "pipeline_trace.overhead_vs_e2e (errored arm?) — "
+                      "overhead not asserted here; PIPELINE_SMOKE flags "
+                      "the underlying failure", file=sys.stderr)
+        else:
+            print(f"[trace_smoke] WARNING: {args.overhead_json} absent — "
+                  f"overhead contract not asserted", file=sys.stderr)
+
+    if not args.keep:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(f"TRACE_SMOKE=ok run_id={rep.run_id} events={a.events} "
+          f"lanes={sorted(exec_lanes)} drift={drift:.4f}"
+          + (f" overhead_vs_e2e={ratio}" if ratio is not None else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"TRACE_SMOKE=FAIL {e}", file=sys.stderr)
+        sys.exit(1)
