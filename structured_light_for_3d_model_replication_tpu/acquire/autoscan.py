@@ -116,7 +116,7 @@ def auto_scan_360(sequencer, turntable, output_root: str,
                   base_name: str = "scan", rotate_timeout: float = 30.0,
                   capture_retries: int = 0, rotate_retries: int = 0,
                   progress: Callable[[dict], None] | None = None,
-                  log=print) -> AutoScanResult:
+                  token=None, log=print) -> AutoScanResult:
     """Run the full turntable sweep; returns per-view folders + angles.
 
     ``sequencer`` is a CaptureSequencer (or anything with ``capture_scan``);
@@ -125,11 +125,22 @@ def auto_scan_360(sequencer, turntable, output_root: str,
     ``capture_retries``/``rotate_retries`` default to 0 (the reference's
     single-attempt behavior); the CLI wires ``acquire.capture_retries`` /
     ``acquire.rotate_retries``.
+
+    ``token`` (a :class:`~.utils.deadline.CancelToken`) makes the sweep
+    cooperatively cancellable: checked between hardware steps, a raised
+    token stops the sweep CLEANLY after the current view — captured views
+    remain usable, nothing half-rotates. An hours-long sweep should never
+    need ``kill -9`` to stop.
     """
     os.makedirs(output_root, exist_ok=True)
     result = AutoScanResult()
     t0 = time.monotonic()
     for i in range(turns):
+        if token is not None and token.cancelled:
+            log(f"[autoscan] cancelled after {i}/{turns} view(s) "
+                f"({token.reason or 'no reason given'}); stopping the "
+                f"sweep cleanly")
+            break
         angle = i * step_deg
         view_dir = os.path.join(output_root, view_folder_name(base_name, angle))
         view_name = os.path.basename(view_dir)

@@ -68,8 +68,10 @@ def await_pose_selection(artifact_dir: str, timeout: float = 600.0,
     the kept pose names, or None on timeout. Consumes the selection file
     and marks the review done."""
     sel = os.path.join(artifact_dir, POSE_SELECTION_FILE)
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    # monotonic, never wall-clock: an NTP step or suspend/resume must not
+    # stretch or collapse the wait (turntable.wait_for_done's convention)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         if os.path.exists(sel):
             with open(sel) as f:
                 keep = json.load(f).get("keep", [])

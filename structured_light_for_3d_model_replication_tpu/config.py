@@ -261,6 +261,15 @@ class PipelineConfig:
     # read; a corrupt entry (bit rot, torn write survivor) is evicted and
     # recomputed instead of poisoning downstream stages
     verify_cache: bool = True
+    # overall wall-clock budget for one fused run, seconds (0 = unbounded;
+    # env SL3D_RUN_BUDGET_S). Checked at stage boundaries and executor
+    # scheduling steps: exceeding it ABORTS the run with an aborted
+    # failure manifest — the request-deadline primitive a multi-tenant
+    # serving process needs (ROADMAP item 1). Per-lane stall handling is
+    # the `deadlines` section; this is the end-to-end ceiling above it.
+    run_budget_s: float = field(
+        default_factory=lambda: float(
+            os.environ.get("SL3D_RUN_BUDGET_S", "0")))
 
 
 def _env_flag(name: str) -> bool:
@@ -284,6 +293,40 @@ class ObservabilityConfig:
     # journal / metrics filenames inside the run's out dir
     trace_file: str = "trace.jsonl"
     metrics_file: str = "metrics.json"
+
+
+@dataclass
+class DeadlinesConfig:
+    """Per-lane deadlines + the lane watchdog (utils/deadline.py): the
+    guarantee that a wedged load, device dispatch, write, or pair
+    registration can never hang a run forever. Enabled by default — the
+    defaults are far above any healthy lane wall, so they only ever fire
+    on a genuine stall; ``enabled=false`` (env SL3D_NO_DEADLINES=1)
+    restores bare blocking waits, and the disabled path is one None/flag
+    check per wait (benched <= 1.02x vs pipeline_e2e, the faults/
+    telemetry contract)."""
+
+    enabled: bool = field(
+        default_factory=lambda: not _env_flag("SL3D_NO_DEADLINES"))
+    # per-lane budgets for each bounded wait, seconds (0 = unbounded).
+    # A breach abandons THAT item: it is recorded as a DeadlineExceeded
+    # FailureRecord and quarantined exactly like a permanently-failed
+    # view/pair — the run continues DEGRADED above the survivor floor.
+    load_s: float = 300.0      # frame-stack prefetch wait per view
+    compute_s: float = 900.0   # decode+triangulate (incl. device sync)
+    write_s: float = 300.0     # one artifact writeback wait
+    register_s: float = 900.0  # streaming-merge register-lane drain
+    drain_s: float = 600.0     # whole writeback-queue drain/close budget
+    cache_s: float = 300.0     # stage-cache keying (frame-byte hashing)
+    # the lane watchdog: a daemon thread polling the heartbeats that
+    # OverlapStats.add/add_pair_launch emit. No heartbeat from ANY lane
+    # for soft_stall_s -> watchdog.stall trace event + warning; for
+    # hard_stall_s -> cancel the stalled item (cooperative — it
+    # quarantines and the run continues) + dump all thread stacks into a
+    # crash-safe stalls.json next to failures.json. 0 disables a level.
+    watchdog_poll_s: float = 1.0
+    soft_stall_s: float = 60.0
+    hard_stall_s: float = 300.0
 
 
 @dataclass
@@ -313,6 +356,7 @@ class Config:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
+    deadlines: DeadlinesConfig = field(default_factory=DeadlinesConfig)
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
     scan_root: str = ""  # dated scan folder; empty = ./scans/<date>

@@ -17,6 +17,9 @@ import numpy as np
 from structured_light_for_3d_model_replication_tpu.io.atomic import (
     atomic_write,
 )
+from structured_light_for_3d_model_replication_tpu.utils import (
+    deadline as dl,
+)
 from structured_light_for_3d_model_replication_tpu.utils import faults
 
 __all__ = ["write_ply", "read_ply", "write_mesh_ply", "WritebackQueue",
@@ -166,6 +169,9 @@ class WritebackQueue:
         def _write() -> str:
             import time
 
+            # work-started heartbeat for the stall watchdog (completion
+            # beats flow through on_write -> OverlapStats.add)
+            dl.beat("write")
             t0 = time.perf_counter()
             if self._retry is not None:
                 faults.retry_call(
@@ -189,16 +195,40 @@ class WritebackQueue:
         """Writes submitted but not yet finished (the queue-depth gauge)."""
         return sum(1 for _, f in self._pending if not f.done())
 
-    def drain(self) -> list[str]:
+    def drain(self, timeout_s: float | None = None) -> list[str]:
         """Block until every submitted write finished; returns successfully
         written paths. ALL write errors are collected and raised together as
         one :class:`PlyWriteError` (callers holding per-item futures instead
-        call ``.result()`` on those and never need drain)."""
+        call ``.result()`` on those and never need drain).
+
+        ``timeout_s`` bounds the WHOLE drain (one shared monotonic
+        deadline, not per write): a stalled writer thread can no longer
+        block the pipeline forever — writes still pending at expiry are
+        aggregated into the same :class:`PlyWriteError` as a
+        :class:`~.utils.deadline.DeadlineExceeded` per path, alongside any
+        ordinary write failures. None keeps the historical unbounded
+        behavior."""
         out: list[str] = []
         errors: list[tuple[str, Exception]] = []
+        deadline = dl.Deadline.after(timeout_s, "writeback drain")
         for path, f in self._pending:
             try:
-                out.append(f.result())
+                # NB: remaining() can be <= 0 once the shared budget is
+                # spent — that means "expired", never "unbounded"
+                rem = deadline.remaining() if deadline is not None else None
+                if rem is not None and rem <= 0:
+                    settled = f.done()
+                elif rem is None:
+                    f.exception()   # blocks without raising; result below
+                    settled = True
+                else:
+                    settled = dl.wait_settled(f, rem)
+                if settled:
+                    out.append(f.result())
+                else:
+                    errors.append((path, dl.DeadlineExceeded(
+                        f"write still pending after the {timeout_s:g}s "
+                        f"drain budget (stalled writer thread?)")))
             except Exception as e:
                 errors.append((path, e))
         self._pending.clear()
@@ -206,7 +236,24 @@ class WritebackQueue:
             raise PlyWriteError(errors)
         return out
 
-    def close(self, wait: bool = True) -> None:
+    def close(self, wait: bool = True,
+              timeout_s: float | None = None) -> None:
+        """Shut the writer down. ``timeout_s`` (with ``wait=True``) bounds
+        how long a stalled in-flight write may delay shutdown: pending
+        futures get one shared deadline, and anything still unsettled is
+        abandoned (``cancel_futures`` drops the queued tail; the wedged
+        thread is left to die with the process — Python cannot kill it)."""
+        if wait and timeout_s is not None and timeout_s > 0:
+            deadline = dl.Deadline.after(timeout_s, "writeback close")
+            settled = True
+            for _, f in self._pending:
+                rem = deadline.remaining()
+                # a spent budget means expired, never unbounded
+                if rem <= 0 or not dl.wait_settled(f, rem):
+                    settled = False
+                    break
+            self._pool.shutdown(wait=settled, cancel_futures=not settled)
+            return
         self._pool.shutdown(wait=wait, cancel_futures=not wait)
 
     def __enter__(self) -> "WritebackQueue":

@@ -179,6 +179,16 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
                         "SL3D_TRACE=1): write an append-only crash-safe "
                         "trace.jsonl event journal + metrics.json into "
                         "<out>; inspect with 'sl3d report <out>'")
+    p.add_argument("--run-budget", type=float, default=None, metavar="S",
+                   help="overall wall-clock budget for this run, seconds "
+                        "(pipeline.run_budget_s; 0 = unbounded): exceeding "
+                        "it aborts with an aborted failure manifest — the "
+                        "request-deadline primitive")
+    p.add_argument("--no-deadlines", action="store_true",
+                   help="disable the per-lane deadline layer + stall "
+                        "watchdog (deadlines.enabled=false; env "
+                        "SL3D_NO_DEADLINES=1) — waits become unbounded "
+                        "again, as before PR 7")
     add_config_args(p)
 
     p = sub.add_parser(
@@ -451,6 +461,10 @@ def _cmd_pipeline(args) -> int:
         cfg.merge.pair_batch = args.pair_batch
     if args.trace:
         cfg.observability.trace = True
+    if args.run_budget is not None:
+        cfg.pipeline.run_budget_s = args.run_budget
+    if args.no_deadlines:
+        cfg.deadlines.enabled = False
     steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
     report = stages.run_pipeline(args.calib, args.target, args.out, cfg=cfg,
                                  steps=steps, stl_name=args.stl_name)
@@ -479,6 +493,11 @@ def _cmd_pipeline(args) -> int:
         print(f"[pipeline] WARNING: completed DEGRADED — "
               f"{len(report.failed)} view(s) quarantined; see "
               f"{report.manifest_path}", file=sys.stderr)
+    stalls = os.path.join(args.out, "stalls.json")
+    if os.path.exists(stalls):
+        print(f"[pipeline] WARNING: the stall watchdog fired during this "
+              f"run; breach records + thread stacks -> {stalls}",
+              file=sys.stderr)
     return 0
 
 

@@ -15,6 +15,11 @@ renders, on a terminal:
   - the launch/bucket table (views per launch, pair launches)
   - the fault ledger: retries, failures, injected faults, quarantined
     views — merged from journal events and failures.json
+  - the stall ledger: ``watchdog.stall`` breaches, per-lane
+    last-heartbeat ages (from the throttled ``lane.heartbeat`` instants
+    and lane-span ends), and the ``stalls.json`` thread-stack dump the
+    watchdog leaves on a hard breach — the "why did this run hang"
+    answer, readable for clean, DEGRADED, and INTERRUPTED runs alike
 
 Degraded and interrupted runs are first-class: a journal with no ``end``
 marker (crash/kill) reports as INTERRUPTED, torn trailing lines are
@@ -117,6 +122,12 @@ class RunAnalysis:
     critical_path_s: float | None = None
     manifest: dict | None = None   # failures.json payload
     metrics: dict | None = None    # metrics.json payload
+    # stall ledger: watchdog breaches seen in the journal, the last
+    # heartbeat time per lane (span ends + lane.heartbeat instants), and
+    # the stalls.json payload the watchdog persists on a breach
+    stall_events: list[dict] = field(default_factory=list)
+    lane_last_beat: dict[str, float] = field(default_factory=dict)
+    stalls: dict | None = None
 
 
 def _merge_intervals(iv: list[tuple[float, float]]) -> list[tuple[float, float]]:
@@ -162,6 +173,8 @@ def analyze_run(out_dir: str, trace_file: str = "trace.jsonl",
             a.lane_walls[lane] = a.lane_walls.get(lane, 0.0) + dur
             a.lane_spans[lane] = a.lane_spans.get(lane, 0) + 1
             a.lane_intervals.setdefault(lane, []).append((t, t + dur))
+            a.lane_last_beat[lane] = max(a.lane_last_beat.get(lane, 0.0),
+                                         t + dur)
         elif kind == "span" and name == "stage":
             st = ev.get("stage", "?")
             a.stage_walls[st] = a.stage_walls.get(st, 0.0) + dur
@@ -186,6 +199,11 @@ def analyze_run(out_dir: str, trace_file: str = "trace.jsonl",
                 a.injected[site] = a.injected.get(site, 0) + 1
             elif name == "quarantine":
                 a.quarantined.append(ev)
+            elif name == "watchdog.stall":
+                a.stall_events.append(ev)
+            elif name == "lane.heartbeat":
+                ln = ev.get("lane", "?")
+                a.lane_last_beat[ln] = max(a.lane_last_beat.get(ln, 0.0), t)
             elif name == "executor.finish":
                 a.critical_path_s = ev.get("critical_path_s")
     a.wall_s = t_max
@@ -205,6 +223,13 @@ def analyze_run(out_dir: str, trace_file: str = "trace.jsonl",
                 a.manifest = json.load(f)
         except (OSError, ValueError):
             a.manifest = None
+    spath = os.path.join(out_dir, "stalls.json")
+    if os.path.exists(spath):
+        try:
+            with open(spath, encoding="utf-8") as f:
+                a.stalls = json.load(f)
+        except (OSError, ValueError):
+            a.stalls = None
     return a
 
 
@@ -339,6 +364,38 @@ def render_report(a: RunAnalysis, width: int = 60) -> str:
     else:
         L.append("")
         L.append("fault ledger: clean (no retries, failures, or injections)")
+
+    # ---- stall ledger: rendered for clean/DEGRADED/INTERRUPTED alike ----
+    breaches = list(a.stall_events)
+    if a.stalls:
+        # stalls.json is authoritative when present (the journal may have
+        # been truncated before the watchdog event flushed)
+        breaches = a.stalls.get("breaches", breaches)
+    if breaches or a.stalls:
+        L.append("")
+        L.append("stall ledger")
+        for b in breaches:
+            lanes = b.get("lane_ages") or b.get("lanes") or {}
+            lanestr = ", ".join(f"{ln} {age}s ago"
+                                for ln, age in sorted(lanes.items()))
+            L.append(f"  {str(b.get('level', '?')).upper():<5} breach: no "
+                     f"heartbeat for {b.get('age_s', '?')}s"
+                     + (f" (last beats: {lanestr})" if lanestr else ""))
+        if a.lane_last_beat and a.wall_s > 0:
+            ages = ", ".join(
+                f"{ln} {max(0.0, a.wall_s - t):.2f}s"
+                for ln, t in sorted(a.lane_last_beat.items(),
+                                    key=lambda kv: _lane_sort_key(kv[0])))
+            L.append(f"  last-heartbeat age at end of journal: {ages}")
+        if a.stalls:
+            n_stack = len(a.stalls.get("thread_stacks", []))
+            L.append(f"  stalls.json: {len(a.stalls.get('breaches', []))} "
+                     f"breach(es), thread-stack dump "
+                     f"({n_stack} line(s)) — the wedge's stack lives "
+                     f"there")
+    else:
+        L.append("")
+        L.append("stall ledger: clean (no watchdog breaches)")
 
     if a.metrics is None:
         L.append("")

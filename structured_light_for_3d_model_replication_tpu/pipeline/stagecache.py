@@ -50,6 +50,9 @@ import os
 import numpy as np
 
 from structured_light_for_3d_model_replication_tpu.io.atomic import sweep_tmp
+from structured_light_for_3d_model_replication_tpu.utils import (
+    deadline as dl,
+)
 from structured_light_for_3d_model_replication_tpu.utils import faults
 from structured_light_for_3d_model_replication_tpu.utils import telemetry
 
@@ -118,27 +121,55 @@ class StageCache:
         return h.hexdigest()
 
     def keys_parallel(self, stage: str, file_lists: list[list[str]],
-                      config_json: str = "", io_workers: int = 1) -> list[str]:
+                      config_json: str = "", io_workers: int = 1,
+                      timeout_s: float | None = None) -> list[str]:
         """Per-item ``key(stage, files=...)`` for a whole batch, hashed on a
-        thread pool (``key`` is pure, so order-preserving ``pool.map`` is
+        thread pool (``key`` is pure, so order-preserving submission is
         safe). Keying a 24-view 1080p run reads ~2 GB of frame bytes; doing
         it serially stalls the batched executor's first launch behind the
-        hash wall. NOTE: executor/batching knobs (``parallel.compute_batch``,
-        ``shard_views``, ``io_workers``) must NEVER enter ``config_json`` —
-        every execution schedule produces identical bytes, so cached views
-        must hit across schedule changes."""
+        hash wall. ``timeout_s`` bounds the WHOLE keying pass (one shared
+        monotonic deadline): a hung filesystem read raises
+        :class:`~.utils.deadline.DeadlineExceeded` instead of wedging the
+        run before its first stage. NOTE: executor/batching knobs
+        (``parallel.compute_batch``, ``shard_views``, ``io_workers``) must
+        NEVER enter ``config_json`` — every execution schedule produces
+        identical bytes, so cached views must hit across schedule
+        changes."""
         if io_workers > 1 and len(file_lists) > 1:
             from concurrent.futures import ThreadPoolExecutor
 
+            deadline = dl.Deadline.after(timeout_s, "stage-cache keying")
             with ThreadPoolExecutor(
                     max_workers=min(io_workers, len(file_lists)),
                     thread_name_prefix="sl3d-cachekey") as pool:
-                return list(pool.map(
-                    lambda fl: self.key(stage, files=fl,
-                                        config_json=config_json),
-                    file_lists))
-        return [self.key(stage, files=fl, config_json=config_json)
-                for fl in file_lists]
+                futs = [pool.submit(self.key, stage, files=fl,
+                                    config_json=config_json)
+                        for fl in file_lists]
+                try:
+                    out = []
+                    for i, f in enumerate(futs):
+                        rem = (deadline.remaining()
+                               if deadline is not None else None)
+                        if rem is not None and rem <= 0:
+                            # spent budget means expired, never unbounded
+                            raise dl.DeadlineExceeded(
+                                f"{stage} cache keying exceeded its "
+                                f"{timeout_s:g}s budget at key {i}")
+                        out.append(dl.wait_future(
+                            f, rem, what=f"{stage} cache key {i}"))
+                    return out
+                except dl.DeadlineExceeded:
+                    # don't leave the pool's __exit__ blocked on the same
+                    # wedge the deadline just reported
+                    pool.shutdown(wait=False, cancel_futures=True)
+                    raise
+        deadline = dl.Deadline.after(timeout_s, "stage-cache keying")
+        out = []
+        for fl in file_lists:
+            if deadline is not None:
+                deadline.check(f"{stage} cache keying")
+            out.append(self.key(stage, files=fl, config_json=config_json))
+        return out
 
     @staticmethod
     def digest_arrays(**arrays) -> str:

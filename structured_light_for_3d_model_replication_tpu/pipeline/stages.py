@@ -27,6 +27,9 @@ from structured_light_for_3d_model_replication_tpu.io import images as imio
 from structured_light_for_3d_model_replication_tpu.io import matfile, ply
 from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
 from structured_light_for_3d_model_replication_tpu.ops import triangulate as tri
+from structured_light_for_3d_model_replication_tpu.utils import (
+    deadline as dl,
+)
 from structured_light_for_3d_model_replication_tpu.utils import faults
 from structured_light_for_3d_model_replication_tpu.utils import profiling as prof
 from structured_light_for_3d_model_replication_tpu.utils import telemetry as tel
@@ -210,8 +213,49 @@ def _retry_stage(stage: str, fn, policy: faults.RetryPolicy, on_retry=None):
         raise
 
 
+def _lane_budget_s(cfg: Config, lane: str) -> float | None:
+    """The bounded-wait budget for one lane's per-item future, or None
+    (plain blocking wait) when the deadline layer is disabled or the lane
+    budget is 0 — the one flag check of the disabled path."""
+    dcfg = cfg.deadlines
+    if not dcfg.enabled:
+        return None
+    budget = getattr(dcfg, f"{lane}_s", 0.0)
+    if budget <= 0:
+        return None
+    ctx = dl.current()
+    if ctx is not None and ctx.run_deadline is not None:
+        # never wait past the overall run budget either
+        budget = min(budget, max(0.05, ctx.run_deadline.remaining()))
+    return budget
+
+
+def _lane_wait(fut, cfg: Config, lane: str, what: str):
+    """Bounded ``Future.result`` for one lane item: a stalled worker
+    thread costs its item a DeadlineExceeded (annotated with the lane so
+    the FailureRecord names it) instead of hanging the run."""
+    try:
+        return dl.wait_future(fut, _lane_budget_s(cfg, lane), what=what)
+    except dl.DeadlineExceeded as e:
+        faults.annotate(e, stage=lane)
+        raise
+
+
+def _budget_check(what: str) -> None:
+    """Overall ``pipeline.run_budget_s`` check (the ABORT path), called at
+    stage boundaries and executor scheduling steps. One None check when
+    no run context / budget is armed."""
+    ctx = dl.current()
+    if ctx is not None:
+        ctx.check_run_budget(what)
+
+
 def _load_fired(src, cfg: Config):
     """Frame-stack load behind the ``frame.load`` injection site."""
+    # work-STARTED heartbeat (completion beats come from OverlapStats.add):
+    # the watchdog distinguishes "a long load is in progress" from "nothing
+    # has moved at all" by the entry beat
+    dl.beat("load")
     faults.fire("frame.load", item=src)
     return imio.load_stack(src, io_workers=cfg.parallel.io_workers)
 
@@ -219,6 +263,7 @@ def _load_fired(src, cfg: Config):
 def _compute_fired(frames, texture, calib, cfg, scanner, src,
                    async_dispatch=False):
     """Per-view decode+triangulate behind the ``compute.view`` site."""
+    dl.beat("compute")
     faults.fire("compute.view", item=src)
     return _compute_cloud(frames, texture, calib, cfg, scanner,
                           async_dispatch=async_dispatch)
@@ -272,6 +317,7 @@ def _reconstruct_serial(sources, calib, cfg, scanner, mode, output, report,
     timer = prof.StageTimer()
     policy = _retry_policy(cfg)
     for idx, src in enumerate(sources):
+        _budget_check("reconstruct")
         name = _item_name(src)
 
         def on_retry(n, e, _name=name):
@@ -415,6 +461,7 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
                 inflight.append((idx, src, load_pool.submit(load_one, src)))
             next_i = len(inflight)
             while inflight:
+                _budget_check("reconstruct")
                 idx, src, lfut = inflight.popleft()
                 stats.sample_queue(len(inflight))
                 if next_i < len(pending):       # keep the prefetch bound full
@@ -422,18 +469,27 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
                     inflight.append((j, s, load_pool.submit(load_one, s)))
                     next_i += 1
                 try:
-                    frames, texture = lfut.result()
+                    frames, texture = _lane_wait(
+                        lfut, cfg, "load", f"load of {_item_name(src)}")
+                except dl.DeadlineExceeded as e:
+                    # stalled prefetch: abandon THIS view (quarantine
+                    # downstream), keep the batch moving
+                    results[idx] = ("fail", src, e)
+                    continue
                 except Exception as e:
                     results[idx] = ("fail", src, e)
                     continue
                 # backpressure on the compute->drain side too: at most
                 # depth+1 dispatched-but-undrained clouds live at once
                 # (each holds a full uncompacted H*W result on host or in
-                # HBM), so batch size never multiplies peak memory.
-                # Future.exception() blocks without raising — per-item
-                # errors stay with the in-order drain below.
+                # HBM), so batch size never multiplies peak memory. The
+                # settle wait blocks without raising — per-item errors
+                # stay with the in-order drain below, and a stalled drain
+                # stops costing here after its compute budget (the drain
+                # phase then charges the item itself).
                 while len(undrained) > depth:
-                    undrained.popleft().exception()
+                    dl.wait_settled(undrained.popleft(),
+                                    _lane_budget_s(cfg, "compute"))
                 try:
                     t0 = time.perf_counter()
                     cloud = _retry_stage(
@@ -463,10 +519,15 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
                 err: BaseException
                 if kind == "done":
                     try:
-                        out_path, n_pts, wfut = rest[0].result()
+                        out_path, n_pts, wfut = _lane_wait(
+                            rest[0], cfg, "compute", f"drain of {name}")
                         if wfut is not None:
                             try:
-                                wfut.result()   # surface write errors
+                                # surface write errors, bounded: a
+                                # stalled writer costs this view, not
+                                # the run
+                                _lane_wait(wfut, cfg, "write",
+                                           f"write of {name}")
                             except faults.InjectedCrash:
                                 raise
                             except Exception as e:
@@ -488,7 +549,8 @@ def _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output, report,
     finally:
         load_pool.shutdown(wait=False, cancel_futures=True)
         drain_pool.shutdown(wait=False, cancel_futures=True)
-        wbq.close(wait=True)
+        wbq.close(wait=True,
+                  timeout_s=_lane_budget_s(cfg, "drain"))
     stats.finish(time.perf_counter() - t_wall)
     report.overlap = stats.as_dict()
     report.retries += report.overlap.get("retry_total", 0)
@@ -739,10 +801,12 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
                 # double buffer: at most 2 dispatched-but-undrained batches
                 # (each holds bucket x stack on device + its results), so
                 # batch size bounds peak memory instead of multiplying it.
-                # Future.exception() blocks without raising — per-item
-                # errors stay with the in-order assembly below.
+                # The settle wait blocks without raising — per-item errors
+                # stay with the in-order assembly below, which charges a
+                # stalled batch to its own views.
                 while len(batch_futs) >= 2:
-                    batch_futs.popleft().exception()
+                    dl.wait_settled(batch_futs.popleft(),
+                                    _lane_budget_s(cfg, "compute"))
                 dfut = dispatch_batch(list(batch_items))
                 batch_futs.append(dfut)
                 for j, (idx, _src, _f, _t) in enumerate(batch_items):
@@ -750,6 +814,7 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
                 batch_items.clear()
 
             while inflight:
+                _budget_check("reconstruct")
                 idx, src, lfut = inflight.popleft()
                 stats.sample_queue(len(inflight))
                 if next_i < len(pending):     # keep the prefetch window full
@@ -757,7 +822,8 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
                     inflight.append((j, s, load_pool.submit(load_one, s)))
                     next_i += 1
                 try:
-                    frames, texture = lfut.result()
+                    frames, texture = _lane_wait(
+                        lfut, cfg, "load", f"load of {_item_name(src)}")
                 except faults.InjectedCrash:
                     raise
                 except Exception as e:
@@ -778,7 +844,8 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
                 if kind == "batch":
                     dfut, j = rest
                     try:
-                        out = dfut.result()[j]
+                        out = _lane_wait(dfut, cfg, "compute",
+                                         f"batch drain of {name}")[j]
                     except Exception as e:
                         if is_backend_init_error(e):
                             raise
@@ -789,7 +856,8 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
                             try:
                                 if wfut is not None:
                                     try:
-                                        wfut.result()
+                                        _lane_wait(wfut, cfg, "write",
+                                                   f"write of {name}")
                                     except faults.InjectedCrash:
                                         raise
                                     except Exception as e:
@@ -812,7 +880,8 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
     finally:
         load_pool.shutdown(wait=False, cancel_futures=True)
         drain_pool.shutdown(wait=False, cancel_futures=True)
-        wbq.close(wait=True)
+        wbq.close(wait=True,
+                  timeout_s=_lane_budget_s(cfg, "drain"))
     stats.finish(time.perf_counter() - t_wall)
     report.overlap = stats.as_dict()
     report.overlap["compute_batch"] = batch_n
@@ -895,16 +964,40 @@ def reconstruct(calib_path: str, target: str, mode: str = "single",
         report.device_count = jax.device_count()
     if output and mode != "single":
         os.makedirs(output, exist_ok=True)
+    # standalone runs own a deadline context (run_pipeline installs its
+    # own and this respects it — nested arming would shadow the watchdog)
+    ctx = prev_ctx = None
+    dcfg = cfg.deadlines
+    if dcfg.enabled and dl.current() is None:
+        stall_dir = output if output and os.path.isdir(output) else None
+        ctx = dl.RunContext(
+            run_deadline=dl.Deadline.after(cfg.pipeline.run_budget_s,
+                                           "reconstruct run"))
+        if dcfg.hard_stall_s > 0 or dcfg.soft_stall_s > 0:
+            ctx.watchdog = dl.Watchdog(
+                dcfg.soft_stall_s, dcfg.hard_stall_s, ctx.token,
+                poll_s=dcfg.watchdog_poll_s, out_dir=stall_dir,
+                run_id=report.run_id, log=log)
+        prev_ctx = dl.activate(ctx)
+        if ctx.watchdog is not None:
+            ctx.watchdog.start()
     t0 = time.monotonic()
-    if _use_batched(cfg, scanner, len(sources)):
-        _reconstruct_batched(sources, calib, cfg, scanner, mode, output,
-                             report, log)
-    elif cfg.parallel.io_workers > 1 and len(sources) > 1:
-        _reconstruct_pipelined(sources, calib, cfg, scanner, mode, output,
-                               report, log)
-    else:
-        _reconstruct_serial(sources, calib, cfg, scanner, mode, output,
-                            report, log)
+    try:
+        if _use_batched(cfg, scanner, len(sources)):
+            _reconstruct_batched(sources, calib, cfg, scanner, mode, output,
+                                 report, log)
+        elif cfg.parallel.io_workers > 1 and len(sources) > 1:
+            _reconstruct_pipelined(sources, calib, cfg, scanner, mode,
+                                   output, report, log)
+        else:
+            _reconstruct_serial(sources, calib, cfg, scanner, mode, output,
+                                report, log)
+    finally:
+        if ctx is not None:
+            ctx.token.cancel("run ended")
+            if ctx.watchdog is not None:
+                ctx.watchdog.stop()
+            dl.deactivate(prev_ctx)
     report.elapsed_s = time.monotonic() - t0
     log(f"[reconstruct] {report.summary}")
     return report
@@ -1357,6 +1450,7 @@ class _StreamRegistrar:
                                         thread_name_prefix="sl3d-register")
         self._futs: list = []
         self._closed = False
+        self._wedged = False   # bounded close timed out; worker untrusted
         # all state below is mutated only on the register worker until
         # close() drains it; finish()'s catch-up then owns it single-threaded
         self._digests: dict[int, str] = {}
@@ -1378,12 +1472,36 @@ class _StreamRegistrar:
         self._futs.append(self._pool.submit(self._note, i, pts, cols))
 
     def close(self) -> None:
-        """Drain the worker and surface injected crashes. Idempotent."""
+        """Drain the worker and surface injected crashes. Idempotent.
+
+        Bounded when the deadline layer is on: a stalled register worker
+        may delay close by at most ``deadlines.register_s``; past that
+        the lane is marked WEDGED — ``finish`` then falls back to the
+        identity transform for every pair the worker never resolved (the
+        same DEGRADED path a permanently-failed registration takes)
+        instead of racing the still-running worker's state."""
         if self._closed:
             return
         self._closed = True
-        self._pool.shutdown(wait=True)
+        budget = _lane_budget_s(self.cfg, "register")
+        if budget is None:
+            self._pool.shutdown(wait=True)
+        else:
+            self._pool.shutdown(wait=False)
+            deadline = dl.Deadline.after(budget, "register-lane close")
+            for f in self._futs:
+                rem = deadline.remaining()
+                # a spent budget means expired, never unbounded
+                if rem <= 0 or not dl.wait_settled(f, rem):
+                    self._wedged = True
+                    self.log(f"[pipeline] WARNING: register lane still "
+                             f"busy after its {budget:g}s close budget — "
+                             f"abandoning the worker; unresolved pairs "
+                             f"fall back to the identity transform")
+                    break
         for f in self._futs:
+            if not f.done():
+                continue
             e = f.exception()
             if isinstance(e, faults.InjectedCrash):
                 raise e
@@ -1398,20 +1516,32 @@ class _StreamRegistrar:
         ``(T [P,4,4], gfit [P], ifit [P], irmse [P])`` aligned to
         consecutive pairs of ``order``."""
         self.close()
-        for i in order:     # backfill anything a lost feed never recorded
-            if i not in self._digests:
-                pts, cols = collected[i]
-                self._clouds[i] = (pts, cols)
-                self._digests[i] = _stagecache_digest(points=pts, colors=cols)
         pairs = [(p, order[p + 1], order[p]) for p in range(len(order) - 1)]
-        for t in pairs:
-            if t not in self._seen:
-                if t[1] - t[2] > 1:
-                    self.log(f"[pipeline] re-pairing around quarantined "
-                             f"view(s): pair {t[2]}->{t[1]} (chain "
-                             f"position {t[0]}) closes the ring")
-                self._enqueue(*t)
-        self._dispatch()
+        if self._wedged:
+            # the worker may still be mutating its state — do NOT run the
+            # catch-up against it. Every pair it never resolved takes the
+            # identity fallback (DEGRADED, never cached), exactly like a
+            # permanently-failed registration.
+            for t in pairs:
+                if t not in self._done:
+                    self._identity(t, dl.DeadlineExceeded(
+                        "register lane stalled past deadlines.register_s; "
+                        "pair abandoned"))
+        else:
+            for i in order:  # backfill anything a lost feed never recorded
+                if i not in self._digests:
+                    pts, cols = collected[i]
+                    self._clouds[i] = (pts, cols)
+                    self._digests[i] = _stagecache_digest(points=pts,
+                                                          colors=cols)
+            for t in pairs:
+                if t not in self._seen:
+                    if t[1] - t[2] > 1:
+                        self.log(f"[pipeline] re-pairing around quarantined "
+                                 f"view(s): pair {t[2]}->{t[1]} (chain "
+                                 f"position {t[0]}) closes the ring")
+                    self._enqueue(*t)
+            self._dispatch()
         if not pairs:
             z = np.zeros(0, np.float32)
             return np.zeros((0, 4, 4), np.float32), z, z, z
@@ -1424,6 +1554,7 @@ class _StreamRegistrar:
     # ---- register-worker internals ---------------------------------------
 
     def _note(self, i, pts, cols):
+        dl.beat("register")   # worker-liveness heartbeat for the watchdog
         self._digests[i] = _stagecache_digest(points=pts, colors=cols)
         self._clouds[i] = (pts, cols)
         while self._frontier in self._clouds:
@@ -1581,6 +1712,27 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
         prev = tel.activate(tracer)
         log(f"[pipeline] flight recorder armed (run {run_id}) -> "
             f"{tracer.path}")
+    # ---- deadline layer: run context + lane watchdog --------------------
+    # the token+watchdog are the per-item STALL-BREAK path (a stalled
+    # view/pair is quarantined, the run continues DEGRADED); the run
+    # deadline is the end-to-end ABORT path (pipeline.run_budget_s)
+    ctx = prev_ctx = None
+    dcfg = cfg.deadlines
+    if dcfg.enabled:
+        ctx = dl.RunContext(
+            run_deadline=dl.Deadline.after(cfg.pipeline.run_budget_s,
+                                           "pipeline run"))
+        if dcfg.hard_stall_s > 0 or dcfg.soft_stall_s > 0:
+            ctx.watchdog = dl.Watchdog(
+                dcfg.soft_stall_s, dcfg.hard_stall_s, ctx.token,
+                poll_s=dcfg.watchdog_poll_s, out_dir=out_dir,
+                run_id=run_id, log=log)
+        prev_ctx = dl.activate(ctx)
+        if ctx.watchdog is not None:
+            ctx.watchdog.start()
+        if ctx.run_deadline is not None:
+            log(f"[pipeline] run budget armed: "
+                f"{cfg.pipeline.run_budget_s:g}s")
     try:
         report = _run_pipeline_impl(calib_path, target, out_dir, cfg,
                                     tuple(steps), merged_name, stl_name,
@@ -1596,7 +1748,39 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
                 g("sl3d_critical_path_seconds",
                   report.overlap.get("critical_path_s") or 0.0)
         return report
+    except Exception as e:
+        # EVERY abort leaves a manifest (the below-floor path writes its
+        # own richer one first and is not overwritten): a run that ends
+        # early — run budget exceeded, unwritable final artifact — must
+        # be diagnosable from disk, not just from a traceback. The
+        # watchdog's stalls.json lands separately in its stop(); an
+        # InjectedCrash (BaseException) deliberately bypasses this, the
+        # crash-safety contract covers it.
+        mpath = os.path.join(out_dir, "failures.json")
+        if not os.path.exists(mpath):
+            _write_json_atomic(mpath, {
+                "run_id": run_id, "aborted": True, "degraded": False,
+                "reason": str(e),
+                "run_budget_s": cfg.pipeline.run_budget_s,
+                "failures": [faults.FailureRecord.from_exception(
+                    "pipeline", "run", e).as_dict()],
+            })
+            log(f"[pipeline] ABORTED ({type(e).__name__}: {e}); "
+                f"manifest -> {mpath}")
+        raise
     finally:
+        if ctx is not None:
+            # wake any lingering cancel-aware sleeps (injected stalls in
+            # abandoned worker threads) so teardown never outlives them
+            ctx.token.cancel("run ended")
+            if ctx.watchdog is not None:
+                ctx.watchdog.stop()
+                if ctx.watchdog.breaches:
+                    log(f"[pipeline] watchdog recorded "
+                        f"{len(ctx.watchdog.breaches)} stall breach(es)"
+                        + (f" -> {ctx.watchdog.stalls_path}"
+                           if ctx.watchdog.stalls_path else ""))
+            dl.deactivate(prev_ctx)
         if tracer is not None:
             tel.deactivate(prev)
             metrics_path = os.path.join(out_dir,
@@ -1650,6 +1834,14 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
     # startup sweep: a kill -9 in an earlier run leaves *.tmp orphans under
     # the out tree (merged/STL/manifest staging, cache puts); none is data
     atomic.sweep_tmp(out_dir, log=log, recursive=True)
+    # a previous run's stall ledger / failure manifest must not masquerade
+    # as this run's: the watchdog rewrites stalls.json only if THIS run
+    # breaches, and the abort path writes failures.json only for THIS
+    # run's failures (clean completion re-asserts the removal at the end)
+    for stale in ("stalls.json", "failures.json"):
+        p = os.path.join(out_dir, stale)
+        if os.path.exists(p):
+            os.remove(p)
     report = PipelineReport(run_id=run_id)
     cache = StageCache(os.path.join(out_dir, ".slscan-cache"),
                        enabled=cfg.pipeline.cache, log=log,
@@ -1668,7 +1860,8 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
         view_keys = cache.keys_parallel(
             "view",
             [[calib_path] + imio.list_frame_files(src) for src in sources],
-            config_json=view_cfg, io_workers=cfg.parallel.io_workers)
+            config_json=view_cfg, io_workers=cfg.parallel.io_workers,
+            timeout_s=_lane_budget_s(cfg, "cache"))
     for i, src in enumerate(sources):
         hit = cache.get("view", view_keys[i])
         if hit is not None:
@@ -1756,6 +1949,16 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
         report.failures = batch.failures
         report.retries = batch.retries
         report.overlap = batch.overlap
+        if batch.failed:
+            # a lane wait that timed out quarantined its view, but the
+            # abandoned worker may still have completed LATE and handed
+            # the cloud to collect() — a quarantined view must never
+            # also merge (its late cache entry is content-correct and
+            # may stay for reruns)
+            failed_srcs = {s for s, _ in batch.failed}
+            for i, src in missing:
+                if src in failed_srcs:
+                    collected.pop(i, None)
     report.views_computed = len(collected) - report.views_cached
 
     # ---- failure domain: quarantine + degrade-or-abort decision ---------
@@ -1786,6 +1989,12 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
             f"the failed angles.")
 
     # ---- stage 3: merge-360 (device-resident handoff) -------------------
+    _budget_check("merge")
+    # the barrier stages ahead (chain accumulate, Poisson solve) are
+    # single opaque device/numpy calls — no heartbeat can flow from
+    # inside them, so the watchdog pauses here (run budget still covers
+    # the tail; the register catch-up's own waits stay bounded)
+    dl.watchdog_suspend()
     order = sorted(collected)
     view_digests = [StageCache.digest_arrays(points=collected[i][0],
                                              colors=collected[i][1])
@@ -1872,8 +2081,20 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
                 snap[k] = report.overlap[k]
         report.overlap = snap
     t_wm = time.perf_counter()
-    ply.write_ply(merged_path, points, colors,
-                  binary=not cfg.pipeline.ascii_output)
+
+    def _final_write_retry(n, e):
+        # the final artifacts sit behind the same transient budget as the
+        # per-view lanes: a blip at the very last write must not cost a
+        # completed scan (soak finding — an unmatched ply.write:transient
+        # previously aborted the whole run here)
+        report.retries += 1
+        log(f"[pipeline] transient {type(e).__name__} writing a final "
+            f"artifact ({e}); retry {n}")
+
+    _retry_stage("write",
+                 lambda: ply.write_ply(merged_path, points, colors,
+                                       binary=not cfg.pipeline.ascii_output),
+                 _retry_policy(cfg), _final_write_retry)
     if _tr is not None:
         _tr.span_end("write.merged", time.perf_counter() - t_wm,
                      points=len(points))
@@ -1882,6 +2103,7 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
     report.merged_points = len(points)
 
     # ---- stage 4: mesh -> STL ------------------------------------------
+    _budget_check("mesh")
     t_mesh = time.perf_counter()
     merged_digest = StageCache.digest_arrays(points=points)
     mesh_key = cache.key("mesh", digests=[merged_digest],
@@ -1896,7 +2118,9 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
         cache.put("mesh", mesh_key, verts=verts, faces=faces)
         report.mesh_status = "computed"
     stl_path = os.path.join(out_dir, stl_name)
-    _write_mesh(stl_path, verts, faces, log=log)
+    _retry_stage("write", lambda: _write_mesh(stl_path, verts, faces,
+                                              log=log),
+                 _retry_policy(cfg), _final_write_retry)
     if _tr is not None:
         _tr.span_end("mesh", time.perf_counter() - t_mesh,
                      status=report.mesh_status, verts=len(verts),

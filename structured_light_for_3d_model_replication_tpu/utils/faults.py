@@ -35,11 +35,11 @@ Fault-spec grammar (comma-separated rules)::
 
     site[~substr]:kind[@n][xM][%p]
 
-    kind     transient | permanent | crash
+    kind     transient | permanent | crash | stall[(T)] | slow[(T)]
     ~substr  only fire() calls whose item contains substr count as hits
     @n       arm on the n-th matching hit (1-based; default 1)
-    xM       fire at most M times (default: 1 for transient/crash,
-             unlimited for permanent)
+    xM       fire at most M times (default: 1 for transient/crash/
+             stall/slow, unlimited for permanent)
     %p       each armed hit fires with probability p (seeded RNG)
 
 Examples::
@@ -49,11 +49,23 @@ Examples::
     ply.write:transient@2x3              writes 2,3,4 fail
     cache.get:transient%0.5              each lookup fails with p=.5 (seeded)
     ply.write~merged:crash               simulated kill -9 at the merged write
+    register.pair:stall(2.5)             first pair registration hangs 2.5s
+    frame.load~072deg:slow(0.5)          view 072deg's load straggles 0.5s
 
 ``transient``/``permanent`` raise ordinary exceptions the retry/quarantine
 machinery handles; ``crash`` raises :class:`InjectedCrash` (a BaseException,
 like KeyboardInterrupt) that no per-item handler may swallow — the
 interrupt-mid-stage simulation for crash-safety tests.
+
+``stall``/``slow`` model faults that do not raise at all: the ``fire()``
+call BLOCKS for T seconds (defaults: ``STALL_DEFAULT_S``/``SLOW_DEFAULT_S``)
+and then returns normally, as if the wedge resolved. Both are cancel-aware
+(:func:`~.deadline.sleep_cancellable`): a watchdog hard breach cancels the
+run token and the sleeping site raises :class:`~.deadline.Cancelled`
+instead — so injected hangs are always bounded and chaos tests terminate.
+``stall`` is the hang the deadline layer must catch (pick T above the
+lane's deadline); ``slow`` is the straggler that must trip only the SOFT
+watchdog threshold and still complete.
 """
 from __future__ import annotations
 
@@ -65,13 +77,16 @@ import time
 import urllib.error
 from dataclasses import dataclass, field
 
+from structured_light_for_3d_model_replication_tpu.utils import (
+    deadline as dl,
+)
 from structured_light_for_3d_model_replication_tpu.utils import telemetry
 
 __all__ = [
     "InjectedFault", "TransientFault", "PermanentFault", "InjectedCrash",
     "FaultRule", "FaultPlan", "configure", "configure_from", "reset", "fire",
     "active_plan", "is_transient", "RetryPolicy", "retry_call", "annotate",
-    "FailureRecord",
+    "FailureRecord", "STALL_DEFAULT_S", "SLOW_DEFAULT_S",
 ]
 
 
@@ -107,7 +122,13 @@ class InjectedCrash(BaseException):
 # the fault plan
 # ---------------------------------------------------------------------------
 
-_KINDS = ("transient", "permanent", "crash")
+_KINDS = ("transient", "permanent", "crash", "stall", "slow")
+
+# default block durations for the non-raising kinds when no ``(T)`` is
+# given. Long enough to trip production-default lane deadlines / the
+# watchdog; chaos tests pass explicit small durations
+STALL_DEFAULT_S = 30.0
+SLOW_DEFAULT_S = 1.0
 
 
 @dataclass
@@ -118,6 +139,7 @@ class FaultRule:
     arm_at: int = 1          # start firing on the n-th matching hit
     times: float = math.inf  # how many times to fire once armed
     prob: float = 1.0        # per-armed-hit probability (seeded)
+    duration_s: float | None = None  # stall/slow block time (None=default)
     hits: int = 0
     fired: int = 0
 
@@ -131,19 +153,34 @@ class FaultRule:
         if "%" in kind:
             kind, p = kind.split("%", 1)
             prob = float(p)
-        if "x" in kind:
+        if "x" in kind:     # no kind name or (T) digits contain an 'x'
             kind, m = kind.split("x", 1)
             times = int(m)
         if "@" in kind:
             kind, n = kind.split("@", 1)
             arm_at = int(n)
+        duration = None
+        if kind.endswith(")") and "(" in kind:
+            kind, d = kind[:-1].split("(", 1)
+            duration = float(d)
         if kind not in _KINDS:
             raise ValueError(
                 f"fault rule {text!r}: kind {kind!r} not in {_KINDS}")
+        if duration is not None and kind not in ("stall", "slow"):
+            raise ValueError(
+                f"fault rule {text!r}: only stall/slow take a (T) duration")
         if times is None:
             times = math.inf if kind == "permanent" else 1
         return cls(site=site.strip(), kind=kind, match=match,
-                   arm_at=arm_at, times=times, prob=prob)
+                   arm_at=arm_at, times=times, prob=prob,
+                   duration_s=duration)
+
+    @property
+    def block_s(self) -> float:
+        """Effective block duration for the stall/slow kinds."""
+        if self.duration_s is not None:
+            return self.duration_s
+        return STALL_DEFAULT_S if self.kind == "stall" else SLOW_DEFAULT_S
 
     def throw(self) -> None:
         detail = (f"injected {self.kind} fault at {self.site}"
@@ -172,6 +209,10 @@ class FaultPlan:
 
     def fire(self, site: str, item=None) -> None:
         text = "" if item is None else str(item)
+        hit: FaultRule | None = None
+        # decide under the lock, act OUTSIDE it: a stall/slow rule sleeps
+        # for seconds, and holding the plan lock through that would
+        # serialize every other lane's fire() behind the injected wedge
         with self._lock:
             for rule in self.rules:
                 if rule.site != site:
@@ -184,13 +225,30 @@ class FaultPlan:
                 if rule.prob < 1.0 and self._rng.random() > rule.prob:
                     continue
                 rule.fired += 1
-                tr = telemetry.current()
-                if tr is not None:
-                    # chaos runs leave their injections in the journal, so
-                    # the fault ledger needs no log scraping
-                    tr.instant("fault.injected", site=site, kind=rule.kind,
-                               item=text or None)
-                rule.throw()
+                hit = rule
+                break
+        if hit is None:
+            return
+        tr = telemetry.current()
+        if tr is not None:
+            # chaos runs leave their injections in the journal, so
+            # the fault ledger needs no log scraping
+            tr.instant("fault.injected", site=site, kind=hit.kind,
+                       item=text or None,
+                       duration_s=(hit.block_s
+                                   if hit.kind in ("stall", "slow")
+                                   else None))
+        if hit.kind in ("stall", "slow"):
+            # block, then RESUME normally (a wedge that eventually
+            # resolves); cancel-aware so a watchdog hard breach raises
+            # deadline.Cancelled out of the sleep and the item is
+            # abandoned instead of waiting out the full duration
+            dl.sleep_cancellable(
+                hit.block_s,
+                what=f"injected {hit.kind} at {site}"
+                     + (f" ({text})" if text else ""))
+            return
+        hit.throw()
 
     def counts(self) -> dict[str, int]:
         """Fired-per-site accounting (for manifests and assertions)."""
@@ -264,7 +322,14 @@ def is_transient(exc: BaseException) -> bool:
     deterministic failure just delays the quarantine decision."""
     if isinstance(exc, InjectedFault):
         return exc.transient
+    if isinstance(exc, dl.Cancelled):
+        # a cancelled item was abandoned by the watchdog/run teardown;
+        # retrying would re-enter the wedge the cancel just broke
+        return False
     if isinstance(exc, (ConnectionError, TimeoutError)):
+        # includes deadline.DeadlineExceeded (a TimeoutError subclass):
+        # hitting a deadline is a scheduling outcome, not proof the item
+        # is poisoned, so a retry budget MAY be spent on it
         return True
     if isinstance(exc, urllib.error.URLError):
         # wraps socket-level failures; the HTTP capture path's blip class

@@ -23,6 +23,9 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from structured_light_for_3d_model_replication_tpu.utils import (
+    deadline as _deadline,
+)
 from structured_light_for_3d_model_replication_tpu.utils import telemetry
 
 __all__ = ["StageTimer", "OverlapStats", "trace", "get_logger",
@@ -215,6 +218,11 @@ class OverlapStats:
         with self._lock:
             self._stage_s[stage] += elapsed_s
             self._items += items
+        # lane heartbeat for the stall watchdog — emitted from the SAME
+        # call that accumulates the lane wall (the telemetry can't-drift
+        # pattern), so liveness and accounting cannot disagree. One None
+        # check when no watchdog is armed.
+        _deadline.beat(stage)
         tr = telemetry.current()
         if tr is not None:
             tr.lane(stage, elapsed_s, view=view)
@@ -270,6 +278,7 @@ class OverlapStats:
             self._pair_launches += 1
             self._pairs_dispatched += n
             self._stage_s["register"] += dispatch_s
+        _deadline.beat("register")
         tr = telemetry.current()
         if tr is not None:
             # the register wall includes launch dispatch — mirror it as a

@@ -39,8 +39,10 @@ Journal schema (``sl3d-trace-v1``) — one JSON object per line:
   instant  {"type":"instant","ev":<name>,"t","th", event fields...}
            wired events: lane.retry, lane.failure, cache.hit/miss/evict/
            put_error, launch (views/bucket/dispatch_s), pair_launch,
-           pair.identity, fault.injected (site/kind), retry, quarantine,
-           executor.finish (critical_path_s)
+           pair.identity, fault.injected (site/kind[/duration_s]), retry,
+           quarantine, executor.finish (critical_path_s), lane.heartbeat
+           (throttled liveness marker, >=1/s per lane while a watchdog is
+           armed), watchdog.stall (level=soft|hard, age_s, lane ages)
   end      last line on a clean close: {"type":"end","t","events"}
 
 The ``lane`` spans are emitted from *inside* ``OverlapStats.add`` /
@@ -329,6 +331,8 @@ class Tracer:
         elif ev == "fault.injected":
             reg.inc("sl3d_faults_injected_total", site=fields.get("site"),
                     kind=fields.get("kind"))
+        elif ev == "watchdog.stall":
+            reg.inc("sl3d_stalls_total", level=fields.get("level"))
         self._emit(self._clean(
             {"type": "instant", "ev": ev, "t": round(self.now(), 6),
              "th": threading.current_thread().name, **fields}))

@@ -1,0 +1,474 @@
+"""ISSUE 7: deadlines everywhere — monotonic Deadline/CancelToken units,
+stall/slow fault-grammar kinds, the lane watchdog's soft/hard breach
+protocol, bounded writeback drain, token-cancellation propagation, the
+stall-at-every-site matrix (the analog of PR 3's crash-at-every-site),
+the run-budget abort, and the report's stall ledger."""
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.cli import main as cli_main
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io import ply as plyio
+from structured_light_for_3d_model_replication_tpu.pipeline import (
+    report as replib,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import (
+    deadline as dl,
+)
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
+STEPS = ("statistical",)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("dlds"))
+    rc = cli_main(["synth", root, "--views", "3",
+                   "--cam", "160x120", "--proj", "128x64"])
+    assert rc == 0
+    return root
+
+
+def _cfg(**dl_overrides) -> Config:
+    # deliberately cheap numerics: these tests assert TERMINATION and
+    # failure-routing, not merge quality — the parity suites own that
+    cfg = Config()
+    cfg.parallel.backend = "numpy"
+    cfg.decode.n_cols, cfg.decode.n_rows = 128, 64
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 256
+    cfg.merge.icp_iters = 5
+    cfg.mesh.depth = 4
+    cfg.mesh.density_trim_quantile = 0.0
+    for k, v in dl_overrides.items():
+        setattr(cfg.deadlines, k, v)
+    return cfg
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_and_ctx_state():
+    yield
+    faults.reset()
+    dl.deactivate(None)
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+def test_deadline_monotonic_math_and_classification():
+    d = dl.Deadline.after(0.2, "unit")
+    assert d is not None and not d.expired
+    assert 0.0 < d.remaining() <= 0.2
+    d.check()  # not expired: no raise
+    # the 0 == unbounded convention
+    assert dl.Deadline.after(0.0) is None
+    assert dl.Deadline.after(None) is None
+    expired = dl.Deadline.after(1e-6)
+    time.sleep(0.01)
+    assert expired.expired
+    with pytest.raises(dl.DeadlineExceeded):
+        expired.check("tiny op")
+    # classification contract: deadline hits are transient (scheduling
+    # outcomes), cancellations are permanent (abandon, never retry)
+    assert faults.is_transient(dl.DeadlineExceeded("x")) is True
+    assert faults.is_transient(dl.Cancelled("x")) is False
+    assert isinstance(dl.DeadlineExceeded("x"), TimeoutError)
+
+
+def test_cancel_token_level_semantics():
+    t = dl.CancelToken()
+    assert not t.cancelled
+    t.check()  # no raise while low
+    t.cancel("stop it")
+    assert t.cancelled and t.reason == "stop it"
+    with pytest.raises(dl.Cancelled, match="stop it"):
+        t.check("an op")
+    t.clear()  # the watchdog's progress-resumed path lowers the level
+    assert not t.cancelled
+    t.check()
+
+
+def test_wait_future_bounds_and_disambiguates_timeouts():
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        # bounded: a slow future raises DeadlineExceeded at the budget
+        slow = pool.submit(time.sleep, 1.0)
+        t0 = time.monotonic()
+        with pytest.raises(dl.DeadlineExceeded):
+            dl.wait_future(slow, 0.1, what="slow sleep")
+        assert time.monotonic() - t0 < 0.9
+        # a work function that ITSELF raises TimeoutError must propagate
+        # as that error, never loop as an unexpired poll window
+
+        def raises_timeout():
+            raise TimeoutError("from the work")
+
+        bad = pool.submit(raises_timeout)
+        with pytest.raises(TimeoutError, match="from the work"):
+            dl.wait_future(bad, 5.0)
+        # results pass through; settle-wait never raises the work error
+        ok = pool.submit(lambda: 42)
+        assert dl.wait_future(ok, 5.0) == 42
+        assert dl.wait_settled(bad, 1.0) is True
+        still = pool.submit(time.sleep, 0.5)
+        assert dl.wait_settled(still, 0.05) is False
+
+
+# ---------------------------------------------------------------------------
+# fault grammar: stall / slow
+# ---------------------------------------------------------------------------
+
+def test_fault_grammar_parses_stall_and_slow():
+    r = faults.FaultRule.parse("register.pair~3->4:stall(2.5)@2x3")
+    assert (r.site, r.kind, r.match) == ("register.pair", "stall", "3->4")
+    assert r.duration_s == 2.5 and r.arm_at == 2 and r.times == 3
+    r2 = faults.FaultRule.parse("frame.load:slow(0.25)")
+    assert r2.kind == "slow" and r2.block_s == 0.25 and r2.times == 1
+    r3 = faults.FaultRule.parse("cache.get:stall")
+    assert r3.block_s == faults.STALL_DEFAULT_S
+    with pytest.raises(ValueError):  # only stall/slow take a duration
+        faults.FaultRule.parse("frame.load:transient(2)")
+
+
+def test_stall_blocks_then_resumes_and_slow_is_a_straggler():
+    faults.configure("a.site:stall(0.15),b.site:slow(0.1)")
+    t0 = time.monotonic()
+    faults.fire("a.site")     # blocks ~0.15s, then returns normally
+    stall_wall = time.monotonic() - t0
+    assert 0.1 <= stall_wall < 1.0
+    t0 = time.monotonic()
+    faults.fire("b.site")
+    assert 0.05 <= time.monotonic() - t0 < 1.0
+    # both fired exactly once and are exhausted (times=1 default)
+    assert faults.active_plan().counts() == {"a.site": 1, "b.site": 1}
+    faults.fire("a.site")     # no block on the second hit
+    assert faults.active_plan().counts()["a.site"] == 1
+
+
+def test_stall_is_cancel_aware_via_ambient_token():
+    ctx = dl.RunContext()
+    prev = dl.activate(ctx)
+    try:
+        faults.configure("a.site:stall(30)")
+        threading.Timer(0.1, lambda: ctx.token.cancel("watchdog")).start()
+        t0 = time.monotonic()
+        with pytest.raises(dl.Cancelled, match="watchdog"):
+            faults.fire("a.site")
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        dl.deactivate(prev)
+
+
+# ---------------------------------------------------------------------------
+# the watchdog
+# ---------------------------------------------------------------------------
+
+def _wait_for(cond, timeout=5.0):
+    t_end = time.monotonic() + timeout
+    while time.monotonic() < t_end:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_watchdog_soft_then_hard_breach_and_recovery(tmp_path):
+    token = dl.CancelToken()
+    logs = []
+    w = dl.Watchdog(0.1, 0.25, token, poll_s=0.02, out_dir=str(tmp_path),
+                    run_id="testrun", log=logs.append)
+    w.start()
+    try:
+        # silence -> soft breach first, then hard: token raised + stack
+        # dump persisted
+        assert _wait_for(lambda: any(b["level"] == "soft"
+                                     for b in w.breaches))
+        assert _wait_for(lambda: token.cancelled)
+        assert any(b["level"] == "hard" for b in w.breaches)
+        stalls = tmp_path / "stalls.json"
+        assert _wait_for(stalls.exists)
+        payload = json.loads(stalls.read_text())
+        assert payload["schema"] == dl.STALLS_SCHEMA
+        assert payload["run_id"] == "testrun"
+        assert payload["breaches"] and payload["thread_stacks"]
+        # some thread's stack really is in the dump
+        assert any("Thread" in ln or "File" in ln
+                   for ln in payload["thread_stacks"])
+        # progress resumes -> the cancel LEVEL drops (stall-break, not
+        # run abort)
+        w.beat("load")
+        assert _wait_for(lambda: not token.cancelled)
+        assert any("HARD STALL" in m for m in logs)
+        assert any("possible stall" in m for m in logs)
+    finally:
+        w.stop()
+
+
+def test_watchdog_suspend_covers_barrier_stages():
+    token = dl.CancelToken()
+    w = dl.Watchdog(0.08, 0.0, token, poll_s=0.02)
+    w.start()
+    try:
+        w.suspend()       # a barrier stage: silence is expected
+        time.sleep(0.3)
+        assert not w.breaches
+        w.resume()        # suspended time must not count as silence
+        assert _wait_for(lambda: bool(w.breaches))  # real silence now does
+    finally:
+        w.stop()
+
+
+# ---------------------------------------------------------------------------
+# bounded writeback drain (satellite)
+# ---------------------------------------------------------------------------
+
+def test_writeback_drain_is_bounded_by_timeout(tmp_path):
+    import numpy as np
+
+    faults.configure("ply.write~stuck:stall(1.5)")
+    q = plyio.WritebackQueue()
+    try:
+        pts = np.zeros((4, 3), np.float32)
+        q.submit(str(tmp_path / "stuck.ply"), pts)
+        q.submit(str(tmp_path / "fine.ply"), pts)
+        t0 = time.monotonic()
+        with pytest.raises(plyio.PlyWriteError) as ei:
+            q.drain(timeout_s=0.3)
+        wall = time.monotonic() - t0
+        assert wall < 1.2, "drain must not wait out the stalled writer"
+        assert "stuck.ply" in str(ei.value)
+        assert "pending" in str(ei.value)
+        errors = dict(ei.value.errors)
+        assert isinstance(errors[str(tmp_path / "stuck.ply")],
+                          dl.DeadlineExceeded)
+    finally:
+        faults.reset()
+        q.close(wait=True, timeout_s=0.2)
+    # unbounded drain still works once the stall resolves
+    q2 = plyio.WritebackQueue()
+    with q2:
+        import numpy as np
+
+        f = q2.submit(str(tmp_path / "ok.ply"), np.zeros((4, 3), "f4"))
+        assert q2.drain() == [str(tmp_path / "ok.ply")]
+        assert f.done()
+
+
+# ---------------------------------------------------------------------------
+# token propagation: the acquire sweep stops cleanly
+# ---------------------------------------------------------------------------
+
+def test_auto_scan_360_cancels_cleanly_between_views(tmp_path):
+    from structured_light_for_3d_model_replication_tpu.acquire.autoscan import (
+        auto_scan_360,
+    )
+    from structured_light_for_3d_model_replication_tpu.acquire.turntable import (
+        LoopbackTurntable,
+    )
+
+    class Seq:
+        def capture_scan(self, view_dir):
+            os.makedirs(view_dir, exist_ok=True)
+
+    token = dl.CancelToken()
+    logs = []
+
+    def progress(info):
+        if info["view"] == 2:
+            token.cancel("operator hit stop")
+
+    res = auto_scan_360(Seq(), LoopbackTurntable(), str(tmp_path / "sweep"),
+                        turns=6, step_deg=60.0, progress=progress,
+                        token=token, log=logs.append)
+    # views 1 and 2 captured; the token raised during view 2's progress
+    # stops the sweep BEFORE view 3 — nothing half-captures
+    assert len(res.view_dirs) == 2
+    assert any("cancelled" in m and "operator hit stop" in m for m in logs)
+
+
+# ---------------------------------------------------------------------------
+# pipeline integration: stall-at-every-site matrix + run budget
+# ---------------------------------------------------------------------------
+
+_MATRIX_SITES = ["frame.load", "compute.view", "ply.write~merged",
+                 "cache.get", "cache.put", "register.pair"]
+
+
+@pytest.mark.parametrize("site", _MATRIX_SITES)
+def test_stall_at_any_site_terminates_the_run(dataset, tmp_path, site):
+    """ISSUE 7 acceptance (the crash-at-every-site analog): a seeded stall
+    at EVERY existing fault site terminates the run — quarantine+DEGRADED
+    where a bounded per-item wait guards the site, clean completion where
+    the stall self-resolves inside its bound — never a hang. Worker-thread
+    sites additionally pin down the deterministic outcome."""
+    out = str(tmp_path / "out")
+    calib = os.path.join(dataset, "calib.mat")
+    cfg = _cfg(load_s=0.3, write_s=0.5, register_s=0.6, drain_s=1.0,
+               soft_stall_s=3.0, hard_stall_s=10.0, watchdog_poll_s=0.1)
+    faults.configure(f"{site}:stall(0.8)")
+    t0 = time.monotonic()
+    try:
+        rep = stages.run_pipeline(calib, dataset, out, cfg=cfg,
+                                  steps=STEPS, log=lambda m: None)
+    finally:
+        faults.reset()
+    wall = time.monotonic() - t0
+    assert wall < 120.0, f"stall at {site} cost {wall:.0f}s — unbounded?"
+    # the run terminated with consistent artifacts either way
+    assert rep.stl_path and os.path.getsize(rep.stl_path) > 0
+    assert plyio.read_ply(rep.merged_ply)["points"].shape[0] > 0
+    if rep.degraded:
+        assert rep.manifest_path and os.path.exists(rep.manifest_path)
+        for r in rep.failures:
+            assert r.error_type in ("DeadlineExceeded", "Cancelled")
+    if site == "frame.load":
+        # a stalled prefetch is guarded by a bounded worker-future wait:
+        # deterministic DeadlineExceeded quarantine, run DEGRADED
+        assert rep.degraded and len(rep.failures) == 1
+        assert rep.failures[0].error_type == "DeadlineExceeded"
+        assert rep.failures[0].stage == "load"
+        assert rep.views_computed == 2
+
+
+def test_slow_straggler_completes_clean_and_trips_only_soft(dataset,
+                                                            tmp_path):
+    out = str(tmp_path / "out")
+    calib = os.path.join(dataset, "calib.mat")
+    cfg = _cfg(soft_stall_s=60.0, hard_stall_s=300.0)
+    faults.configure("frame.load:slow(0.3)")
+    try:
+        rep = stages.run_pipeline(calib, dataset, out, cfg=cfg,
+                                  steps=STEPS, log=lambda m: None)
+    finally:
+        faults.reset()
+    assert not rep.degraded and rep.failed == []
+    assert not os.path.exists(os.path.join(out, "stalls.json"))
+
+
+def test_run_budget_aborts_with_manifest(dataset, tmp_path):
+    out = str(tmp_path / "out")
+    calib = os.path.join(dataset, "calib.mat")
+    cfg = _cfg()
+    cfg.pipeline.run_budget_s = 0.05
+    with pytest.raises(dl.DeadlineExceeded):
+        stages.run_pipeline(calib, dataset, out, cfg=cfg, steps=STEPS,
+                            log=lambda m: None)
+    mpath = os.path.join(out, "failures.json")
+    assert os.path.exists(mpath)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    assert manifest["aborted"] is True
+    assert manifest["run_budget_s"] == 0.05
+    assert manifest["failures"][0]["error_type"] == "DeadlineExceeded"
+
+
+def test_disabled_layer_keeps_bare_blocking_waits(dataset, tmp_path):
+    """deadlines.enabled=false: no run context is installed, no watchdog
+    thread runs, and the run completes exactly as before PR 7."""
+    out = str(tmp_path / "out")
+    calib = os.path.join(dataset, "calib.mat")
+    cfg = _cfg(enabled=False)
+    before = {t.name for t in threading.enumerate()}
+    rep = stages.run_pipeline(calib, dataset, out, cfg=cfg, steps=STEPS,
+                              log=lambda m: None)
+    after = {t.name for t in threading.enumerate()}
+    assert rep.failed == []
+    assert "sl3d-watchdog" not in (after - before)
+    assert not os.path.exists(os.path.join(out, "stalls.json"))
+
+
+# ---------------------------------------------------------------------------
+# report: the stall ledger
+# ---------------------------------------------------------------------------
+
+def test_report_renders_stall_ledger(tmp_path):
+    out = tmp_path / "run"
+    out.mkdir()
+    lines = [
+        {"type": "meta", "schema": "sl3d-trace-v1", "run_id": "r1",
+         "t0_unix": 0.0, "host_cpus": 1, "backend": "numpy"},
+        {"type": "span", "ev": "lane", "lane": "load", "t": 0.1,
+         "dur": 0.5, "th": "w"},
+        {"type": "instant", "ev": "lane.heartbeat", "lane": "compute",
+         "t": 0.8, "th": "m"},
+        {"type": "instant", "ev": "watchdog.stall", "level": "soft",
+         "age_s": 1.2, "lanes": {"load": 1.2}, "t": 1.9, "th": "wd"},
+        {"type": "instant", "ev": "watchdog.stall", "level": "hard",
+         "age_s": 2.4, "lanes": {"load": 2.4}, "t": 3.1, "th": "wd"},
+    ]
+    with open(out / "trace.jsonl", "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+    (out / "stalls.json").write_text(json.dumps({
+        "schema": dl.STALLS_SCHEMA, "run_id": "r1",
+        "soft_stall_s": 1.0, "hard_stall_s": 2.0,
+        "breaches": [{"level": "hard", "age_s": 2.4,
+                      "lane_ages": {"load": 2.4}}],
+        "thread_stacks": ["Thread 0x1 (sl3d-prefetch):",
+                          '  File "x.py", line 1, in f'],
+    }))
+    a = replib.analyze_run(str(out))
+    assert len(a.stall_events) == 2
+    assert a.stalls and a.stalls["breaches"]
+    assert a.lane_last_beat["load"] == pytest.approx(0.6)
+    assert a.lane_last_beat["compute"] == pytest.approx(0.8)
+    text = replib.render_report(a)
+    assert "stall ledger" in text
+    assert "HARD" in text and "thread-stack dump" in text
+    assert "last-heartbeat age" in text
+    # an INTERRUPTED run (no end marker) still renders it
+    assert "INTERRUPTED" in text
+
+
+def test_report_stall_ledger_clean_line(tmp_path):
+    out = tmp_path / "run"
+    out.mkdir()
+    with open(out / "trace.jsonl", "w") as f:
+        f.write(json.dumps({"type": "meta", "schema": "sl3d-trace-v1",
+                            "run_id": "r2", "t0_unix": 0.0}) + "\n")
+        f.write(json.dumps({"type": "end", "t": 1.0}) + "\n")
+    text = replib.render_report(replib.analyze_run(str(out)))
+    assert "stall ledger: clean" in text
+
+
+# ---------------------------------------------------------------------------
+# wall-clock sweep satellite
+# ---------------------------------------------------------------------------
+
+def test_await_pose_selection_timeout_is_monotonic_bounded(tmp_path):
+    from structured_light_for_3d_model_replication_tpu.acquire.viewer import (
+        await_pose_selection,
+    )
+
+    t0 = time.monotonic()
+    assert await_pose_selection(str(tmp_path), timeout=0.15,
+                                poll=0.02) is None
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_no_wall_clock_deadline_arithmetic_in_package():
+    """The sweep satellite, kept honest: no `time.time() +` deadline
+    arithmetic anywhere in the package (monotonic is the convention;
+    time.time() remains fine for timestamps)."""
+    import re
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(
+        stages.__file__)))
+    offenders = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fn)
+            with open(p, encoding="utf-8") as f:
+                src = f.read()
+            if re.search(r"time\.time\(\)\s*\+", src):
+                offenders.append(p)
+    assert offenders == []

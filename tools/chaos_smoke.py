@@ -12,6 +12,12 @@ permanent ``compute.view`` fault (must quarantine that view) — and runs
   - the merged STL exists (4 of 5 views merged)
   - the quarantine folder holds the failed view's record
 
+A second run (ISSUE 7) injects a ``stall`` — a load that simply never
+returns for longer than its lane deadline — into a FRESH out dir and
+asserts the deadline layer's contract: the run still exits 0, the stalled
+view is quarantined with a ``DeadlineExceeded`` record, and the STL
+ships.
+
 Prints ``CHAOS_SMOKE=ok`` (exit 0) or ``CHAOS_SMOKE=FAIL (...)`` (exit 1).
 """
 import json
@@ -87,9 +93,47 @@ def main() -> int:
         qrec = os.path.join(out, "quarantine", f"{rec['view']}.json")
         if not os.path.exists(qrec):
             return fail(f"quarantine record missing: {qrec}")
+
+        # ---- stall case (ISSUE 7): a load that hangs past its lane
+        # deadline must be quarantined like a permanent failure, never
+        # hang the run. Fresh out dir: run 1's view cache would otherwise
+        # satisfy every load and the site would never fire.
+        os.environ["SL3D_FAULTS"] = "frame.load~072deg:stall(1.5)"
+        out2 = os.path.join(tmp, "out_stall")
+        rc = cli_main([
+            "pipeline", root, "--out", out2,
+            "--calib", os.path.join(root, "calib.mat"),
+            "--steps", "statistical",
+            "--set", "parallel.backend=numpy",
+            "--set", "decode.n_cols=128", "--set", "decode.n_rows=64",
+            "--set", "decode.thresh_mode=manual",
+            "--set", "merge.voxel_size=4.0",
+            "--set", "merge.ransac_trials=512",
+            "--set", "merge.icp_iters=10",
+            "--set", "mesh.depth=5",
+            "--set", "mesh.density_trim_quantile=0",
+            "--set", "deadlines.load_s=0.4",
+        ])
+        if rc != 0:
+            return fail(f"stall pipeline rc={rc} (a stalled load must "
+                        f"degrade, not hang or abort)")
+        stl2 = os.path.join(out2, "model.stl")
+        if not os.path.exists(stl2) or os.path.getsize(stl2) == 0:
+            return fail("merged STL missing after stalled run")
+        with open(os.path.join(out2, "failures.json")) as f:
+            manifest2 = json.load(f)
+        recs = manifest2.get("failures", [])
+        if len(recs) != 1 or "072deg" not in recs[0]["view"]:
+            return fail(f"stall case: expected 1 quarantined 072deg view, "
+                        f"got {[(r['view'], r['error_type']) for r in recs]}")
+        if recs[0]["error_type"] != "DeadlineExceeded":
+            return fail(f"stall case: expected DeadlineExceeded, got "
+                        f"{recs[0]['error_type']}")
+
         print(f"CHAOS_SMOKE=ok (1 view quarantined, "
               f"{manifest['retries']} retry(ies), STL "
-              f"{os.path.getsize(stl)} bytes from 4/5 views)")
+              f"{os.path.getsize(stl)} bytes from 4/5 views; stall case: "
+              f"1 DeadlineExceeded quarantine, STL shipped)")
         return 0
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

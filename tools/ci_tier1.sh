@@ -72,7 +72,9 @@ else
 fi
 
 # ---- chaos smoke: seeded fault plan (1 transient + 1 permanent over 5
-# views) must retry, quarantine, and still ship the STL with exit 0 ----
+# views) must retry, quarantine, and still ship the STL with exit 0;
+# plus the ISSUE-7 stall case (a load hanging past its lane deadline
+# must quarantine as DeadlineExceeded, never hang the run) ----
 chaos_rc=0
 chaos=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py 2>&1) || chaos_rc=$?
 echo "$chaos" > tools/_ci/chaos_smoke.log
@@ -80,6 +82,20 @@ if [ $chaos_rc -eq 0 ] && echo "$chaos" | grep -q 'CHAOS_SMOKE=ok'; then
   echo "$chaos" | grep 'CHAOS_SMOKE=ok'
 else
   echo "CHAOS_SMOKE=FAIL (rc=$chaos_rc; see tools/_ci/chaos_smoke.log)"
+  [ $rc -eq 0 ] && rc=1
+fi
+
+# ---- soak smoke: 3 seeded runs over a randomized fault matrix
+# (transient/permanent/crash/stall/slow mixes) — every run must
+# TERMINATE within budget with a schema-valid trace journal (ISSUE 7);
+# longer sweeps: python tools/soak.py --runs 20 ----
+soak_rc=0
+soak=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/soak.py --runs 3 --views 4 --budget-s 150 2>&1) || soak_rc=$?
+echo "$soak" > tools/_ci/soak_smoke.log
+if [ $soak_rc -eq 0 ] && echo "$soak" | grep -q 'SOAK=ok'; then
+  echo "$soak" | grep 'SOAK=ok'
+else
+  echo "SOAK_SMOKE=FAIL (rc=$soak_rc; see tools/_ci/soak_smoke.log)"
   [ $rc -eq 0 ] && rc=1
 fi
 exit $rc

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Seeded soak: N pipeline runs over a randomized fault matrix, asserting
+that EVERY run terminates — the "the pipeline can never hang" acceptance.
+
+Each run draws 1-3 rules from the full site x kind grid (transient /
+permanent / crash / stall(T) / slow(T) over every wired injection site),
+seeded from ``--seed`` so any failing run is reproducible by number, and
+executes the fused pipeline on a small synthetic dataset with tight lane
+deadlines + watchdog thresholds and the flight recorder armed. A run may
+legitimately end four ways:
+
+  completed        clean or DEGRADED (quarantines, identity fallbacks)
+  aborted          below the min_views survivor floor (ValueError with an
+                   aborted failure manifest) or past the run budget
+                   (DeadlineExceeded with an aborted manifest)
+  crashed          an injected ``crash`` rule (InjectedCrash is the
+                   simulated kill -9; crash-safety is PR 3's contract)
+
+What it may NEVER do is hang: each run must return within ``--budget-s``
+wall seconds (a belt-and-braces SIGALRM dumps all thread stacks and fails
+the soak if even that is violated), and each run's trace journal must
+schema-validate so a stalled run is always diagnosable from artifacts.
+
+Prints ``SOAK=ok runs=N ...`` (exit 0) or ``SOAK=FAIL (...)`` (exit 1).
+CI runs a short arm (``tools/ci_tier1.sh`` SOAK_SMOKE); longer sweeps:
+
+    python tools/soak.py --runs 20 --seed 7 --budget-s 120
+"""
+import argparse
+import faulthandler
+import json
+import os
+import random
+import shutil
+import signal
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# the full wired-site pool (utils/faults.py docstring); http.capture and
+# serial.rotate never fire in a pipeline run but stay listed so a drawn
+# rule exercises the no-op path too
+SITES = ["frame.load", "compute.view", "ply.write", "cache.get",
+         "cache.put", "register.pair", "http.capture", "serial.rotate"]
+KINDS = ["transient", "permanent", "crash", "stall(0.8)", "slow(0.3)"]
+
+
+def fail(why: str) -> int:
+    print(f"SOAK=FAIL ({why})")
+    return 1
+
+
+def _spec_for(rng: random.Random, view_names: list[str]) -> str:
+    rules = []
+    for _ in range(rng.randint(1, 3)):
+        site = rng.choice(SITES)
+        kind = rng.choice(KINDS)
+        match = rng.choice(["", rng.choice(view_names)])
+        rules.append(f"{site}{'~' + match if match else ''}:{kind}")
+    return ",".join(rules)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--runs", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--views", type=int, default=4)
+    ap.add_argument("--budget-s", type=float, default=150.0,
+                    help="per-run wall ceiling; a run past it fails the "
+                         "soak (the never-hang assertion)")
+    args = ap.parse_args()
+
+    from structured_light_for_3d_model_replication_tpu.cli import (
+        main as cli_main,
+    )
+    from structured_light_for_3d_model_replication_tpu.config import Config
+    from structured_light_for_3d_model_replication_tpu.pipeline import (
+        report as replib,
+    )
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+    from structured_light_for_3d_model_replication_tpu.utils import faults
+
+    # last line of defense: if the deadline layer itself wedges, dump every
+    # thread's stack and die loudly instead of hanging CI
+    alarm_s = int(args.budget_s * args.runs + 120)
+
+    def on_alarm(signum, frame):
+        faulthandler.dump_traceback(all_threads=True)
+        print(f"SOAK=FAIL (global {alarm_s}s alarm — a run hung past its "
+              f"budget AND the in-run deadlines)")
+        os._exit(1)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(alarm_s)
+
+    tmp = tempfile.mkdtemp(prefix="slsoak_")
+    try:
+        root = os.path.join(tmp, "dataset")
+        rc = cli_main(["synth", root, "--views", str(args.views),
+                       "--cam", "160x120", "--proj", "128x64"])
+        if rc != 0:
+            return fail(f"synth rc={rc}")
+        calib = os.path.join(root, "calib.mat")
+        view_names = sorted(d for d in os.listdir(root)
+                            if os.path.isdir(os.path.join(root, d)))
+
+        def cfg() -> Config:
+            c = Config()
+            c.parallel.backend = "numpy"
+            c.decode.n_cols, c.decode.n_rows = 128, 64
+            c.decode.thresh_mode = "manual"
+            c.merge.voxel_size = 4.0
+            c.merge.ransac_trials = 512
+            c.merge.icp_iters = 10
+            c.mesh.depth = 5
+            c.mesh.density_trim_quantile = 0.0
+            # tight deadlines so injected stalls resolve in seconds
+            c.deadlines.load_s = 2.0
+            c.deadlines.compute_s = 60.0
+            c.deadlines.write_s = 2.0
+            c.deadlines.register_s = 5.0
+            c.deadlines.drain_s = 5.0
+            c.deadlines.soft_stall_s = 5.0
+            c.deadlines.hard_stall_s = 15.0
+            c.deadlines.watchdog_poll_s = 0.2
+            c.pipeline.run_budget_s = args.budget_s
+            c.observability.trace = True
+            return c
+
+        rng = random.Random(args.seed)
+        outcomes: dict[str, int] = {}
+        walls: list[float] = []
+        for i in range(args.runs):
+            spec = _spec_for(rng, view_names)
+            out = os.path.join(tmp, f"out_{i:03d}")
+            faults.configure(spec, seed=args.seed + i)
+            t0 = time.monotonic()
+            outcome = "completed"
+            try:
+                rep = stages.run_pipeline(calib, root, out, cfg=cfg(),
+                                          steps=("statistical",),
+                                          log=lambda m: None)
+                if rep.degraded:
+                    outcome = "degraded"
+            except faults.InjectedCrash:
+                outcome = "crashed"
+            except Exception as e:
+                # any controlled abort (below the survivor floor, run
+                # budget, unwritable final artifact) must leave a failure
+                # manifest — terminating is necessary but not sufficient
+                outcome = "aborted"
+                if not os.path.exists(os.path.join(out, "failures.json")):
+                    return fail(f"run {i} [{spec}] aborted "
+                                f"({type(e).__name__}: {e}) without a "
+                                f"failure manifest")
+            finally:
+                faults.reset()
+            wall = time.monotonic() - t0
+            walls.append(round(wall, 1))
+            if wall > args.budget_s:
+                return fail(f"run {i} [{spec}] took {wall:.1f}s > "
+                            f"{args.budget_s}s budget — a hang the "
+                            f"deadline layer failed to bound")
+            journal = os.path.join(out, "trace.jsonl")
+            if not os.path.exists(journal):
+                return fail(f"run {i} [{spec}] left no trace journal")
+            errors = replib.validate_journal(journal)
+            if errors:
+                return fail(f"run {i} [{spec}] journal invalid: "
+                            f"{errors[:3]}")
+            outcomes[outcome] = outcomes.get(outcome, 0) + 1
+            print(f"[soak] run {i}: {outcome:<9} {wall:5.1f}s  [{spec}]")
+
+        summary = json.dumps(outcomes, sort_keys=True)
+        print(f"SOAK=ok runs={args.runs} seed={args.seed} "
+              f"outcomes={summary} max_wall={max(walls)}s")
+        return 0
+    finally:
+        signal.alarm(0)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,12 +56,14 @@ def main() -> None:
 
     log(f"pid {os.getpid()} — probing every {args.gap:.0f}s for up to "
         f"{args.max_hours:.1f}h")
-    t_end = time.time() + args.max_hours * 3600
+    # monotonic, never wall-clock: deadline arithmetic must not move with
+    # NTP steps or suspend/resume (repo-wide convention, utils/deadline.py)
+    t_end = time.monotonic() + args.max_hours * 3600
     n = 0
     same_failure = 0
     sessions = 0
     last_detail = None
-    while time.time() < t_end:
+    while time.monotonic() < t_end:
         n += 1
         t0 = time.time()
         # hold the repo-wide claim lock across probe AND session: a probe
