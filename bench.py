@@ -976,6 +976,124 @@ def bench_pipeline_deadline(views: int = PIPE_VIEWS) -> dict:
     return out
 
 
+def bench_multiproc(views: int = PIPE_VIEWS) -> dict:
+    """Multiprocess-coordinator cost on the fused pipeline (ISSUE 9).
+
+    Arms A/B (``single_s`` vs ``hooked_s``, interleaved best-of-2): the
+    coordinator's only recurring hot-path cost on a single-process run is
+    the heartbeat-hook check in ``OverlapStats.add`` (the can't-drift
+    lease-renewal seam; the workers=0 dispatch head is one branch per
+    run). Arm A runs with the hook disarmed — the stock fused pipeline —
+    and arm B with a no-op hook armed, upper-bounding what lease renewal
+    costs every stage transition. ``coordinator_overhead`` = B/A is the
+    <= 1.02x contract number.
+
+    Arm C (``coordinated_s``): the real thing — the same scan sharded
+    across 2 worker processes (spawn + lease protocol + ledger + assembly
+    over the warmed cache), with ``parity_ply`` / ``parity_stl`` byte
+    comparisons against arm A's artifacts. Its wall is a regime record,
+    not a contract: on a 1-CPU box process spawn + the assembly pass
+    dominate; with idle cores the workers overlap and it approaches (or
+    beats) the single-process wall."""
+    import shutil
+    import tempfile
+
+    from structured_light_for_3d_model_replication_tpu.config import Config
+    from structured_light_for_3d_model_replication_tpu.io import images as imio
+    from structured_light_for_3d_model_replication_tpu.io import matfile
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        profiling as prof,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        synthetic as syn,
+    )
+
+    out: dict = {"views": views, "backend": "numpy", "workers": 2,
+                 "host_cpus": os.cpu_count()}
+    tmp = tempfile.mkdtemp(prefix="slbench_mproc_")
+    try:
+        rig = syn.default_rig(cam_size=PIPE_CAM, proj_size=PIPE_PROJ)
+        scene = syn.sphere_on_background()
+        obj, background = scene.objects
+        calib_path = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(calib_path, rig.calibration())
+        root = os.path.join(tmp, "scans")
+        os.makedirs(root)
+        step = 360.0 / views
+        pivot = np.array([0.0, 0.0, 420.0])
+        for i, (R, t) in enumerate(syn.turntable_poses(views, step, pivot)):
+            frames, _ = syn.render_scene(
+                rig, syn.Scene([obj.transformed(R, t), background]))
+            imio.save_stack(
+                os.path.join(root, f"scan_{int(round(i * step)):03d}deg_scan"),
+                frames)
+
+        def cfg(workers: int = 0) -> Config:
+            c = Config()
+            c.parallel.backend = "numpy"
+            c.decode.n_cols, c.decode.n_rows = PIPE_PROJ
+            c.decode.thresh_mode = "manual"
+            c.merge.voxel_size = 4.0
+            c.merge.ransac_trials = 512
+            c.merge.icp_iters = 10
+            c.mesh.depth = 5
+            c.mesh.density_trim_quantile = 0.0
+            c.coordinator.workers = workers
+            return c
+
+        steps = ("statistical",)
+        single_walls, hooked_walls = [], []
+        for rep_i in range(2):
+            t0 = time.perf_counter()
+            rep = stages.run_pipeline(calib_path, root,
+                                      os.path.join(tmp, f"sp{rep_i}"),
+                                      cfg=cfg(), steps=steps,
+                                      log=lambda m: None)
+            single_walls.append(time.perf_counter() - t0)
+            assert not rep.failed, rep.failed
+            prev = prof.set_heartbeat_hook(lambda stage: None)
+            try:
+                t0 = time.perf_counter()
+                rep2 = stages.run_pipeline(calib_path, root,
+                                           os.path.join(tmp, f"hk{rep_i}"),
+                                           cfg=cfg(), steps=steps,
+                                           log=lambda m: None)
+                hooked_walls.append(time.perf_counter() - t0)
+            finally:
+                prof.set_heartbeat_hook(prev)
+            assert not rep2.failed, rep2.failed
+        out["single_s"] = round(min(single_walls), 4)
+        out["hooked_s"] = round(min(hooked_walls), 4)
+        out["single_walls"] = [round(w, 4) for w in single_walls]
+        out["hooked_walls"] = [round(w, 4) for w in hooked_walls]
+        out["coordinator_overhead"] = (
+            round(out["hooked_s"] / out["single_s"], 3)
+            if out["single_s"] else None)
+
+        # ---- arm C: 2-worker coordinated run + byte parity ----
+        out_mp = os.path.join(tmp, "mp")
+        t0 = time.perf_counter()
+        rep3 = stages.run_pipeline(calib_path, root, out_mp,
+                                   cfg=cfg(workers=2), steps=steps,
+                                   log=lambda m: None)
+        out["coordinated_s"] = round(time.perf_counter() - t0, 4)
+        out["coordinated_vs_single"] = (
+            round(out["coordinated_s"] / out["single_s"], 3)
+            if out["single_s"] else None)
+        info = rep3.coordinator or {}
+        out["items"] = info.get("items_total")
+        out["steals"] = info.get("steals")
+        for name, key in (("merged.ply", "parity_ply"),
+                          ("model.stl", "parity_stl")):
+            with open(os.path.join(tmp, "sp0", name), "rb") as fa, \
+                    open(os.path.join(out_mp, name), "rb") as fb:
+                out[key] = fa.read() == fb.read()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # child: all jax work, per-phase persisted results
 # ---------------------------------------------------------------------------
@@ -1569,6 +1687,23 @@ def main() -> None:
             log(f"pipeline deadline arm FAILED "
                 f"({final['pipeline_deadline']['error']})")
 
+        # multiprocess-coordinator overhead + 2-worker parity (host-only)
+        try:
+            log("multiproc arm (heartbeat-hook overhead + 2-worker "
+                "coordinated run)...")
+            final["multiproc"] = bench_multiproc()
+            mp = final["multiproc"]
+            log(f"multiproc: single {mp['single_s']}s vs hooked "
+                f"{mp['hooked_s']}s (x{mp['coordinator_overhead']}); "
+                f"coordinated {mp.get('coordinated_s')}s "
+                f"(x{mp.get('coordinated_vs_single')}, "
+                f"{mp.get('steals')} steal(s)), parity "
+                f"ply={mp.get('parity_ply')} stl={mp.get('parity_stl')}")
+        except Exception as e:
+            final["multiproc"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+            log(f"multiproc arm FAILED ({final['multiproc']['error']})")
+
         # one TPU client at a time, repo-wide: if a validation session (or
         # any other tool) holds the claim lock, QUEUE behind it — racing it
         # is the concurrent-client wedge. Waiting is also the best outcome:
@@ -1720,6 +1855,7 @@ if __name__ == "__main__":
             line["pipeline_faults"] = bench_pipeline_faults()
             line["pipeline_trace"] = bench_pipeline_trace()
             line["pipeline_deadline"] = bench_pipeline_deadline()
+            line["multiproc"] = bench_multiproc()
             fused = line["pipeline_e2e"].get("fused_s")
             disabled = line["pipeline_faults"].get("disabled_s")
             if fused and disabled:

@@ -257,6 +257,11 @@ class PipelineConfig:
     max_retries: int = 2
     retry_backoff_s: float = 0.05
     retry_backoff_max_s: float = 1.0
+    # FULL jitter on those backoff sleeps (uniform in [0, delay]): N
+    # coordinated workers retrying the same transient must not thundering-
+    # herd the coordinator/acquire layer in lockstep. Seeded via the armed
+    # fault plan's jitter stream, so chaos runs stay reproducible.
+    retry_jitter: bool = True
     # verify stage-cache payloads against their recorded content digest on
     # read; a corrupt entry (bit rot, torn write survivor) is evicted and
     # recomputed instead of poisoning downstream stages
@@ -330,6 +335,40 @@ class DeadlinesConfig:
 
 
 @dataclass
+class CoordinatorConfig:
+    """Host-level fault domains (parallel/coordinator.py): shard one scan's
+    view-compute + pair-registration items across N worker PROCESSES under
+    a lease/heartbeat protocol. ``workers=0`` (the default) disables the
+    whole layer — ``run_pipeline`` never touches it. The coordinated
+    result is byte-identical to the single-process pipeline: workers only
+    warm the content-addressed stage cache; the coordinator's final
+    assembly pass is the proven single-process pipeline reading it."""
+
+    # worker processes to spawn (0 = single-process, coordinator disabled)
+    workers: int = 0
+    # a granted item's lease lifetime; leases renew on every
+    # OverlapStats.add heartbeat, so only a killed/preempted/wedged/
+    # partitioned worker lets one expire — then the item is STOLEN and
+    # regranted to a survivor.  Must cover the longest single opaque
+    # stage call (a cold pair registration can run tens of seconds with
+    # no heartbeat inside); an expiry is still safe — the late complete
+    # is journaled and the result stays in cache — just wasteful
+    lease_s: float = 45.0
+    # worker -> coordinator heartbeat cadence (rate-limits lease renewal
+    # traffic; must be well under lease_s)
+    heartbeat_s: float = 2.0
+    # times one item may be stolen+regranted before the coordinator stops
+    # regranting it (the assembly pass still computes it single-process,
+    # so a poisonous item can never live-lock the grant loop)
+    max_steals: int = 3
+    # coordinator TCP port (loopback only); 0 = ephemeral
+    port: int = 0
+    # worker -> coordinator connect deadline; a worker that cannot reach
+    # the coordinator within it exits with a clear diagnostic
+    connect_timeout_s: float = 20.0
+
+
+@dataclass
 class FaultsConfig:
     """Deterministic fault injection (utils/faults.py). Disabled by default
     (empty spec == zero overhead); the SL3D_FAULTS / SL3D_FAULTS_SEED env
@@ -356,6 +395,7 @@ class Config:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
+    coordinator: CoordinatorConfig = field(default_factory=CoordinatorConfig)
     deadlines: DeadlinesConfig = field(default_factory=DeadlinesConfig)
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)

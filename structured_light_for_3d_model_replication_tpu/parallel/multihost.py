@@ -21,10 +21,17 @@ __all__ = ["initialize", "global_mesh", "is_multiprocess", "process_summary"]
 
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
-               process_id: int | None = None) -> bool:
+               process_id: int | None = None,
+               connect_timeout_s: float | None = None) -> bool:
     """Join the jax.distributed process group when multi-host settings are
     present (flags or the standard env vars); returns True when distributed
-    mode is active. Safe to call more than once."""
+    mode is active. Safe to call more than once.
+
+    ``connect_timeout_s`` bounds the group join: a peer that never shows up
+    (wrong address, firewalled port, a dead coordinator) otherwise hangs the
+    gloo/distributed client indefinitely with no diagnostic. Past the bound
+    a ``DeadlineExceeded`` names the address and topology so the operator
+    knows WHICH rendezvous stalled. ``None`` keeps the library default."""
     env_addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
     env_np = os.environ.get("JAX_NUM_PROCESSES")
     addr = coordinator_address or env_addr
@@ -57,16 +64,64 @@ def initialize(coordinator_address: str | None = None,
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
         pass
+    pid = process_id if process_id is not None else (
+        int(os.environ.get("JAX_PROCESS_ID", "0")))
+    kw = dict(coordinator_address=addr, num_processes=nproc, process_id=pid)
+    # deliberately NOT jax's initialization_timeout: on expiry the
+    # distributed client LOG(FATAL)s — it aborts the whole process with
+    # SIGABRT instead of raising, which is exactly the opposite of a
+    # recoverable diagnostic
+
+    def _join():
+        try:
+            jax.distributed.initialize(**kw)
+        except RuntimeError as e:  # already initialized
+            if "already" not in str(e).lower():
+                raise
+
+    if connect_timeout_s is None:
+        _join()
+        return True
+    import threading
+    from concurrent.futures import Future
+
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        deadline as dl,
+    )
+
+    # the join runs on a DAEMON thread so a wedged gloo client can neither
+    # hang the caller past the bound nor block interpreter exit (a
+    # ThreadPoolExecutor would: its workers are non-daemon and joined at
+    # exit, so an unreachable coordinator would wedge shutdown forever).
+    # Past the deadline the thread is simply abandoned — it dies with the
+    # process.
+    fut: Future = Future()
+
+    def _runner():
+        if not fut.set_running_or_notify_cancel():
+            return
+        try:
+            _join()
+        except BaseException as e:
+            fut.set_exception(e)
+        else:
+            fut.set_result(None)
+
+    threading.Thread(target=_runner, name="sl3d-mh-connect",
+                     daemon=True).start()
     try:
-        jax.distributed.initialize(
-            coordinator_address=addr,
-            num_processes=nproc,
-            process_id=process_id if process_id is not None else (
-                int(os.environ.get("JAX_PROCESS_ID", "0"))),
-        )
-    except RuntimeError as e:  # already initialized
-        if "already" not in str(e).lower():
-            raise
+        dl.wait_future(
+            fut, connect_timeout_s,
+            f"multihost connect to {addr} "
+            f"(num_processes={nproc}, process_id={pid})")
+    except dl.DeadlineExceeded:
+        raise dl.DeadlineExceeded(
+            f"multihost.initialize: no process group within "
+            f"{connect_timeout_s:g}s — coordinator {addr!r} "
+            f"(num_processes={nproc}, process_id={pid}) never "
+            f"completed the rendezvous. Check that the coordinator "
+            f"host is up, the port is reachable, and every peer was "
+            f"launched with the same topology.") from None
     return True
 
 

@@ -1,0 +1,415 @@
+"""The coordinated-run worker: one crash domain, leased work, cache puts.
+
+Spawned by ``parallel/coordinator.run_coordinated`` as
+``sl3d worker --spec <json>`` (one process per host fault domain). The loop
+is deliberately dumb: ask the coordinator for the next leased item, run the
+EXACT single-process item program (``stages._load_fired`` →
+``_compute_fired`` → ``compact_cloud`` → ``_clean_arrays`` for views;
+``prep_view`` + ``register_prep_pairs`` for pairs), publish the result to
+the content-addressed StageCache (atomic tmp+rename put — the natural
+cross-process handoff), and report ``complete``. The coordinator's assembly
+pass then finds the bytes under the same keys a clean single-process run
+would compute — workers never touch merged artifacts, so they cannot break
+byte parity; the worst a dead worker costs is recompute.
+
+Liveness: the lease renews from *inside* ``OverlapStats.add`` via the
+``profiling.set_heartbeat_hook`` ambient hook — the same can't-drift
+call site the deadline watchdog beats from, so progress accounting and
+lease renewal can never disagree. A worker wedged inside one stage stops
+beating and loses its leases; there is deliberately NO background beat
+thread that would keep a zombie's leases alive.
+
+Host-scope fault kinds (utils/faults.py) get real semantics here:
+
+  worker.kill        -> os._exit(137) mid-item (SIGKILL'd host)
+  worker.preempt(T)  -> grace sleep, then os._exit(143) (spot preemption)
+  net.partition(T)   -> drop the coordinator link for T seconds but KEEP
+                        computing (compute is local; only coordination is
+                        partitioned), then reconnect and report late — the
+                        lease may have been stolen, exercising the
+                        late-complete/"stolen" protocol arm.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.utils import deadline as dl
+from structured_light_for_3d_model_replication_tpu.utils import faults
+from structured_light_for_3d_model_replication_tpu.utils import profiling as prof
+from structured_light_for_3d_model_replication_tpu.utils import telemetry as tel
+
+__all__ = ["CoordClient", "run_worker"]
+
+
+class CoordClient:
+    """Persistent newline-JSON connection to the coordinator. Every call
+    is synchronous request/response; socket errors propagate — the caller
+    decides between reconnect (partition) and exit (dead coordinator)."""
+
+    def __init__(self, port: int, worker: str, connect_timeout_s: float,
+                 io_timeout_s: float = 60.0):
+        self.port = port
+        self.worker = worker
+        self.connect_timeout_s = connect_timeout_s
+        self.io_timeout_s = io_timeout_s
+        self._sock: socket.socket | None = None
+        self._f = None
+
+    def connect(self) -> None:
+        """Bounded connect: retry until the coordinator answers or the
+        deadline passes — a vanished coordinator must strand no worker."""
+        deadline = dl.Deadline.after(self.connect_timeout_s,
+                                     "coordinator connect")
+        last: Exception | None = None
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    ("127.0.0.1", self.port), timeout=1.0)
+                self._sock.settimeout(self.io_timeout_s)
+                self._f = self._sock.makefile("rw", encoding="utf-8")
+                return
+            except OSError as e:
+                last = e
+                if deadline is not None and deadline.remaining() <= 0:
+                    raise dl.DeadlineExceeded(
+                        f"worker {self.worker}: no coordinator on port "
+                        f"{self.port} within {self.connect_timeout_s:g}s "
+                        f"({type(e).__name__}: {e})") from last
+                time.sleep(0.1)
+
+    def request(self, obj: dict) -> dict:
+        if self._f is None:
+            raise ConnectionError("not connected")
+        self._f.write(json.dumps(obj) + "\n")
+        self._f.flush()
+        line = self._f.readline()
+        if not line:
+            raise ConnectionError("coordinator closed the connection")
+        return json.loads(line)
+
+    def hello(self, pid: int) -> dict:
+        return self.request({"op": "hello", "worker": self.worker,
+                             "pid": pid})
+
+    def next(self) -> dict:
+        return self.request({"op": "next", "worker": self.worker})
+
+    def beat(self) -> dict:
+        return self.request({"op": "beat", "worker": self.worker})
+
+    def complete(self, item: str, gen: int) -> str:
+        return self.request({"op": "complete", "worker": self.worker,
+                             "item": item, "gen": gen}).get("ok", "")
+
+    def failed(self, item: str, gen: int, exc: BaseException) -> None:
+        self.request({"op": "failed", "worker": self.worker, "item": item,
+                      "gen": gen, "error": str(exc),
+                      "error_type": type(exc).__name__,
+                      "transient": faults.is_transient(exc)})
+
+    def close(self) -> None:
+        for x in (self._f, self._sock):
+            try:
+                if x is not None:
+                    x.close()
+            except OSError:
+                pass
+        self._f = self._sock = None
+
+
+class _WorkerCtx:
+    """Everything one worker process holds: config, calib, cache, retry
+    policy, the shared OverlapStats whose add() renews the lease."""
+
+    def __init__(self, cfg: Config, spec: dict, client: CoordClient,
+                 heartbeat_s: float):
+        from structured_light_for_3d_model_replication_tpu.io import (
+            matfile,
+        )
+        from structured_light_for_3d_model_replication_tpu.pipeline.stagecache import (
+            StageCache,
+        )
+
+        self.cfg = cfg
+        self.spec = spec
+        self.client = client
+        self.heartbeat_s = heartbeat_s
+        self.worker = spec["worker"]
+        self.steps = tuple(spec["steps"])
+        self.calib = matfile.load_calibration(spec["calib"])
+        self.cache = StageCache(
+            os.path.join(spec["out"], ".slscan-cache"), enabled=True,
+            verify=cfg.pipeline.verify_cache, log=lambda *_: None)
+        self.stats = prof.OverlapStats()
+        self._scanner = None
+        self._scanner_built = False
+        self._last_beat = 0.0
+
+    def heartbeat(self, stage: str) -> None:
+        """The ``OverlapStats.add`` hook: renew every lease this worker
+        holds, rate-limited, NEVER raising — a beat that fails (partition,
+        dying coordinator) simply lets the lease age toward a steal, which
+        is the correct outcome for both."""
+        now = time.monotonic()
+        if now - self._last_beat < self.heartbeat_s / 2.0:
+            return
+        self._last_beat = now
+        try:
+            self.client.beat()
+        except Exception:
+            pass
+
+    def scanner(self, src: str):
+        from structured_light_for_3d_model_replication_tpu.pipeline import (
+            stages,
+        )
+
+        if not self._scanner_built:
+            self._scanner = stages._build_scanner([src], self.calib,
+                                                  self.cfg)
+            self._scanner_built = True
+        return self._scanner
+
+    def retries(self, lane: str):
+        def on_retry(n, e):
+            self.stats.add_retry(lane)
+        return on_retry
+
+
+def _do_view(ctx: _WorkerCtx, ispec: dict) -> None:
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        triangulate as tri,
+    )
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+    src, key, idx = ispec["src"], ispec["key"], ispec["index"]
+    policy = stages._retry_policy(ctx.cfg)
+    t0 = time.perf_counter()
+    frames, texture = stages._retry_stage(
+        "load", lambda: stages._load_fired(src, ctx.cfg), policy,
+        ctx.retries("load"))
+    ctx.stats.add("load", time.perf_counter() - t0, view=idx)
+    t0 = time.perf_counter()
+    pts, cols = stages._retry_stage(
+        "compute",
+        lambda: tri.compact_cloud(stages._compute_fired(
+            frames, texture, ctx.calib, ctx.cfg, ctx.scanner(src), src)),
+        policy, ctx.retries("compute"))
+    ctx.stats.add("compute", time.perf_counter() - t0, view=idx)
+    t0 = time.perf_counter()
+    pts, cols, _ = stages._clean_arrays(pts, cols, ctx.cfg, ctx.steps)
+    ctx.stats.add("clean", time.perf_counter() - t0, view=idx)
+    t0 = time.perf_counter()
+    ctx.cache.put("view", key, points=pts, colors=cols)
+    ctx.stats.add("write", time.perf_counter() - t0, view=idx)
+
+
+def _do_pair(ctx: _WorkerCtx, ispec: dict) -> None:
+    from structured_light_for_3d_model_replication_tpu.models import (
+        reconstruction as recon,
+    )
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+    from structured_light_for_3d_model_replication_tpu.pipeline.stagecache import (
+        StageCache,
+    )
+
+    cfg = ctx.cfg
+    pid, dst, src = ispec["pid"], ispec["dst"], ispec["src"]
+    hd = ctx.cache.get("view", ispec["key_dst"])
+    hs = ctx.cache.get("view", ispec["key_src"])
+    if hd is None or hs is None:
+        raise RuntimeError(
+            f"pair {dst}->{src}: endpoint view(s) missing from the stage "
+            f"cache (dep gating should have prevented this grant)")
+    pts_d = np.asarray(hd["points"], np.float32)
+    cols_d = np.asarray(hd["colors"], np.uint8)
+    pts_s = np.asarray(hs["points"], np.float32)
+    cols_s = np.asarray(hs["colors"], np.uint8)
+    # identical key derivation to _StreamRegistrar._enqueue: endpoint
+    # OUTPUT digests + merge numerics + chain position
+    dig_d = StageCache.digest_arrays(points=pts_d, colors=cols_d)
+    dig_s = StageCache.digest_arrays(points=pts_s, colors=cols_s)
+    pair_cfg = stages._merge_numeric_json(cfg) + json.dumps(
+        {"backend": cfg.parallel.backend,
+         "force_bf16": cfg.parallel.force_bf16_features})
+    key = ctx.cache.key("pair", digests=[dig_d, dig_s],
+                        config_json=pair_cfg + json.dumps({"pair": pid}))
+    if ctx.cache.get("pair", key) is not None:
+        return      # already warm (another worker, or a previous run)
+    policy = stages._retry_policy(cfg)
+    on_retry = ctx.retries("register")
+    # same injection site + retry envelope as the streaming register lane
+    faults.retry_call(
+        lambda: faults.fire("register.pair", item=f"{dst}->{src}"),
+        policy, on_retry=on_retry)
+    voxel = float(cfg.merge.voxel_size)
+    fb16 = True if cfg.parallel.force_bf16_features else None
+    t0 = time.perf_counter()
+    prep_s = recon.prep_view(pts_s, voxel, cfg.merge.sample_before)
+    ctx.heartbeat("register")
+    prep_d = recon.prep_view(pts_d, voxel, cfg.merge.sample_before)
+    ctx.heartbeat("register")
+    T, gf, fi, ir = faults.retry_call(
+        lambda: recon.register_prep_pairs(
+            [(prep_s, prep_d)], [pid], cfg.merge, voxel, mesh=None,
+            feat_bf16=fb16, batch=max(1, cfg.merge.pair_batch)),
+        policy, on_retry=on_retry)
+    ctx.stats.add("register", time.perf_counter() - t0, view=dst)
+    ctx.cache.put("pair", key, T=np.asarray(T[0], np.float32),
+                  gfit=np.float32(gf[0]), ifit=np.float32(fi[0]),
+                  irmse=np.float32(ir[0]))
+
+
+def _run_item(ctx: _WorkerCtx, kind: str, iid: str, ispec: dict) -> None:
+    # the per-item host-fault site: specs match on "<worker>:<item>", so
+    # `worker.item~w0:worker.kill` kills exactly worker w0's first item
+    faults.fire("worker.item", item=f"{ctx.worker}:{iid}")
+    if kind == "view":
+        _do_view(ctx, ispec)
+    else:
+        _do_pair(ctx, ispec)
+
+
+def run_worker(spec_path: str, log=print) -> int:
+    """The ``sl3d worker`` entry: join the coordinator, drain leased items
+    until shutdown. Exit codes: 0 clean, 137 injected kill, 143 injected
+    preemption, 1 protocol/connect failure."""
+    with open(spec_path, encoding="utf-8") as f:
+        spec = json.load(f)
+    from structured_light_for_3d_model_replication_tpu import load_config
+
+    cfg = load_config(spec["config"])
+    worker = spec["worker"]
+    # host tag: rank+pid into every artifact filename this process writes
+    # (trace journal, stalls, failures) — N workers share out_dir safely
+    tel.set_host_tag(f"{worker}-{os.getpid()}")
+    faults.configure_from(cfg.faults)
+    tracer = prev_tr = None
+    if cfg.observability.trace:
+        tracer = tel.Tracer(
+            os.path.join(spec["out"],
+                         tel.host_scoped(cfg.observability.trace_file)),
+            run_id=tel.new_run_id(),
+            meta={"tool": "worker", "host": tel.host_tag(),
+                  "worker": worker, "pid": os.getpid(),
+                  "backend": cfg.parallel.backend,
+                  "host_cpus": os.cpu_count()})
+        prev_tr = tel.activate(tracer)
+
+    client = CoordClient(spec["port"], worker,
+                         cfg.coordinator.connect_timeout_s)
+    client.connect()
+    hello = client.hello(os.getpid())
+    heartbeat_s = float(hello.get("heartbeat_s",
+                                  cfg.coordinator.heartbeat_s))
+    ctx = _WorkerCtx(cfg, spec, client, heartbeat_s)
+    prev_hook = prof.set_heartbeat_hook(ctx.heartbeat)
+    log(f"[worker {worker}] joined run {hello.get('run_id')} "
+        f"(pid {os.getpid()}, lease {hello.get('lease_s')}s)")
+    rc = 0
+    try:
+        while True:
+            try:
+                resp = client.next()
+            except (OSError, ConnectionError, ValueError):
+                # coordinator gone mid-run: bounded reconnect, then give up
+                client.close()
+                try:
+                    client.connect()
+                    client.hello(os.getpid())
+                    continue
+                except Exception:
+                    log(f"[worker {worker}] coordinator unreachable; "
+                        f"exiting")
+                    rc = 1
+                    break
+            if resp.get("shutdown"):
+                log(f"[worker {worker}] shutdown received; exiting clean")
+                break
+            if "grant" not in resp:
+                time.sleep(float(resp.get("wait", 0.2)))
+                continue
+            grant = resp["grant"]
+            iid, gen = grant["id"], int(grant["gen"])
+            kind, ispec = grant["kind"], grant["spec"]
+            if tracer is not None:
+                tracer.instant("worker.grant", item=iid, gen=gen)
+            try:
+                _run_item(ctx, kind, iid, ispec)
+            except faults.WorkerKilled:
+                # simulated SIGKILL: no complete, no cleanup, no flush —
+                # the lease MUST expire and the item MUST be stolen
+                os._exit(137)
+            except faults.WorkerPreempted as e:
+                log(f"[worker {worker}] preemption notice: exiting in "
+                    f"{e.grace_s:g}s grace")
+                time.sleep(max(0.0, e.grace_s))
+                os._exit(143)
+            except faults.NetPartition as e:
+                _partitioned(ctx, e, kind, iid, gen, ispec, tracer, log)
+                continue
+            except faults.InjectedCrash:
+                os._exit(134)
+            except Exception as e:
+                log(f"[worker {worker}] item {iid} failed: "
+                    f"{type(e).__name__}: {e}")
+                if tracer is not None:
+                    tracer.instant("worker.failed", item=iid,
+                                   error=type(e).__name__)
+                try:
+                    client.failed(iid, gen, e)
+                except Exception:
+                    pass    # lease expiry covers an unreportable failure
+                continue
+            status = client.complete(iid, gen)
+            if tracer is not None:
+                tracer.instant("worker.complete", item=iid, status=status)
+            if status == "stolen":
+                log(f"[worker {worker}] item {iid} completed late — "
+                    f"lease was stolen; result stays in cache")
+    finally:
+        prof.set_heartbeat_hook(prev_hook)
+        client.close()
+        if tracer is not None:
+            tel.deactivate(prev_tr)
+            tracer.close(os.path.join(
+                spec["out"],
+                tel.host_scoped(cfg.observability.metrics_file)))
+    return rc
+
+
+def _partitioned(ctx: _WorkerCtx, e, kind: str, iid: str, gen: int,
+                 ispec: dict, tracer, log) -> None:
+    """net.partition semantics: coordination is cut for ``duration_s`` but
+    compute is local — finish the item anyway, reconnect, report late. The
+    coordinator may answer "stolen" (lease expired during the partition);
+    the content-addressed cache makes the double-compute harmless."""
+    w = ctx.worker
+    log(f"[worker {w}] PARTITIONED from coordinator for "
+        f"{e.duration_s:g}s (item {iid} continues locally)")
+    ctx.client.close()
+    time.sleep(max(0.0, e.duration_s))
+    err: Exception | None = None
+    try:
+        if kind == "view":
+            _do_view(ctx, ispec)
+        else:
+            _do_pair(ctx, ispec)
+    except Exception as ie:
+        err = ie
+    ctx.client.connect()
+    ctx.client.hello(os.getpid())
+    if err is not None:
+        ctx.client.failed(iid, gen, err)
+        return
+    status = ctx.client.complete(iid, gen)
+    if tracer is not None:
+        tracer.instant("worker.complete", item=iid, status=status,
+                       after_partition=True)
+    log(f"[worker {w}] reconnected; late complete of {iid} -> {status}")

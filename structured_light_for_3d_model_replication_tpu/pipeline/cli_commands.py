@@ -189,7 +189,24 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
                         "watchdog (deadlines.enabled=false; env "
                         "SL3D_NO_DEADLINES=1) — waits become unbounded "
                         "again, as before PR 7")
+    p.add_argument("--workers", type=int, default=None, metavar="N",
+                   help="coordinated multiprocess mode "
+                        "(coordinator.workers): lease per-view/per-pair "
+                        "items to N local worker processes with lease "
+                        "expiry + work stealing; a killed or preempted "
+                        "worker costs only its in-flight items, and the "
+                        "output stays byte-identical to a single-process "
+                        "run (grants journal to <out>/ledger.jsonl; a "
+                        "crashed coordinator resumes with zero recompute)")
     add_config_args(p)
+
+    p = sub.add_parser(
+        "worker",
+        help="INTERNAL: one coordinated-run worker process — spawned by "
+             "'pipeline --workers N', not meant for direct use")
+    p.add_argument("--spec", required=True,
+                   help="worker spec JSON written by the coordinator "
+                        "(<out>/.coord/workerN.json)")
 
     p = sub.add_parser(
         "report",
@@ -465,11 +482,19 @@ def _cmd_pipeline(args) -> int:
         cfg.pipeline.run_budget_s = args.run_budget
     if args.no_deadlines:
         cfg.deadlines.enabled = False
+    if args.workers is not None:
+        cfg.coordinator.workers = args.workers
     steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
     report = stages.run_pipeline(args.calib, args.target, args.out, cfg=cfg,
                                  steps=steps, stl_name=args.stl_name)
     print(f"[pipeline] merge mode: {report.merge_mode} "
           f"({report.merge_status})")
+    if report.coordinator:
+        c = report.coordinator
+        print(f"[pipeline] coordinator: {c['items_total']} item(s) across "
+              f"{c['workers']} worker(s), steals={c.get('steals', 0)}, "
+              f"resumed={c.get('resumed_completed', 0)}; ledger -> "
+              f"{c['ledger']}")
     if report.overlap:
         o = report.overlap
         clean = (f" + clean {o['clean_s']}s" if o.get("clean_s") else "")
@@ -501,6 +526,18 @@ def _cmd_pipeline(args) -> int:
     return 0
 
 
+@_runner("worker")
+def _cmd_worker(args) -> int:
+    # the coordinated-run worker: config, faults, and identity all come
+    # from the spec file the coordinator wrote — no _cfg() here (the
+    # worker must see EXACTLY the coordinator's resolved config)
+    from structured_light_for_3d_model_replication_tpu.parallel import (
+        worker,
+    )
+
+    return worker.run_worker(args.spec)
+
+
 @_runner("report")
 def _cmd_report(args) -> int:
     from structured_light_for_3d_model_replication_tpu.pipeline import (
@@ -513,20 +550,33 @@ def _cmd_report(args) -> int:
     cfg = _cfg(args)
     trace_file = cfg.observability.trace_file
     journal = os.path.join(args.out_dir, trace_file)
-    if not os.path.exists(journal):
+    journals = replib.host_journals(args.out_dir, trace_file)
+    if not journals:
         print(f"[report] no {trace_file} under {args.out_dir} — run the "
               f"pipeline with --trace (or SL3D_TRACE=1) first",
               file=sys.stderr)
         return 1
 
     if args.validate:
-        errors = replib.validate_journal(journal)
-        for e in errors:
-            print(f"[report] INVALID: {e}", file=sys.stderr)
-        print(f"[report] journal {'INVALID' if errors else 'valid'}: "
-              f"{journal}")
-        if errors:
+        # a coordinated run leaves one journal per host; ALL must be valid
+        any_errors = False
+        for jp in journals:
+            errors = replib.validate_journal(jp)
+            for e in errors:
+                print(f"[report] INVALID: {e}", file=sys.stderr)
+            print(f"[report] journal {'INVALID' if errors else 'valid'}: "
+                  f"{jp}")
+            any_errors = any_errors or bool(errors)
+        if any_errors:
             return 1
+
+    if not os.path.exists(journal):
+        # worker journals only (the coordinator ran untraced): the merged
+        # cross-host timeline is still renderable
+        rows = replib.merge_host_timeline(args.out_dir, trace_file)
+        if not args.validate and not args.prometheus:
+            print(replib.render_host_timeline(rows))
+        return 0
 
     if args.prometheus:
         mpath = os.path.join(args.out_dir, cfg.observability.metrics_file)
@@ -543,6 +593,12 @@ def _cmd_report(args) -> int:
         metrics_file=cfg.observability.metrics_file)
     if not args.validate:
         print(replib.render_report(analysis, width=args.width))
+        if len(journals) > 1:
+            # coordinated run: merge the per-host worker journals into
+            # one timeline with a host column under the main report
+            rows = replib.merge_host_timeline(args.out_dir, trace_file)
+            print()
+            print(replib.render_host_timeline(rows))
 
     if args.chrome_trace is not None:
         out_path = args.chrome_trace or os.path.join(args.out_dir,

@@ -32,13 +32,15 @@ close) downgrades to journal-only analysis.
 """
 from __future__ import annotations
 
+import glob
 import json
 import os
 from dataclasses import dataclass, field
 
 from structured_light_for_3d_model_replication_tpu.utils import telemetry
 
-__all__ = ["RunAnalysis", "analyze_run", "render_report", "validate_journal"]
+__all__ = ["RunAnalysis", "analyze_run", "render_report", "validate_journal",
+           "host_journals", "merge_host_timeline", "render_host_timeline"]
 
 _LANES = telemetry.LANE_ORDER
 
@@ -231,6 +233,74 @@ def analyze_run(out_dir: str, trace_file: str = "trace.jsonl",
         except (OSError, ValueError):
             a.stalls = None
     return a
+
+
+# ---------------------------------------------------------------------------
+# multi-host journal merge (coordinated runs: N workers share one out dir)
+# ---------------------------------------------------------------------------
+
+def host_journals(out_dir: str, trace_file: str = "trace.jsonl") -> list[str]:
+    """Every journal in an out dir: the coordinator/single-process
+    ``trace_file`` plus the host-scoped ``trace.<rank>-<pid>.jsonl``
+    siblings coordinated workers write (``telemetry.host_scoped`` naming).
+    The unscoped journal sorts first."""
+    stem, dot, ext = trace_file.rpartition(".")
+    pat = f"{stem}.*.{ext}" if dot else f"{trace_file}.*"
+    main = os.path.join(out_dir, trace_file)
+    sibs = sorted(glob.glob(os.path.join(out_dir, pat)))
+    out = [main] if os.path.exists(main) else []
+    out += [p for p in sibs if p != main]
+    return out
+
+
+def merge_host_timeline(out_dir: str,
+                        trace_file: str = "trace.jsonl") -> list[dict]:
+    """Fold every per-host journal into ONE time-ordered event list, each
+    row stamped with its ``host`` column. Per-host relative timestamps are
+    rebased onto each journal's ``t0_unix`` wall anchor, so events from
+    different processes interleave in true order (subject to host clock
+    skew — irrelevant on one machine, labeled per-host anyway)."""
+    rows: list[dict] = []
+    for path in host_journals(out_dir, trace_file):
+        j = telemetry.read_journal(path)
+        meta = j["meta"] or {}
+        host = (meta.get("host") or meta.get("tool")
+                or os.path.basename(path))
+        t0 = float(meta.get("t0_unix", 0.0) or 0.0)
+        for ev in j["events"]:
+            row = dict(ev)
+            row["host"] = host
+            row["t_unix"] = t0 + float(ev.get("t", 0.0) or 0.0)
+            rows.append(row)
+    rows.sort(key=lambda r: r["t_unix"])
+    return rows
+
+
+def render_host_timeline(rows: list[dict], limit: int = 60) -> str:
+    """The merged cross-host timeline as a host-column table (the last
+    ``limit`` events; earlier ones summarize to a count). Pure function —
+    the CLI prints it under the per-journal report when worker journals
+    are present."""
+    L: list[str] = []
+    hosts = sorted({r["host"] for r in rows})
+    L.append(f"multi-host timeline — {len(rows)} event(s) across "
+             f"{len(hosts)} journal(s): {', '.join(hosts)}")
+    if not rows:
+        return "\n".join(L)
+    t_base = rows[0]["t_unix"]
+    shown = rows[-limit:] if len(rows) > limit else rows
+    if len(rows) > limit:
+        L.append(f"  ... {len(rows) - limit} earlier event(s) elided ...")
+    wh = max(len(h) for h in hosts)
+    for r in shown:
+        what = r.get("ev") or r.get("type", "?")
+        detail = " ".join(
+            f"{k}={r[k]}" for k in ("lane", "stage", "item", "view",
+                                    "status", "site", "kind", "error")
+            if k in r)
+        L.append(f"  +{r['t_unix'] - t_base:8.3f}s  {r['host']:<{wh}}  "
+                 f"{what}" + (f"  {detail}" if detail else ""))
+    return "\n".join(L)
 
 
 # ---------------------------------------------------------------------------

@@ -198,7 +198,8 @@ def _retry_policy(cfg: Config) -> faults.RetryPolicy:
     return faults.RetryPolicy(
         max_retries=cfg.pipeline.max_retries,
         backoff_base_s=cfg.pipeline.retry_backoff_s,
-        backoff_max_s=cfg.pipeline.retry_backoff_max_s)
+        backoff_max_s=cfg.pipeline.retry_backoff_max_s,
+        jitter=cfg.pipeline.retry_jitter)
 
 
 def _retry_stage(stage: str, fn, policy: faults.RetryPolicy, on_retry=None):
@@ -1308,6 +1309,9 @@ class PipelineReport:
     merged_points: int = 0
     overlap: dict | None = None     # executor lanes incl. clean + register
     cache: dict | None = None       # StageCache.stats()
+    # multiprocess runs only: the coordinator's lease/steal/ledger summary
+    # (parallel/coordinator.py attaches it after the assembly pass)
+    coordinator: dict | None = None
     elapsed_s: float = 0.0
 
     @property
@@ -1357,7 +1361,7 @@ def _failure_manifest(out_dir: str, report: "PipelineReport",
     failure record, the degradation verdict, and (on chaos runs) the fired
     injection counts so seeded assertions need no log scraping."""
     plan = faults.active_plan()
-    path = os.path.join(out_dir, "failures.json")
+    path = os.path.join(out_dir, tel.host_scoped("failures.json"))
     _write_json_atomic(path, {
         "run_id": report.run_id,
         "views_total": views_total,
@@ -1668,6 +1672,43 @@ def _stagecache_digest(**arrays) -> str:
     return StageCache.digest_arrays(**arrays)
 
 
+def _view_plan(calib_path: str, target: str, cfg: Config,
+               steps: tuple[str, ...], cache, log):
+    """Angle-ordered scan sources plus their content-addressed view cache
+    keys — the shared ground truth between the single-process pipeline and
+    the multiprocess coordinator. A worker warming the cache MUST key its
+    entries exactly as the assembly pass will look them up, so both sides
+    derive the plan from this one helper."""
+    from structured_light_for_3d_model_replication_tpu.pipeline.stagecache import (
+        config_subtree,
+    )
+
+    calib = matfile.load_calibration(calib_path)
+    need = gc.frames_per_view(cfg.decode.n_cols, cfg.decode.n_rows,
+                              cfg.projector.downsample)
+    sources = _scan_sources(target, "batch", need, log=log)
+    if len(sources) < 2:
+        raise ValueError(
+            f"pipeline needs >= 2 scan views under {target!r}, found "
+            f"{len(sources)}")
+    # the merge chain is angle-ordered; scan folders carry the same
+    # '<n>deg' tag the per-view PLYs would, so the fused run and the
+    # discrete reconstruct->merge-360 chain see the views in one order
+    sources = sort_ply_paths_by_angle(sources)
+    view_cfg = config_subtree(cfg, ("decode", "triangulate", "projector",
+                                    "clean")) + json.dumps(
+        {"steps": list(steps), "backend": cfg.parallel.backend})
+    # per-view content keys hashed on the I/O pool — the serial hash wall
+    # otherwise delays the batched executor's first launch
+    with tel.stage("cache.keys", views=len(sources)):
+        view_keys = cache.keys_parallel(
+            "view",
+            [[calib_path] + imio.list_frame_files(src) for src in sources],
+            config_json=view_cfg, io_workers=cfg.parallel.io_workers,
+            timeout_s=_lane_budget_s(cfg, "cache"))
+    return calib, sources, view_cfg, view_keys
+
+
 def run_pipeline(calib_path: str, target: str, out_dir: str,
                  cfg: Config | None = None,
                  steps: tuple[str, ...] | list[str] = _CLEAN_STEPS,
@@ -1696,17 +1737,34 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     The recorder closes (and persists metrics) even on a crash/interrupt.
     """
     cfg = cfg or Config()
+    if cfg.coordinator.workers > 0:
+        # host-fault-domain mode: the coordinator leases view/pair items
+        # to N worker processes (each a crash domain), then re-enters this
+        # function with workers=0 as the assembly pass over the warmed
+        # stage cache — so coordinated output is byte-identical to a
+        # single-process run by construction. Lazy import: coordinator
+        # imports stages for the item programs.
+        from structured_light_for_3d_model_replication_tpu.parallel import (
+            coordinator as _coord,
+        )
+
+        return _coord.run_coordinated(calib_path, target, out_dir, cfg,
+                                      steps=tuple(steps),
+                                      merged_name=merged_name,
+                                      stl_name=stl_name, log=log)
     os.makedirs(out_dir, exist_ok=True)
     run_id = tel.new_run_id()
     tracer = prev = None
     if cfg.observability.trace:
         tracer = tel.Tracer(
-            os.path.join(out_dir, cfg.observability.trace_file),
+            os.path.join(out_dir,
+                         tel.host_scoped(cfg.observability.trace_file)),
             run_id=run_id,
             meta={"tool": "pipeline", "target": os.path.abspath(target),
                   "backend": cfg.parallel.backend,
                   "merge_method": cfg.merge.method,
                   "merge_stream": cfg.merge.stream,
+                  "host": tel.host_tag(),
                   "host_cpus": os.cpu_count(),
                   "device_count": _initialized_device_count()})
         prev = tel.activate(tracer)
@@ -1756,7 +1814,7 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
         # watchdog's stalls.json lands separately in its stop(); an
         # InjectedCrash (BaseException) deliberately bypasses this, the
         # crash-safety contract covers it.
-        mpath = os.path.join(out_dir, "failures.json")
+        mpath = os.path.join(out_dir, tel.host_scoped("failures.json"))
         if not os.path.exists(mpath):
             _write_json_atomic(mpath, {
                 "run_id": run_id, "aborted": True, "degraded": False,
@@ -1783,8 +1841,8 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
             dl.deactivate(prev_ctx)
         if tracer is not None:
             tel.deactivate(prev)
-            metrics_path = os.path.join(out_dir,
-                                        cfg.observability.metrics_file)
+            metrics_path = os.path.join(
+                out_dir, tel.host_scoped(cfg.observability.metrics_file))
             tracer.close(metrics_path)
             log(f"[pipeline] flight recorder -> {tracer.path} + "
                 f"{metrics_path} (inspect with: sl3d report {out_dir})")
@@ -1818,18 +1876,6 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
     )
 
     t_start = time.monotonic()
-    calib = matfile.load_calibration(calib_path)
-    need = gc.frames_per_view(cfg.decode.n_cols, cfg.decode.n_rows,
-                              cfg.projector.downsample)
-    sources = _scan_sources(target, "batch", need, log=log)
-    if len(sources) < 2:
-        raise ValueError(
-            f"pipeline needs >= 2 scan views under {target!r}, found "
-            f"{len(sources)}")
-    # the merge chain is angle-ordered; scan folders carry the same
-    # '<n>deg' tag the per-view PLYs would, so the fused run and the
-    # discrete reconstruct->merge-360 chain see the views in one order
-    sources = sort_ply_paths_by_angle(sources)
     os.makedirs(out_dir, exist_ok=True)
     # startup sweep: a kill -9 in an earlier run leaves *.tmp orphans under
     # the out tree (merged/STL/manifest staging, cache puts); none is data
@@ -1839,7 +1885,7 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
     # breaches, and the abort path writes failures.json only for THIS
     # run's failures (clean completion re-asserts the removal at the end)
     for stale in ("stalls.json", "failures.json"):
-        p = os.path.join(out_dir, stale)
+        p = os.path.join(out_dir, tel.host_scoped(stale))
         if os.path.exists(p):
             os.remove(p)
     report = PipelineReport(run_id=run_id)
@@ -1849,19 +1895,10 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
 
     # ---- stage 1+2: per-view reconstruct + masked clean -----------------
     steps = tuple(steps)
-    view_cfg = config_subtree(cfg, ("decode", "triangulate", "projector",
-                                    "clean")) + json.dumps(
-        {"steps": list(steps), "backend": cfg.parallel.backend})
+    calib, sources, _view_cfg, view_keys = _view_plan(
+        calib_path, target, cfg, steps, cache, log)
     collected: dict[int, tuple[np.ndarray, np.ndarray]] = {}
     missing: list[tuple[int, str]] = []
-    # per-view content keys hashed on the I/O pool — the serial hash wall
-    # otherwise delays the batched executor's first launch
-    with tel.stage("cache.keys", views=len(sources)):
-        view_keys = cache.keys_parallel(
-            "view",
-            [[calib_path] + imio.list_frame_files(src) for src in sources],
-            config_json=view_cfg, io_workers=cfg.parallel.io_workers,
-            timeout_s=_lane_budget_s(cfg, "cache"))
     for i, src in enumerate(sources):
         hit = cache.get("view", view_keys[i])
         if hit is not None:
@@ -2133,7 +2170,7 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
             log=log)
     else:
         # a clean (re)run must not advertise a previous run's failures
-        stale = os.path.join(out_dir, "failures.json")
+        stale = os.path.join(out_dir, tel.host_scoped("failures.json"))
         if os.path.exists(stale):
             os.remove(stale)
 

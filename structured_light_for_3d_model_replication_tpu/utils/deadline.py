@@ -252,8 +252,11 @@ class Watchdog:
         self.run_id = run_id
         self.log = log or (lambda m: None)
         self.breaches: list[dict] = []
-        self.stalls_path = (os.path.join(out_dir, "stalls.json")
-                            if out_dir else None)
+        # host-scoped in coordinated-run workers (stalls.w0-123.json) so N
+        # workers sharing an out dir never clobber each other's evidence
+        self.stalls_path = (
+            os.path.join(out_dir, telemetry.host_scoped("stalls.json"))
+            if out_dir else None)
         self._hb_trace_min_s = float(heartbeat_trace_min_s)
         self._lock = threading.Lock()
         self._beats: dict[str, float] = {}

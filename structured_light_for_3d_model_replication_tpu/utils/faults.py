@@ -23,6 +23,11 @@ supplies the two halves of making that chain resilient:
                          permanent hit falls back to the identity transform)
    ``http.capture``      phone HTTP frame capture (acquire/android.py)
    ``serial.rotate``     turntable rotate+wait (acquire/turntable.py)
+   ``worker.item``       coordinated-run worker item execution (item is
+                         ``"<worker_id>:<item_id>"``; parallel/worker.py)
+   ``coord.grant``       coordinator lease grant (item is
+                         ``"<worker_id>:<item_id>"``; the coordinator-crash
+                         site for resume tests; parallel/coordinator.py)
    ====================  ====================================================
 
 2. **Retry/quarantine toolkit** — the exception classifier
@@ -36,10 +41,11 @@ Fault-spec grammar (comma-separated rules)::
     site[~substr]:kind[@n][xM][%p]
 
     kind     transient | permanent | crash | stall[(T)] | slow[(T)]
+             | worker.kill | worker.preempt[(T)] | net.partition[(T)]
     ~substr  only fire() calls whose item contains substr count as hits
     @n       arm on the n-th matching hit (1-based; default 1)
-    xM       fire at most M times (default: 1 for transient/crash/
-             stall/slow, unlimited for permanent)
+    xM       fire at most M times (default: unlimited for permanent,
+             1 for every other kind)
     %p       each armed hit fires with probability p (seeded RNG)
 
 Examples::
@@ -66,6 +72,26 @@ instead — so injected hangs are always bounded and chaos tests terminate.
 ``stall`` is the hang the deadline layer must catch (pick T above the
 lane's deadline); ``slow`` is the straggler that must trip only the SOFT
 watchdog threshold and still complete.
+
+The **host-scope kinds** model whole-process fates in a coordinated
+multi-process run (parallel/coordinator.py):
+
+  ``worker.kill``        raises :class:`WorkerKilled` (an
+                         :class:`InjectedCrash`): the worker loop turns it
+                         into an immediate ``os._exit`` — SIGKILL at item
+                         granularity, no cleanup, no journal close
+  ``worker.preempt(T)``  raises :class:`WorkerPreempted` (also an
+                         :class:`InjectedCrash`) carrying a ``grace_s`` of
+                         T: the worker loop stops taking work and exits
+                         after the grace window — the cloud-VM preemption
+                         notice shape
+  ``net.partition(T)``   raises :class:`NetPartition` (a
+                         :class:`TransientFault`) carrying ``duration_s``:
+                         the worker's coordinator client drops its
+                         connection and stays dark for T seconds before
+                         reconnecting — long enough partitions expire the
+                         worker's leases and exercise steal + the
+                         stolen-item late-complete path
 """
 from __future__ import annotations
 
@@ -84,9 +110,11 @@ from structured_light_for_3d_model_replication_tpu.utils import telemetry
 
 __all__ = [
     "InjectedFault", "TransientFault", "PermanentFault", "InjectedCrash",
+    "WorkerKilled", "WorkerPreempted", "NetPartition",
     "FaultRule", "FaultPlan", "configure", "configure_from", "reset", "fire",
     "active_plan", "is_transient", "RetryPolicy", "retry_call", "annotate",
-    "FailureRecord", "STALL_DEFAULT_S", "SLOW_DEFAULT_S",
+    "jitter_rng", "FailureRecord", "STALL_DEFAULT_S", "SLOW_DEFAULT_S",
+    "PREEMPT_GRACE_DEFAULT_S", "PARTITION_DEFAULT_S",
 ]
 
 
@@ -118,17 +146,51 @@ class InjectedCrash(BaseException):
     (tmp+rename, startup sweeps, the stage cache) may mask its effects."""
 
 
+class WorkerKilled(InjectedCrash):
+    """Host-scope ``worker.kill``: the worker loop must die IMMEDIATELY
+    (``os._exit``, no cleanup) — the SIGKILL / OOM-kill simulation. An
+    InjectedCrash subclass so no per-item handler can absorb it."""
+
+
+class WorkerPreempted(InjectedCrash):
+    """Host-scope ``worker.preempt(T)``: the worker got a preemption notice
+    with ``grace_s`` seconds to vacate. The loop stops taking work and
+    exits after the grace window; in-flight leases expire and are stolen."""
+
+    def __init__(self, detail: str, grace_s: float):
+        super().__init__(detail)
+        self.grace_s = grace_s
+
+
+class NetPartition(TransientFault):
+    """Host-scope ``net.partition(T)``: the worker's link to the
+    coordinator goes dark for ``duration_s`` seconds. Transient — the
+    worker survives, reconnects, and may find its leases stolen."""
+
+    def __init__(self, detail: str, duration_s: float):
+        super().__init__(detail)
+        self.duration_s = duration_s
+
+
 # ---------------------------------------------------------------------------
 # the fault plan
 # ---------------------------------------------------------------------------
 
-_KINDS = ("transient", "permanent", "crash", "stall", "slow")
+_KINDS = ("transient", "permanent", "crash", "stall", "slow",
+          "worker.kill", "worker.preempt", "net.partition")
+
+# the kinds that accept a ``(T)`` duration, and what T means for each:
+# stall/slow block for T; worker.preempt grants T of grace before the
+# forced exit; net.partition keeps the link dark for T
+_DURATION_KINDS = ("stall", "slow", "worker.preempt", "net.partition")
 
 # default block durations for the non-raising kinds when no ``(T)`` is
 # given. Long enough to trip production-default lane deadlines / the
 # watchdog; chaos tests pass explicit small durations
 STALL_DEFAULT_S = 30.0
 SLOW_DEFAULT_S = 1.0
+PREEMPT_GRACE_DEFAULT_S = 0.5
+PARTITION_DEFAULT_S = 1.0
 
 
 @dataclass
@@ -166,9 +228,10 @@ class FaultRule:
         if kind not in _KINDS:
             raise ValueError(
                 f"fault rule {text!r}: kind {kind!r} not in {_KINDS}")
-        if duration is not None and kind not in ("stall", "slow"):
+        if duration is not None and kind not in _DURATION_KINDS:
             raise ValueError(
-                f"fault rule {text!r}: only stall/slow take a (T) duration")
+                f"fault rule {text!r}: only "
+                f"{'/'.join(_DURATION_KINDS)} take a (T) duration")
         if times is None:
             times = math.inf if kind == "permanent" else 1
         return cls(site=site.strip(), kind=kind, match=match,
@@ -177,14 +240,23 @@ class FaultRule:
 
     @property
     def block_s(self) -> float:
-        """Effective block duration for the stall/slow kinds."""
+        """Effective ``(T)`` duration for the duration-taking kinds."""
         if self.duration_s is not None:
             return self.duration_s
-        return STALL_DEFAULT_S if self.kind == "stall" else SLOW_DEFAULT_S
+        return {"stall": STALL_DEFAULT_S,
+                "worker.preempt": PREEMPT_GRACE_DEFAULT_S,
+                "net.partition": PARTITION_DEFAULT_S,
+                }.get(self.kind, SLOW_DEFAULT_S)
 
     def throw(self) -> None:
         detail = (f"injected {self.kind} fault at {self.site}"
                   + (f" (match {self.match!r})" if self.match else ""))
+        if self.kind == "worker.kill":
+            raise WorkerKilled(detail)
+        if self.kind == "worker.preempt":
+            raise WorkerPreempted(detail, grace_s=self.block_s)
+        if self.kind == "net.partition":
+            raise NetPartition(detail, duration_s=self.block_s)
         if self.kind == "crash":
             raise InjectedCrash(detail)
         if self.kind == "transient":
@@ -200,6 +272,10 @@ class FaultPlan:
         self.rules = rules
         self.seed = seed
         self._rng = random.Random(seed)
+        # a SEPARATE seeded stream for retry-backoff jitter: drawing
+        # jitter from ``_rng`` would shift the %p decision sequence,
+        # changing which faults fire between jittered and unjittered runs
+        self._jitter_rng = random.Random(seed ^ 0x6A77)
         self._lock = threading.Lock()
 
     @classmethod
@@ -236,7 +312,7 @@ class FaultPlan:
             tr.instant("fault.injected", site=site, kind=hit.kind,
                        item=text or None,
                        duration_s=(hit.block_s
-                                   if hit.kind in ("stall", "slow")
+                                   if hit.kind in _DURATION_KINDS
                                    else None))
         if hit.kind in ("stall", "slow"):
             # block, then RESUME normally (a wedge that eventually
@@ -347,16 +423,40 @@ def is_transient(exc: BaseException) -> bool:
 class RetryPolicy:
     """Bounded exponential backoff: retry ``max_retries`` times, sleeping
     ``backoff_base_s * 2**(retry-1)`` (capped at ``backoff_max_s``) before
-    each. ``max_retries=0`` disables retrying entirely."""
+    each. ``max_retries=0`` disables retrying entirely.
+
+    ``jitter=True`` turns each sleep into FULL jitter — uniform in
+    ``[0, delay_s(retry)]`` — so N workers tripping over the same
+    transient (a coordinator blip, a shared-mount hiccup) spread their
+    retries instead of thundering back in lockstep. The draw comes from
+    the armed fault plan's seeded jitter stream (:func:`jitter_rng`), so
+    chaos tests stay reproducible; ``delay_s`` itself stays deterministic
+    (it is the CEILING, and what retry logs/traces may quote)."""
 
     max_retries: int = 2
     backoff_base_s: float = 0.05
     backoff_max_s: float = 1.0
+    jitter: bool = False
 
     def delay_s(self, retry: int) -> float:
-        """Backoff before the ``retry``-th retry (1-based)."""
+        """Deterministic backoff ceiling before the ``retry``-th retry
+        (1-based). With ``jitter``, the actual sleep is drawn uniformly
+        below this inside :func:`retry_call`."""
         return min(self.backoff_base_s * (2.0 ** (retry - 1)),
                    self.backoff_max_s)
+
+
+_JITTER_FALLBACK = random.Random()
+
+
+def jitter_rng() -> random.Random:
+    """The seeded jitter stream when a fault plan is armed (deterministic
+    chaos runs), else an OS-seeded RNG (real runs, where true randomness
+    is exactly what anti-herd jitter wants)."""
+    plan = _PLAN
+    if plan is not None:
+        return plan._jitter_rng
+    return _JITTER_FALLBACK
 
 
 def retry_call(fn, policy: RetryPolicy, *, classify=is_transient,
@@ -382,13 +482,15 @@ def retry_call(fn, policy: RetryPolicy, *, classify=is_transient,
                 raise
             if on_retry is not None:
                 on_retry(retries_done + 1, e)
+            delay = policy.delay_s(retries_done + 1)
+            if policy.jitter:
+                delay = jitter_rng().uniform(0.0, delay)
             tr = telemetry.current()
             if tr is not None:
                 tr.instant("retry", attempt=retries_done + 1,
                            error=type(e).__name__,
-                           backoff_s=round(policy.delay_s(retries_done + 1),
-                                           4))
-            sleep(policy.delay_s(retries_done + 1))
+                           backoff_s=round(delay, 4))
+            sleep(delay)
             attempts += 1
 
 

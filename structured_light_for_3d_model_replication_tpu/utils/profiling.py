@@ -29,7 +29,26 @@ from structured_light_for_3d_model_replication_tpu.utils import (
 from structured_light_for_3d_model_replication_tpu.utils import telemetry
 
 __all__ = ["StageTimer", "OverlapStats", "trace", "get_logger",
-           "attach_callback", "attached_callback", "detach_callback"]
+           "attach_callback", "attached_callback", "detach_callback",
+           "set_heartbeat_hook"]
+
+# ambient progress-heartbeat hook (the faults._PLAN / telemetry._TRACER
+# pattern): a coordinated-run worker installs its lease-renewal client
+# here so EVERY ``OverlapStats.add`` — the same call that accumulates lane
+# walls and feeds the stall watchdog — also renews the worker's leases.
+# Liveness-as-seen-by-the-coordinator and actual compute progress come
+# from one call site and cannot drift. The hook must never raise (the
+# client swallows its own socket errors); one None check when unset.
+_HEARTBEAT: "callable | None" = None
+
+
+def set_heartbeat_hook(hook) -> "callable | None":
+    """Install (or clear, with None) the ambient progress-heartbeat hook;
+    returns the previous hook so nested scopes can restore it."""
+    global _HEARTBEAT
+    prev = _HEARTBEAT
+    _HEARTBEAT = hook
+    return prev
 
 _LOGGER_NAME = "sl3d"
 
@@ -223,6 +242,9 @@ class OverlapStats:
         # pattern), so liveness and accounting cannot disagree. One None
         # check when no watchdog is armed.
         _deadline.beat(stage)
+        hb = _HEARTBEAT
+        if hb is not None:   # coordinated-run lease renewal, same call site
+            hb(stage)
         tr = telemetry.current()
         if tr is not None:
             tr.lane(stage, elapsed_s, view=view)
@@ -279,6 +301,9 @@ class OverlapStats:
             self._pairs_dispatched += n
             self._stage_s["register"] += dispatch_s
         _deadline.beat("register")
+        hb = _HEARTBEAT
+        if hb is not None:
+            hb("register")
         tr = telemetry.current()
         if tr is not None:
             # the register wall includes launch dispatch — mirror it as a

@@ -62,6 +62,7 @@ __all__ = [
     "SCHEMA", "Tracer", "MetricsRegistry", "current", "activate",
     "deactivate", "new_run_id", "stage", "read_journal",
     "export_chrome_trace", "prometheus_text",
+    "set_host_tag", "host_tag", "host_scoped",
 ]
 
 SCHEMA = "sl3d-trace-v1"
@@ -77,10 +78,49 @@ _SECONDS_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 _COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
 
+# host-scope identity for coordinated multi-process runs: when N workers
+# share one out dir, every run id and crash artifact (failures.json,
+# stalls.json, trace.jsonl, metrics.json) must carry the writer's identity
+# or the workers clobber each other's evidence. Unset (the default, and
+# the coordinator/single-process case) everything keeps its canonical name.
+_HOST_TAG: str | None = None
+
+
+def set_host_tag(tag: str | None) -> str | None:
+    """Install this process's host tag (``w<rank>-<pid>`` in worker
+    processes; None restores canonical names). Returns the previous tag so
+    nested scopes can restore it."""
+    global _HOST_TAG
+    prev = _HOST_TAG
+    _HOST_TAG = tag or None
+    return prev
+
+
+def host_tag() -> str | None:
+    return _HOST_TAG
+
+
+def host_scoped(filename: str) -> str:
+    """Stamp the host tag into an artifact filename (before the extension:
+    ``failures.json`` -> ``failures.w0-1234.json``). Identity when no tag
+    is set — the single-process path is unchanged, byte for byte."""
+    if _HOST_TAG is None:
+        return filename
+    stem, dot, ext = filename.rpartition(".")
+    if not dot:
+        return f"{filename}.{_HOST_TAG}"
+    return f"{stem}.{_HOST_TAG}.{ext}"
+
+
 def new_run_id() -> str:
-    """Sortable, collision-safe run identifier (UTC stamp + random hex)."""
-    return (time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
-            + "-" + os.urandom(4).hex())
+    """Sortable, collision-safe run identifier (UTC stamp + random hex;
+    the host tag is appended in worker processes so per-host journals
+    merge without ambiguity)."""
+    rid = (time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+           + "-" + os.urandom(4).hex())
+    if _HOST_TAG is not None:
+        rid += "-" + _HOST_TAG
+    return rid
 
 
 # ---------------------------------------------------------------------------

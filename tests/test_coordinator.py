@@ -1,0 +1,235 @@
+"""Host-fault-domain tests for the multiprocess coordinator (ISSUE 9).
+
+The acceptance anchor, asserted directly: one scan sharded across N
+worker subprocesses produces PLY+STL bytes IDENTICAL to the
+single-process run — clean, with a worker SIGKILLed mid-run, and with
+the coordinator itself crashed and resumed. Workers are cache-warmers
+and assembly is the proven single-process pipeline, so parity is by
+construction; these tests assert the construction held.
+
+Worker faults are armed via the ``SL3D_FAULTS`` env (spawned worker
+processes re-arm from it; this pytest process never fires worker sites).
+Coordinator faults are armed in-process (``coord.grant`` fires in the
+coordinator, which runs in this process).
+"""
+import json
+import os
+
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.cli import main as cli_main
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.parallel.coordinator import (
+    LEDGER_SCHEMA,
+    Ledger,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline import (
+    report as replib,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
+VIEWS = 5
+PROJ = (64, 32)
+STEPS = ("statistical",)
+N_ITEMS = VIEWS + (VIEWS - 1)       # view items + streamed pair items
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("coordds"))
+    rc = cli_main(["synth", root, "--views", str(VIEWS),
+                   "--cam", "96x72", "--proj", f"{PROJ[0]}x{PROJ[1]}"])
+    assert rc == 0
+    return root
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_env():
+    yield
+    os.environ.pop("SL3D_FAULTS", None)
+    os.environ.pop("SL3D_FAULTS_SEED", None)
+    faults.reset()
+
+
+def _cfg(workers: int = 0, trace: bool = False) -> Config:
+    cfg = Config()
+    cfg.parallel.backend = "numpy"
+    cfg.decode.n_cols, cfg.decode.n_rows = PROJ
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 256
+    cfg.merge.icp_iters = 6
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    cfg.coordinator.workers = workers
+    cfg.observability.trace = trace
+    return cfg
+
+
+def _run(dataset: str, out: str, workers: int = 0,
+         trace: bool = False):
+    return stages.run_pipeline(os.path.join(dataset, "calib.mat"), dataset,
+                               out, cfg=_cfg(workers, trace), steps=STEPS,
+                               log=lambda m: None)
+
+
+def _bytes(out: str, name: str) -> bytes:
+    with open(os.path.join(out, name), "rb") as f:
+        return f.read()
+
+
+@pytest.fixture(scope="module")
+def baseline(dataset, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("coord_sp"))
+    rep = _run(dataset, out)
+    assert rep.failed == [] and not rep.degraded
+    return _bytes(out, "merged.ply"), _bytes(out, "model.stl")
+
+
+def _assert_parity(baseline, out: str) -> None:
+    ply, stl = baseline
+    assert _bytes(out, "merged.ply") == ply, "merged.ply differs"
+    assert _bytes(out, "model.stl") == stl, "model.stl differs"
+
+
+def _ledger_events(out: str) -> list[dict]:
+    with open(os.path.join(out, "ledger.jsonl")) as f:
+        return [json.loads(line) for line in f]
+
+
+# ---------------------------------------------------------------------------
+# byte parity: clean / worker kill / coordinator crash + resume
+# ---------------------------------------------------------------------------
+
+def test_two_workers_clean_byte_parity(dataset, baseline, tmp_path):
+    out = str(tmp_path / "out")
+    rep = _run(dataset, out, workers=2)
+    assert not rep.degraded and rep.coordinator is not None
+    _assert_parity(baseline, out)
+    replay = Ledger.replay(os.path.join(out, "ledger.jsonl"))
+    assert len(replay["completed"]) == N_ITEMS
+    assert rep.coordinator["items_total"] == N_ITEMS
+    assert set(rep.coordinator["completed_by_worker"]) <= {"w0", "w1"}
+
+
+def test_four_workers_clean_byte_parity(dataset, baseline, tmp_path):
+    out = str(tmp_path / "out")
+    rep = _run(dataset, out, workers=4)
+    assert not rep.degraded
+    _assert_parity(baseline, out)
+    assert len(Ledger.replay(
+        os.path.join(out, "ledger.jsonl"))["completed"]) == N_ITEMS
+
+
+def test_worker_kill_costs_only_inflight_items(dataset, baseline, tmp_path):
+    """SIGKILL w0 on its first granted item: the coordinator must reap
+    the corpse, steal the orphaned lease, regrant to the survivor, and
+    the scan must still be byte-identical — plus per-host artifact
+    scoping (satellite 1): the dead worker's journal survives under its
+    own rank/pid-stamped filename and `report` merges all hosts."""
+    out = str(tmp_path / "out")
+    os.environ["SL3D_FAULTS"] = "worker.item~w0:worker.kill"
+    rep = _run(dataset, out, workers=2, trace=True)
+    assert not rep.degraded
+    _assert_parity(baseline, out)
+    events = _ledger_events(out)
+    steals = [e for e in events if e["type"] == "steal"]
+    assert len(steals) >= 1
+    assert any(e["worker"] == "w0" for e in steals)
+    assert rep.coordinator["steals"] >= 1
+    # every item still completed (the survivor picked up the slack)
+    assert len(Ledger.replay(
+        os.path.join(out, "ledger.jsonl"))["completed"]) == N_ITEMS
+    # per-host journals: assembly's trace.jsonl + at least the surviving
+    # worker's trace.w<rank>-<pid>.jsonl, merged with a host column
+    journals = replib.host_journals(out, "trace.jsonl")
+    assert len(journals) >= 2
+    for j in journals:
+        assert replib.validate_journal(j) == []
+    rows = replib.merge_host_timeline(out, "trace.jsonl")
+    assert rows and all("host" in r for r in rows)
+    hosts = {r["host"] for r in rows}
+    assert any(h.startswith("w1-") for h in hosts), hosts
+
+
+def test_coordinator_crash_and_resume_zero_recompute(dataset, baseline,
+                                                     tmp_path):
+    """Crash the coordinator on its 3rd grant (AFTER >= 1 item completed
+    and journaled), then rerun into the same out dir: the ledger replay
+    must credit the completed prefix with zero recompute, and the final
+    artifacts must still be byte-identical."""
+    out = str(tmp_path / "out")
+    faults.configure("coord.grant:crash@3")
+    with pytest.raises(faults.InjectedCrash):
+        _run(dataset, out, workers=2)
+    faults.reset()
+    # segment 1 is on disk; by grant 3 at least one complete is journaled
+    # (with 2 workers, grant 3 only happens after a worker finished one)
+    replay1 = Ledger.replay(os.path.join(out, "ledger.jsonl"))
+    assert replay1["segments"] == 1
+    assert len(replay1["completed"]) >= 1
+
+    rep = _run(dataset, out, workers=2)
+    assert not rep.degraded
+    _assert_parity(baseline, out)
+    assert rep.coordinator["resumed_completed"] == len(replay1["completed"])
+    # zero recompute: the resumed run only rebuilt the un-journaled items
+    assert rep.coordinator["items_total"] == \
+        N_ITEMS - len(replay1["completed"])
+    replay2 = Ledger.replay(os.path.join(out, "ledger.jsonl"))
+    assert replay2["segments"] == 2
+    assert len(replay2["completed"]) == N_ITEMS
+
+
+# ---------------------------------------------------------------------------
+# ledger replay discipline (no dataset needed)
+# ---------------------------------------------------------------------------
+
+def test_ledger_replay_roundtrip(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    led = Ledger(path, run_id="r1", meta={"workers": 2})
+    led.event("grant", item="view:0", worker="w0", gen=0)
+    led.event("complete", item="view:0", worker="w0", gen=0)
+    led.event("grant", item="view:1", worker="w1", gen=0)
+    led.close()
+    replay = Ledger.replay(path)
+    assert replay["completed"] == {"view:0"}
+    assert replay["segments"] == 1
+
+
+def test_ledger_replay_tolerates_torn_tail(tmp_path):
+    """A coordinator killed mid-write leaves a partial last line; replay
+    must keep every whole record and drop the torn tail."""
+    path = str(tmp_path / "ledger.jsonl")
+    led = Ledger(path, run_id="r1", meta={})
+    led.event("complete", item="view:0", worker="w0", gen=0)
+    led.close()
+    with open(path, "a") as f:
+        f.write('{"type": "complete", "item": "view:1", "wor')
+    replay = Ledger.replay(path)
+    assert replay["completed"] == {"view:0"}
+
+
+def test_ledger_replay_rejects_unknown_schema(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"type": "meta", "schema": "bogus-v9",
+                            "run_id": "r1"}) + "\n")
+    with pytest.raises(ValueError):
+        Ledger.replay(path)
+
+
+def test_ledger_segments_accumulate(tmp_path):
+    """Each coordinator start appends a new meta head (segment) to the
+    same file; completed items union across segments."""
+    path = str(tmp_path / "ledger.jsonl")
+    for i in range(2):
+        led = Ledger(path, run_id=f"r{i}", meta={})
+        led.event("complete", item=f"view:{i}", worker="w0", gen=0)
+        led.close()
+    replay = Ledger.replay(path)
+    assert replay["segments"] == 2
+    assert replay["completed"] == {"view:0", "view:1"}
+    head = json.loads(open(path).readline())
+    assert head["schema"] == LEDGER_SCHEMA

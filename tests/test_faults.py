@@ -225,3 +225,121 @@ def test_failure_record_from_annotated_exception():
                                                 ValueError("bad"))
     assert plain.stage == "compute" and plain.attempts == 1
     assert not plain.transient
+
+
+# ---------------------------------------------------------------------------
+# host-scope kinds (ISSUE 9): worker.kill / worker.preempt(T) /
+# net.partition(T)
+# ---------------------------------------------------------------------------
+
+def test_host_kind_grammar_and_duration_defaults():
+    k = faults.FaultRule.parse("worker.item~w0:worker.kill")
+    assert k.kind == "worker.kill" and k.match == "w0"
+    assert k.times == 1                      # host kinds fire once by default
+
+    p = faults.FaultRule.parse("worker.item:worker.preempt")
+    assert p.kind == "worker.preempt"
+    assert p.block_s == faults.PREEMPT_GRACE_DEFAULT_S
+    p2 = faults.FaultRule.parse("worker.item:worker.preempt(0.3)")
+    assert p2.block_s == 0.3
+
+    n = faults.FaultRule.parse("worker.item:net.partition")
+    assert n.kind == "net.partition"
+    assert n.block_s == faults.PARTITION_DEFAULT_S
+    n2 = faults.FaultRule.parse("worker.item~w1:net.partition(2.5)@2")
+    assert n2.block_s == 2.5 and n2.arm_at == 2 and n2.match == "w1"
+
+
+def test_host_kind_exception_types_and_payloads():
+    faults.configure("a:worker.kill,b:worker.preempt(0.7),"
+                     "c:net.partition(1.2)")
+    # worker.kill / worker.preempt simulate host death: InjectedCrash
+    # (BaseException) so they escape `except Exception` fault barriers
+    with pytest.raises(faults.WorkerKilled):
+        faults.fire("a")
+    assert issubclass(faults.WorkerKilled, faults.InjectedCrash)
+    with pytest.raises(faults.WorkerPreempted) as ei:
+        faults.fire("b")
+    assert ei.value.grace_s == 0.7
+    assert issubclass(faults.WorkerPreempted, faults.InjectedCrash)
+    # a partition is connectivity loss, not death: transient by contract
+    with pytest.raises(faults.NetPartition) as ei:
+        faults.fire("c")
+    assert ei.value.duration_s == 1.2
+    assert isinstance(ei.value, faults.TransientFault)
+    assert faults.is_transient(ei.value)
+
+
+def test_host_kinds_fire_once_by_default():
+    faults.configure("worker.item:worker.kill")
+    with pytest.raises(faults.WorkerKilled):
+        faults.fire("worker.item", item="w0:view:0")
+    faults.fire("worker.item", item="w0:view:1")     # second hit: no-op
+
+
+# ---------------------------------------------------------------------------
+# retry backoff jitter (ISSUE 9 satellite): full jitter, seeded
+# ---------------------------------------------------------------------------
+
+def test_jitter_draws_are_seed_deterministic():
+    """jitter=True draws each sleep uniformly from [0, exponential
+    ceiling] using the plan's dedicated seeded stream: two runs with the
+    same seed sleep IDENTICALLY; a different seed draws differently."""
+    policy = faults.RetryPolicy(max_retries=3, backoff_base_s=0.1,
+                                backoff_max_s=0.25, jitter=True)
+
+    def draws(seed: int) -> list[float]:
+        faults.configure("x:transient", seed=seed)  # arms the jitter rng
+        sleeps = []
+        with pytest.raises(faults.TransientFault):
+            faults.retry_call(_always_transient, policy,
+                              sleep=sleeps.append)
+        faults.reset()
+        return sleeps
+
+    a, b, c = draws(7), draws(7), draws(8)
+    assert a == b
+    assert a != c
+    assert len(a) == 3
+    # full jitter: every draw within its deterministic ceiling
+    for got, ceiling in zip(a, [0.1, 0.2, 0.25]):
+        assert 0.0 <= got <= ceiling
+
+
+def _always_transient():
+    raise faults.TransientFault("again")
+
+
+def test_jitter_off_keeps_exact_schedule():
+    """The default (jitter=False) stays byte-for-byte the PR-3 schedule —
+    existing deadline/backoff acceptance tests rely on it."""
+    policy = faults.RetryPolicy(max_retries=3, backoff_base_s=0.1,
+                                backoff_max_s=0.25)
+    assert policy.jitter is False
+    sleeps = []
+    with pytest.raises(faults.TransientFault):
+        faults.retry_call(_always_transient, policy, sleep=sleeps.append)
+    assert sleeps == [0.1, 0.2, 0.25]
+
+
+def test_jitter_does_not_shift_probabilistic_decisions():
+    """Drawing jitter must come from a SEPARATE stream: a %p plan fires
+    the same faults whether or not retries jitter."""
+    def fired_sites(jitter: bool) -> list[int]:
+        faults.configure("s:transientx100%0.5", seed=3)
+        policy = faults.RetryPolicy(max_retries=2, backoff_base_s=0.01,
+                                    backoff_max_s=0.02, jitter=jitter)
+        hits = []
+        for i in range(30):
+            try:
+                faults.fire("s", item=str(i))
+            except faults.TransientFault:
+                hits.append(i)
+                # burn some jittered (or fixed) retry sleeps in between
+                with pytest.raises(faults.TransientFault):
+                    faults.retry_call(_always_transient, policy,
+                                      sleep=lambda s: None)
+        faults.reset()
+        return hits
+
+    assert fired_sites(jitter=False) == fired_sites(jitter=True)

@@ -1,0 +1,137 @@
+"""Unit tests for the lease table (parallel/lease.py) on a fake clock.
+
+Contract under test (ISSUE 9): at most one ACTIVE lease per item;
+generations are monotonic for the item's lifetime (no ABA — a late
+complete from a stolen generation can never be credited); renewal is
+per-worker (one heartbeat renews everything the worker holds); expiry is
+clock-driven so a wedged worker that stops heartbeating loses exactly
+its in-flight items.
+"""
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.parallel.lease import (
+    LeaseTable,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture()
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture()
+def table(clock):
+    return LeaseTable(lease_s=10.0, clock=clock)
+
+
+def test_grant_complete_roundtrip(table):
+    lease = table.grant("view:0", "w0")
+    gen = lease.gen
+    assert gen == 0 and lease.worker == "w0"
+    assert table.holder("view:0") == "w0"
+    assert table.active_count() == 1
+    assert table.complete("view:0", "w0", gen)
+    assert table.holder("view:0") is None
+    assert table.active_count() == 0
+
+
+def test_double_grant_is_a_bug(table):
+    table.grant("view:0", "w0")
+    with pytest.raises(RuntimeError):
+        table.grant("view:0", "w1")
+
+
+def test_expiry_is_clock_driven(table, clock):
+    table.grant("view:0", "w0")
+    clock.advance(9.9)
+    assert table.expired() == []
+    clock.advance(0.2)
+    exp = table.expired()
+    assert [ls.item for ls in exp] == ["view:0"]
+    assert exp[0].worker == "w0"
+
+
+def test_renew_is_per_worker(table, clock):
+    table.grant("view:0", "w0")
+    table.grant("view:1", "w0")
+    table.grant("view:2", "w1")
+    clock.advance(8.0)
+    assert table.renew("w0") == 2      # renews BOTH of w0's leases
+    clock.advance(4.0)                 # t=12: w1's lease (t0+10) is dead,
+    expired = {ls.item for ls in table.expired()}
+    assert expired == {"view:2"}       # w0's (renewed to t8+10) are not
+
+
+def test_steal_bumps_generation_and_blocks_late_complete(table, clock):
+    g0 = table.grant("view:0", "w0").gen
+    clock.advance(11.0)
+    g1 = table.steal("view:0")
+    assert g1 == g0 + 1
+    assert table.holder("view:0") is None
+    # the stolen-generation complete must be rejected...
+    assert not table.complete("view:0", "w0", g0)
+    # ...and the regrant carries the new generation
+    assert table.grant("view:0", "w1").gen == g1
+    assert table.complete("view:0", "w1", g1)
+
+
+def test_generations_never_reset(table, clock):
+    """No ABA: steal -> regrant -> steal again keeps counting up, so a
+    complete from ANY older epoch is rejectable by generation alone."""
+    gens = [table.grant("view:0", "w0").gen]
+    for i in range(3):
+        clock.advance(11.0)
+        gens.append(table.steal("view:0"))
+        table.grant("view:0", f"w{i + 1}")
+    assert gens == [0, 1, 2, 3]
+    assert table.steals("view:0") == 3
+
+
+def test_complete_requires_exact_triple(table):
+    gen = table.grant("view:0", "w0").gen
+    assert not table.complete("view:0", "w1", gen)     # wrong worker
+    assert not table.complete("view:0", "w0", gen + 1)  # wrong generation
+    assert not table.complete("view:9", "w0", gen)     # unknown item
+    assert table.complete("view:0", "w0", gen)         # exact match wins
+    assert not table.complete("view:0", "w0", gen)     # and only once
+
+
+def test_drop_worker_revokes_all_its_leases(table):
+    table.grant("view:0", "w0")
+    table.grant("view:1", "w0")
+    table.grant("view:2", "w1")
+    revoked = sorted(table.drop_worker("w0"))
+    assert revoked == ["view:0", "view:1"]
+    assert table.active_count() == 1
+    # a drop counts like a steal: the generation is bumped so the dead
+    # worker's in-flight completes are rejected on arrival
+    assert table.steals("view:0") == 1
+    assert not table.complete("view:0", "w0", 0)
+    assert table.grant("view:0", "w2").gen == 1
+
+
+def test_renew_unknown_worker_is_zero(table):
+    assert table.renew("ghost") == 0
+
+
+def test_steal_of_unleased_item_still_bumps(table, clock):
+    """Stealing an item with no active lease (races between the expiry
+    sweep and an observed-dead drop) is safe: the generation keeps
+    climbing — monotonic, never reused — so stale completes stay
+    rejectable; it never resurrects a lease."""
+    table.grant("view:0", "w0")
+    clock.advance(11.0)
+    g1 = table.steal("view:0")
+    assert table.steal("view:0") == g1 + 1
+    assert table.holder("view:0") is None

@@ -69,3 +69,47 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def test_connect_timeout_bounds_unreachable_coordinator():
+    """ISSUE 9 satellite: initialize() with connect_timeout_s must raise a
+    diagnostic DeadlineExceeded when the coordinator never answers, instead
+    of hanging in the gloo client forever. Run in a subprocess so the
+    abandoned join thread and any half-initialized distributed state die
+    with the child."""
+    port = _free_port()      # bound + released: nothing listens on it
+    code = f"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+from structured_light_for_3d_model_replication_tpu.parallel import multihost
+from structured_light_for_3d_model_replication_tpu.utils import deadline as dl
+import time
+t0 = time.monotonic()
+try:
+    multihost.initialize("127.0.0.1:{port}", num_processes=2, process_id=0,
+                         connect_timeout_s=2.0)
+except dl.DeadlineExceeded as e:
+    wall = time.monotonic() - t0
+    assert "127.0.0.1:{port}" in str(e), str(e)
+    assert "num_processes=2" in str(e), str(e)
+    assert wall < 30.0, wall
+    print("timeout ok %.1fs" % wall)
+else:
+    print("NO TIMEOUT", file=sys.stderr)
+    sys.exit(1)
+"""
+    env = os.environ.copy()
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, env=env)
+    try:
+        out, err = p.communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        p.communicate()
+        raise AssertionError("initialize() hung despite connect_timeout_s")
+    assert p.returncode == 0, f"rc={p.returncode}\nstdout:{out}\nstderr:{err[-2000:]}"
+    assert "timeout ok" in out

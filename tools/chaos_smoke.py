@@ -130,10 +130,51 @@ def main() -> int:
             return fail(f"stall case: expected DeadlineExceeded, got "
                         f"{recs[0]['error_type']}")
 
+        # ---- worker-kill case (ISSUE 9): kill EVERY worker on its first
+        # granted item (the site has no ~substr filter and each worker
+        # process arms its own fault plan, so the once-only counter fires
+        # once per worker). Total worker loss must never hang or abort:
+        # the coordinator journals the unfinished items as lost and the
+        # single-process assembly pass recomputes them, so the run still
+        # exits 0 and ships the STL.
+        os.environ["SL3D_FAULTS"] = "worker.item:worker.kill"
+        out3 = os.path.join(tmp, "out_wkill")
+        rc = cli_main([
+            "pipeline", root, "--out", out3, "--workers", "2",
+            "--calib", os.path.join(root, "calib.mat"),
+            "--steps", "statistical",
+            "--set", "parallel.backend=numpy",
+            "--set", "decode.n_cols=128", "--set", "decode.n_rows=64",
+            "--set", "decode.thresh_mode=manual",
+            "--set", "merge.voxel_size=4.0",
+            "--set", "merge.ransac_trials=512",
+            "--set", "merge.icp_iters=10",
+            "--set", "mesh.depth=5",
+            "--set", "mesh.density_trim_quantile=0",
+        ])
+        os.environ.pop("SL3D_FAULTS", None)
+        if rc != 0:
+            return fail(f"worker-kill pipeline rc={rc} (losing every "
+                        f"worker must degrade to single-process assembly, "
+                        f"not hang or abort)")
+        stl3 = os.path.join(out3, "model.stl")
+        if not os.path.exists(stl3) or os.path.getsize(stl3) == 0:
+            return fail("merged STL missing after all-workers-killed run")
+        lost = 0
+        with open(os.path.join(out3, "ledger.jsonl")) as f:
+            for line in f:
+                ev = json.loads(line)
+                if ev.get("type") == "lost":
+                    lost += 1
+        if lost < 1:
+            return fail("all-workers-killed run journaled no lost items")
+
         print(f"CHAOS_SMOKE=ok (1 view quarantined, "
               f"{manifest['retries']} retry(ies), STL "
               f"{os.path.getsize(stl)} bytes from 4/5 views; stall case: "
-              f"1 DeadlineExceeded quarantine, STL shipped)")
+              f"1 DeadlineExceeded quarantine, STL shipped; worker-kill "
+              f"case: 2/2 workers killed, {lost} item(s) lost, STL "
+              f"shipped)")
         return 0
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -86,16 +86,32 @@ else
 fi
 
 # ---- soak smoke: 3 seeded runs over a randomized fault matrix
-# (transient/permanent/crash/stall/slow mixes) — every run must
-# TERMINATE within budget with a schema-valid trace journal (ISSUE 7);
-# longer sweeps: python tools/soak.py --runs 20 ----
+# (transient/permanent/crash/stall/slow mixes) plus 1 coordinated
+# 2-worker run from the host-scope kill matrix — every run must
+# TERMINATE within budget with a schema-valid trace journal (ISSUE 7)
+# and a replayable ledger (ISSUE 9); longer sweeps:
+# python tools/soak.py --runs 20 ----
 soak_rc=0
-soak=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/soak.py --runs 3 --views 4 --budget-s 150 2>&1) || soak_rc=$?
+soak=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/soak.py --runs 3 --views 4 --budget-s 150 --multiproc-runs 1 2>&1) || soak_rc=$?
 echo "$soak" > tools/_ci/soak_smoke.log
 if [ $soak_rc -eq 0 ] && echo "$soak" | grep -q 'SOAK=ok'; then
   echo "$soak" | grep 'SOAK=ok'
 else
   echo "SOAK_SMOKE=FAIL (rc=$soak_rc; see tools/_ci/soak_smoke.log)"
+  [ $rc -eq 0 ] && rc=1
+fi
+
+# ---- multiproc smoke: one scan sharded across 2 worker processes with
+# a seeded worker.kill (w0 dies on its first granted item) must exit 0,
+# journal the steal, and produce PLY+STL byte-identical to the
+# single-process run (ISSUE 9's acceptance anchor) ----
+mproc_rc=0
+mproc=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/multiproc_smoke.py 2>&1) || mproc_rc=$?
+echo "$mproc" > tools/_ci/multiproc_smoke.log
+if [ $mproc_rc -eq 0 ] && echo "$mproc" | grep -q 'MULTIPROC_SMOKE=ok'; then
+  echo "$mproc" | grep 'MULTIPROC_SMOKE=ok'
+else
+  echo "MULTIPROC_SMOKE=FAIL (rc=$mproc_rc; see tools/_ci/multiproc_smoke.log)"
   [ $rc -eq 0 ] && rc=1
 fi
 exit $rc

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Multiprocess coordinator smoke for CI (run by tools/ci_tier1.sh).
+
+Renders a 5-view synthetic turntable dataset and runs the same scan
+twice: once single-process (the trusted baseline) and once sharded
+across 2 worker processes with a seeded host fault —
+``worker.item~w0:worker.kill`` SIGKILLs worker w0 on its first granted
+item (ISSUE 9's acceptance anchor). Asserts the host-fault-domain
+contract:
+
+  - both runs exit 0 — a killed worker costs only its in-flight items
+  - merged.ply and model.stl are BYTE-IDENTICAL across the two runs
+    (workers are cache-warmers; assembly is the proven single-process
+    pipeline, so parity is by construction — this asserts it held)
+  - the coordinator journaled the kill: ledger.jsonl replays cleanly
+    and contains >= 1 steal event (the dead worker's lease, stolen and
+    regranted to the survivor)
+
+Prints ``MULTIPROC_SMOKE=ok`` (exit 0) or ``MULTIPROC_SMOKE=FAIL (...)``
+(exit 1).
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# kill worker w0 on its first granted item (fires once); spawned worker
+# processes inherit the env, the coordinator process never fires
+# worker.* sites, so exactly one worker dies exactly once
+FAULT_SPEC = "worker.item~w0:worker.kill"
+
+PIPE_FLAGS = [
+    "--steps", "statistical",
+    "--set", "parallel.backend=numpy",
+    "--set", "decode.n_cols=128", "--set", "decode.n_rows=64",
+    "--set", "decode.thresh_mode=manual",
+    "--set", "merge.voxel_size=4.0",
+    "--set", "merge.ransac_trials=512",
+    "--set", "merge.icp_iters=10",
+    "--set", "mesh.depth=5",
+    "--set", "mesh.density_trim_quantile=0",
+]
+
+
+def fail(why: str) -> int:
+    print(f"MULTIPROC_SMOKE=FAIL ({why})")
+    return 1
+
+
+def _read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def main() -> int:
+    # the baseline run must be fault-free even if the CI env is dirty
+    os.environ.pop("SL3D_FAULTS", None)
+    os.environ["SL3D_FAULTS_SEED"] = "0"
+    from structured_light_for_3d_model_replication_tpu.cli import (
+        main as cli_main,
+    )
+    from structured_light_for_3d_model_replication_tpu.parallel.coordinator import (  # noqa: E501
+        Ledger,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="slmproc_")
+    try:
+        root = os.path.join(tmp, "dataset")
+        out_sp = os.path.join(tmp, "out_single")
+        out_mp = os.path.join(tmp, "out_multi")
+        rc = cli_main(["synth", root, "--views", "5",
+                       "--cam", "160x120", "--proj", "128x64"])
+        if rc != 0:
+            return fail(f"synth rc={rc}")
+        calib = ["--calib", os.path.join(root, "calib.mat")]
+
+        rc = cli_main(["pipeline", root, "--out", out_sp]
+                      + calib + PIPE_FLAGS)
+        if rc != 0:
+            return fail(f"single-process pipeline rc={rc}")
+
+        # worker processes inherit this env; w0 dies on its first item
+        os.environ["SL3D_FAULTS"] = FAULT_SPEC
+        rc = cli_main(["pipeline", root, "--out", out_mp, "--workers", "2"]
+                      + calib + PIPE_FLAGS)
+        os.environ.pop("SL3D_FAULTS", None)
+        if rc != 0:
+            return fail(f"coordinated pipeline rc={rc} (a killed worker "
+                        f"must cost only its in-flight items)")
+
+        for name in ("merged.ply", "model.stl"):
+            a, b = os.path.join(out_sp, name), os.path.join(out_mp, name)
+            if not os.path.exists(b):
+                return fail(f"{name} missing from coordinated run")
+            if _read(a) != _read(b):
+                return fail(f"{name} differs from single-process run "
+                            f"({os.path.getsize(a)} vs "
+                            f"{os.path.getsize(b)} bytes)")
+
+        ledger_path = os.path.join(out_mp, "ledger.jsonl")
+        if not os.path.exists(ledger_path):
+            return fail("ledger.jsonl missing from coordinated run")
+        replay = Ledger.replay(ledger_path)
+        steals = 0
+        with open(ledger_path) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("type") == "steal":
+                    steals += 1
+        if steals < 1:
+            return fail("no steal event journaled for the killed worker")
+        print(f"MULTIPROC_SMOKE=ok (2 workers, 1 killed; "
+              f"{len(replay['completed'])} item(s) journaled complete, "
+              f"{steals} steal(s); PLY+STL byte-identical to "
+              f"single-process)")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

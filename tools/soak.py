@@ -21,6 +21,11 @@ wall seconds (a belt-and-braces SIGALRM dumps all thread stacks and fails
 the soak if even that is violated), and each run's trace journal must
 schema-validate so a stalled run is always diagnosable from artifacts.
 
+``--multiproc-runs`` (ISSUE 9) appends a host-scope kill matrix: 2-worker
+coordinated runs under seeded ``worker.kill`` / ``worker.preempt(T)`` /
+``net.partition(T)`` rules. The same never-hang contract applies, plus
+the work ledger must replay and every per-host journal must validate.
+
 Prints ``SOAK=ok runs=N ...`` (exit 0) or ``SOAK=FAIL (...)`` (exit 1).
 CI runs a short arm (``tools/ci_tier1.sh`` SOAK_SMOKE); longer sweeps:
 
@@ -47,6 +52,13 @@ SITES = ["frame.load", "compute.view", "ply.write", "cache.get",
          "cache.put", "register.pair", "http.capture", "serial.rotate"]
 KINDS = ["transient", "permanent", "crash", "stall(0.8)", "slow(0.3)"]
 
+# host-scope kill matrix (ISSUE 9): every rule targets the per-item
+# worker site, so a drawn rule SIGKILLs / preempts / partitions a worker
+# process mid-scan; the coordinator must steal the orphaned leases and
+# the run must still terminate with a replayable ledger
+HOST_KINDS = ["worker.kill", "worker.preempt(0.3)", "net.partition(0.8)"]
+HOST_MATCH = ["", "w0", "w1"]
+
 
 def fail(why: str) -> int:
     print(f"SOAK=FAIL ({why})")
@@ -63,6 +75,15 @@ def _spec_for(rng: random.Random, view_names: list[str]) -> str:
     return ",".join(rules)
 
 
+def _host_spec_for(rng: random.Random) -> str:
+    rules = []
+    for _ in range(rng.randint(1, 2)):
+        kind = rng.choice(HOST_KINDS)
+        match = rng.choice(HOST_MATCH)
+        rules.append(f"worker.item{'~' + match if match else ''}:{kind}")
+    return ",".join(rules)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--runs", type=int, default=5)
@@ -71,6 +92,10 @@ def main() -> int:
     ap.add_argument("--budget-s", type=float, default=150.0,
                     help="per-run wall ceiling; a run past it fails the "
                          "soak (the never-hang assertion)")
+    ap.add_argument("--multiproc-runs", type=int, default=3,
+                    help="additional 2-worker coordinated runs drawn from "
+                         "the host-scope kill matrix (worker.kill / "
+                         "worker.preempt / net.partition); 0 disables")
     args = ap.parse_args()
 
     from structured_light_for_3d_model_replication_tpu.cli import (
@@ -83,9 +108,13 @@ def main() -> int:
     from structured_light_for_3d_model_replication_tpu.pipeline import stages
     from structured_light_for_3d_model_replication_tpu.utils import faults
 
+    from structured_light_for_3d_model_replication_tpu.parallel.coordinator import (  # noqa: E501
+        Ledger,
+    )
+
     # last line of defense: if the deadline layer itself wedges, dump every
     # thread's stack and die loudly instead of hanging CI
-    alarm_s = int(args.budget_s * args.runs + 120)
+    alarm_s = int(args.budget_s * (args.runs + args.multiproc_runs) + 120)
 
     def on_alarm(signum, frame):
         faulthandler.dump_traceback(all_threads=True)
@@ -174,8 +203,72 @@ def main() -> int:
             outcomes[outcome] = outcomes.get(outcome, 0) + 1
             print(f"[soak] run {i}: {outcome:<9} {wall:5.1f}s  [{spec}]")
 
+        # ---- multiprocess kill matrix (ISSUE 9): coordinated 2-worker
+        # runs under seeded host faults. Spawned workers arm from the
+        # SL3D_FAULTS env (which wins over config), so the drawn rule
+        # kills / preempts / partitions real OS processes; the
+        # coordinator must steal orphaned leases and EVERY run must
+        # terminate within budget with a replayable ledger, schema-valid
+        # journals, and (on abort) a failure manifest.
+        for i in range(args.multiproc_runs):
+            spec = _host_spec_for(rng)
+            out = os.path.join(tmp, f"out_mp_{i:03d}")
+            mpcfg = cfg()
+            mpcfg.coordinator.workers = 2
+            # short leases so an orphaned lease is stolen within seconds
+            # (spurious expiry on a slow-but-alive item is safe: the late
+            # complete is journaled and the cache entry stays warm)
+            mpcfg.coordinator.lease_s = 6.0
+            mpcfg.coordinator.heartbeat_s = 0.5
+            os.environ["SL3D_FAULTS"] = spec
+            os.environ["SL3D_FAULTS_SEED"] = str(args.seed + 1000 + i)
+            t0 = time.monotonic()
+            outcome = "completed"
+            try:
+                rep = stages.run_pipeline(calib, root, out, cfg=mpcfg,
+                                          steps=("statistical",),
+                                          log=lambda m: None)
+                if rep.degraded:
+                    outcome = "degraded"
+            except faults.InjectedCrash:
+                outcome = "crashed"
+            except Exception as e:
+                outcome = "aborted"
+                if not os.path.exists(os.path.join(out, "failures.json")):
+                    return fail(f"mp run {i} [{spec}] aborted "
+                                f"({type(e).__name__}: {e}) without a "
+                                f"failure manifest")
+            finally:
+                os.environ.pop("SL3D_FAULTS", None)
+                os.environ.pop("SL3D_FAULTS_SEED", None)
+                faults.reset()
+            wall = time.monotonic() - t0
+            walls.append(round(wall, 1))
+            if wall > args.budget_s:
+                return fail(f"mp run {i} [{spec}] took {wall:.1f}s > "
+                            f"{args.budget_s}s budget — a hang the "
+                            f"coordinator failed to bound")
+            ledger = os.path.join(out, "ledger.jsonl")
+            if not os.path.exists(ledger):
+                return fail(f"mp run {i} [{spec}] left no ledger")
+            try:
+                Ledger.replay(ledger)
+            except ValueError as e:
+                return fail(f"mp run {i} [{spec}] ledger invalid: {e}")
+            # every per-host journal (coordinator assembly + each worker,
+            # killed ones included) must schema-validate
+            for journal in replib.host_journals(out, "trace.jsonl"):
+                errors = replib.validate_journal(journal)
+                if errors:
+                    return fail(f"mp run {i} [{spec}] journal "
+                                f"{os.path.basename(journal)} invalid: "
+                                f"{errors[:3]}")
+            outcomes[f"mp-{outcome}"] = outcomes.get(f"mp-{outcome}", 0) + 1
+            print(f"[soak] mp run {i}: {outcome:<9} {wall:5.1f}s  [{spec}]")
+
         summary = json.dumps(outcomes, sort_keys=True)
         print(f"SOAK=ok runs={args.runs} seed={args.seed} "
+              f"multiproc={args.multiproc_runs} "
               f"outcomes={summary} max_wall={max(walls)}s")
         return 0
     finally:
