@@ -275,6 +275,14 @@ class PipelineConfig:
     run_budget_s: float = field(
         default_factory=lambda: float(
             os.environ.get("SL3D_RUN_BUDGET_S", "0")))
+    # HBM-resident view fastpath (batched executor only): the drain
+    # compacts + cleans each batch on device and syncs results with ONE
+    # jax.device_get; cleaned device buffers hand to the streaming
+    # registrar without a re-upload. Byte-identical outputs to the
+    # discrete drain (same jitted clean programs on the same bits); any
+    # failure inside degrades to the per-view lane. Opt-in while the
+    # discrete arm remains the reference path.
+    fused_clean: bool = False
 
 
 def _env_flag(name: str) -> bool:

@@ -31,8 +31,8 @@ from structured_light_for_3d_model_replication_tpu.ops import (
 
 __all__ = ["merge_360", "merge_360_posegraph", "preprocess_for_registration",
            "chamfer_distance", "DeviceClouds", "compact_views_device",
-           "stack_views_device", "prep_view", "register_prep_pairs",
-           "finalize_chain"]
+           "stack_views_device", "prep_view", "prep_view_device",
+           "register_prep_pairs", "finalize_chain"]
 
 
 @dataclass
@@ -499,6 +499,43 @@ def prep_view(points, voxel: float, sample_before: int = 0) -> _Prep:
     bucket = _bucket_pad(cnt, n_raw)
     # survivors occupy a contiguous slot prefix (pinned by
     # test_voxel_downsample_survivor_prefix), so the bucket slice is sound
+    p_c = p_all[:bucket]
+    v_c = jnp.arange(bucket, dtype=jnp.int32) < cnt
+    nr, feat = _prep_features_jit(p_c, v_c,
+                                  jnp.float32(FEAT_RADIUS_SCALE * voxel))
+    return _Prep(p_c, v_c, nr, feat)
+
+
+@functools.partial(jax.jit, static_argnames=("n_raw",))
+def _repad_view_jit(pts, n, n_raw: int):
+    # the compacted gather's tail rows (>= n) hold REAL unselected
+    # coordinates, not sentinels — re-sentinel them before re-padding so
+    # the voxel grid sees exactly prep_view's host-padded 1e9 rows
+    rows = jnp.arange(pts.shape[0], dtype=jnp.int32)
+    p = jnp.where(rows[:, None] < n, pts, jnp.float32(1e9))
+    if n_raw > pts.shape[0]:
+        p = jnp.concatenate(
+            [p, jnp.full((n_raw - pts.shape[0], 3), 1e9, jnp.float32)])
+    return p, jnp.arange(n_raw, dtype=jnp.int32) < n
+
+
+def prep_view_device(points, count: int, voxel: float) -> _Prep:
+    """:func:`prep_view` consuming a DEVICE buffer (the fused clean's
+    compacted per-view output) without the host round-trip: rows below
+    ``count`` are the view's points in prefix order; the tail is
+    re-sentineled and the array re-padded to the same 8192-multiple the
+    host prep uses, so every downstream shape, jit program, and bit
+    matches ``prep_view(host_points)`` exactly (``count`` is a dynamic
+    argument — no per-count retrace)."""
+    n = int(count)
+    n_raw = -(-max(n, 1) // 8192) * 8192
+    if points.shape[0] > n_raw:   # cannot happen on _bucket_pad inputs
+        points = points[:n_raw]
+    p_pad, valid = _repad_view_jit(jnp.asarray(points, jnp.float32),
+                                   jnp.int32(n), n_raw)
+    p_all, v_all = _voxel_view_jit(p_pad, valid, jnp.float32(voxel))
+    cnt = int(np.asarray(v_all.sum()))            # one small sync
+    bucket = _bucket_pad(cnt, n_raw)
     p_c = p_all[:bucket]
     v_c = jnp.arange(bucket, dtype=jnp.int32) < cnt
     nr, feat = _prep_features_jit(p_c, v_c,

@@ -1,0 +1,152 @@
+"""Device-resident view fastpath: decode output -> cleaned cloud in HBM.
+
+The batched executor's discrete drain syncs the WHOLE decode slot stack to
+host ([V, H*W] slots at ~15-25% occupancy), boolean-masks each view on host,
+then `_clean_arrays` re-uploads every cloud for the jitted clean chain and
+syncs the masks back — three bulk round-trips per view of which two move
+mostly padding. This module fuses the span: the batch's clouds are
+compacted, bucket-padded, cleaned, and final-mask-compacted entirely on
+device, and the ONE host sync is a single ``jax.device_get`` of the
+per-view compact results (the collect/writeback boundary). The cleaned
+device buffers additionally hand to the streaming registrar as-is
+(``prep_view_device``), so pair prep consumes HBM-resident points without
+another upload.
+
+Byte parity with the discrete arm is BY CONSTRUCTION, not by tolerance:
+
+  - device compaction is the stable valid-first order
+    (``_compact_order_counts_jit``), which is exactly the row order host
+    boolean masking produces;
+  - each view's clean input is rebuilt to the identical array
+    ``_clean_arrays`` would upload: the same ``_bucket_pad(n)`` bucket,
+    real points in the prefix, ``1e9`` sentinel rows after, validity
+    ``arange < n`` — so ``pc.clean_chain`` runs the SAME jitted program on
+    the same bits and emits identical masks;
+  - the final-mask selection replicates the host chain's abort-at-zero
+    semantics on device: step counts are monotone non-increasing, so
+    ``argmax(cnts == 0)`` IS the host loop's first-zero break index.
+
+Gray -> RGB replication happens on host after the final slice (replicate
+commutes with row masking), matching ``triangulate.compact_cloud``.
+"""
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.models import (
+    reconstruction as recon,
+)
+from structured_light_for_3d_model_replication_tpu.ops import pointcloud as pc
+
+__all__ = ["fused_clean_views", "FusedView"]
+
+
+@dataclass
+class FusedView:
+    """One cleaned view out of the fused drain: host arrays for the
+    write/collect boundary plus the device-resident compact points the
+    registrar's ``prep_view_device`` consumes without re-upload."""
+    points: np.ndarray          # [n,3] f32, final-mask compacted
+    colors: np.ndarray          # [n,3] u8 (gray replicated host-side)
+    dev_points: object          # [bucket,3] f32 device array, prefix order
+    count: int                  # n — valid prefix length of dev_points
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def _gather_pad_jit(pts, cols, order, n, bucket: int):
+    """Gather one view's survivors (prefix of the compaction order) into a
+    ``_bucket_pad(n)`` bucket and rebuild EXACTLY the array _clean_arrays
+    uploads: sentinel 1e9 rows / zero colors / ``arange < n`` validity.
+    ``n`` is dynamic — no per-count retrace; the bucket is the only static
+    shape key (the _view_bucket ladder keeps it bounded)."""
+    take = min(bucket, pts.shape[0])
+    o = order[:take]
+    p = jnp.take(pts, o, axis=0)
+    c = jnp.take(cols, o, axis=0)
+    if bucket > take:   # view nearly full: bucket rounds past the slot count
+        p = jnp.concatenate([p, jnp.zeros((bucket - take, 3), p.dtype)])
+        c = jnp.concatenate(
+            [c, jnp.zeros((bucket - take, c.shape[1]), c.dtype)])
+    rows = jnp.arange(bucket, dtype=jnp.int32)
+    p = jnp.where(rows[:, None] < n, p, jnp.float32(1e9))
+    c = jnp.where(rows[:, None] < n, c, jnp.uint8(0))
+    return p, c, rows < n
+
+
+@jax.jit
+def _select_clean_jit(pts, cols, masks, cnts):
+    """Apply the chain's FINAL mask (host abort-at-zero semantics: counts
+    are monotone non-increasing, so the first zero step — argmax of the
+    boolean — is where the host loop breaks; otherwise the last mask) and
+    compact survivors to the prefix, all on device."""
+    fidx = jnp.where((cnts == 0).any(), jnp.argmax(cnts == 0),
+                     masks.shape[0] - 1)
+    final = masks[fidx]
+    order, n2 = recon._compact_order_counts_jit(final[None])
+    return (jnp.take(pts, order[0], axis=0),
+            jnp.take(cols, order[0], axis=0), n2[0])
+
+
+def _cache_sizes() -> dict:
+    """Jit-cache sizes of the fused helpers (the no-retrace gauge tests
+    pin: same bucket ladder -> stable sizes across batches)."""
+    return {"gather": _gather_pad_jit._cache_size(),
+            "select": _select_clean_jit._cache_size()}
+
+
+def fused_clean_views(points, colors, valid, clean_cfg, steps):
+    """Compact + clean + final-compact every view of one decoded batch on
+    device; sync the results with ONE ``jax.device_get``.
+
+    ``points`` [V,S,3] f32 / ``colors`` [V,S,C] u8 / ``valid`` [V,S] bool —
+    a batched ``CloudResult`` still on device. Returns
+    ``(views, d2h_bytes, clean_s)``: per-view :class:`FusedView`, the bulk
+    device->host bytes that one sync moved, and the wall spent dispatching
+    the clean-chain programs (the drain splits its lane accounting on it).
+    """
+    pts_v = jnp.asarray(points)
+    cols_v = jnp.asarray(colors)
+    val_v = jnp.asarray(valid)
+    if pts_v.shape[1] > (1 << recon._COMPACT_IOTA_BITS):
+        raise ValueError(
+            f"fused clean supports up to 2^{recon._COMPACT_IOTA_BITS} slots "
+            f"per view, got {pts_v.shape[1]}")   # caller degrades per-view
+    params = pc.chain_params(clean_cfg, tuple(steps)) if steps else ()
+
+    order_v, cnts_d = recon._compact_order_counts_jit(val_v)
+    cnts = np.asarray(cnts_d).astype(int)         # one small [V] sync
+    clean_s = 0.0
+    staged = []
+    for j in range(pts_v.shape[0]):
+        n = int(cnts[j])
+        bucket = recon._bucket_pad(n)             # _clean_arrays' bucket
+        p_b, c_b, v_b = _gather_pad_jit(pts_v[j], cols_v[j], order_v[j],
+                                        jnp.int32(n), bucket)
+        if params:
+            t0 = time.perf_counter()
+            masks_d, cnts_step = pc.clean_chain(p_b, v_b, clean_cfg,
+                                                tuple(steps))
+            p_c, c_c, n2 = _select_clean_jit(p_b, c_b, masks_d, cnts_step)
+            clean_s += time.perf_counter() - t0
+        else:
+            p_c, c_c, n2 = p_b, c_b, jnp.int32(n)
+        staged.append((p_c, c_c, n2))
+
+    host = jax.device_get(staged)                 # THE one bulk sync
+    d2h = sum(int(p.nbytes + c.nbytes + np.asarray(n).nbytes)
+              for p, c, n in host)
+    views = []
+    for (p_c, _c_c, _n2), (p_h, c_h, n2_h) in zip(staged, host):
+        n2 = int(n2_h)
+        p_out = np.asarray(p_h[:n2], np.float32)
+        c_out = np.asarray(c_h[:n2], np.uint8)
+        if c_out.ndim == 2 and c_out.shape[-1] == 1:
+            c_out = np.repeat(c_out, 3, axis=1)   # compact_cloud's gray->RGB
+        views.append(FusedView(p_out, c_out, p_c, n2))
+    return views, d2h, clean_s

@@ -37,7 +37,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["use_pallas", "pallas_mode", "nn1", "radius_count_pallas",
            "decode_maps_fused", "scan_points_fused_views",
-           "slab_mean_knn", "slab_bisect_ok"]
+           "slab_mean_knn", "slab_bisect_ok",
+           "knn_mean", "knn_mean_np", "knn_mean_ok",
+           "ransac_score", "ransac_score_np", "ransac_score_ok",
+           "kernel_report"]
 
 _FAR = 1e9
 
@@ -45,6 +48,13 @@ _PALLAS_MODE: str | None = None  # "compiled" | "interpret" (probe result, cache
 _VIEWS_KERNEL_OK = True          # view-batched decode lowering probe result
 _SCAN_FUSED_OK = True            # fused decode+triangulate lowering probe result
 _SLAB_BISECT_OK = True           # slab bisection kernel probe result
+_KNN_MEAN_OK = True              # dense knn-mean kernel probe result
+_RANSAC_SCORE_OK = True          # RANSAC hypothesis-scoring kernel probe result
+
+# candidate-count cutoff for the dense knn-mean kernel: any d2 at or below
+# these f32 bits is a REAL candidate (valid rows park at _FAR, so their
+# squared distances sit around 1e18 — an order of magnitude above)
+_KNN_R2_BITS = int(np.float32(1e17).view(np.int32))
 
 
 def slab_bisect_ok() -> bool:
@@ -59,6 +69,48 @@ def scan_fused_ok() -> bool:
     """True when the fused scan kernel compiled in the capability probe
     (always True in interpret mode — tests exercise it explicitly)."""
     return use_pallas() and _SCAN_FUSED_OK
+
+
+def knn_mean_ok() -> bool:
+    """True when the COMPILED dense knn-mean kernel passed its capability
+    probe (False in interpret mode — the outlier stage then keeps its jnp
+    fallthrough; CPU parity tests run the kernel via interpret explicitly).
+    ``SLSCAN_KNN_KERNEL=0`` is the operator kill switch."""
+    if os.environ.get("SLSCAN_KNN_KERNEL", "").strip().lower() in (
+            "0", "off", "false"):
+        return False
+    return use_pallas() and _KNN_MEAN_OK
+
+
+def ransac_score_ok() -> bool:
+    """True when the COMPILED RANSAC hypothesis-scoring kernel passed its
+    capability probe. ``SLSCAN_RANSAC_KERNEL=0`` is the kill switch; the
+    caller (_ransac_core) additionally rides the existing nn_mode="pallas"
+    try/except, so a surprise at score time degrades to the chunked jnp
+    scoring exactly like an nn1 failure does."""
+    if os.environ.get("SLSCAN_RANSAC_KERNEL", "").strip().lower() in (
+            "0", "off", "false"):
+        return False
+    return use_pallas() and _RANSAC_SCORE_OK
+
+
+def kernel_report() -> dict:
+    """Per-kernel capability verdicts (probe results + kill switches) —
+    what `sl3d warmup` logs so an operator can see which Mosaic lowerings
+    this process will actually dispatch."""
+    mode = pallas_mode()
+    compiled = mode == "compiled"
+    return {
+        "mode": mode,
+        "nn1": compiled,
+        "radius_count": compiled,
+        "decode": compiled,
+        "decode_views": compiled and _VIEWS_KERNEL_OK,
+        "scan_fused": scan_fused_ok(),
+        "slab_bisect": slab_bisect_ok(),
+        "knn_mean": knn_mean_ok(),
+        "ransac_score": ransac_score_ok(),
+    }
 
 
 def _probe_compiled() -> bool:
@@ -161,6 +213,66 @@ def _probe_compiled() -> bool:
                 False).compile()
     except Exception:
         _SLAB_BISECT_OK = False
+
+    # dense knn-mean bisection kernel (the statistical-outlier stage on
+    # bucket-resident clouds): COMPILED numeric check against the NumPy
+    # twin, then a compile-only lowering at the production geometry —
+    # a failure demotes only this kernel, the jnp fallthrough remains
+    global _KNN_MEAN_OK
+    try:
+        rngk = np.random.default_rng(11)
+        kpts = rngk.uniform(0.0, 10.0, (96, 3)).astype(np.float32)
+        kval = np.ones(96, bool)
+        kval[90:] = False
+        kmd, kcnt = knn_mean(jnp.asarray(kpts), jnp.asarray(kval), 4,
+                             interpret=False)
+        rmd, rcnt = knn_mean_np(kpts, kval, 4)
+        kfin = np.isfinite(rmd)
+        _KNN_MEAN_OK = bool(
+            kfin.sum() > 50
+            and np.allclose(np.asarray(kmd)[kfin], rmd[kfin], rtol=1e-4)
+            and (np.asarray(kcnt) == rcnt).all())
+        if _KNN_MEAN_OK:
+            Lk = 32768
+            _knn_mean_call.lower(
+                jax.ShapeDtypeStruct((Lk, 8), jnp.float32),
+                jax.ShapeDtypeStruct((8, Lk), jnp.float32),
+                20, _KNN_R2_BITS, 8, False).compile()
+    except Exception:
+        _KNN_MEAN_OK = False
+
+    # RANSAC hypothesis-scoring kernel: COMPILED inlier counts must match
+    # the NumPy twin (±1 borderline slot tolerated — f32 matmul rounding),
+    # then compile-only at a production geometry (4096 trials x 64k pts)
+    global _RANSAC_SCORE_OK
+    try:
+        rngr = np.random.default_rng(12)
+        tn, nn = 16, 96
+        rsrc = rngr.uniform(-1, 1, (nn, 3)).astype(np.float32)
+        rdst = rngr.uniform(-1, 1, (nn, 3)).astype(np.float32)
+        rcs9 = (rdst[:, :, None] * rsrc[:, None, :]).reshape(nn, 9)
+        rR9 = rngr.uniform(-1, 1, (tn, 9)).astype(np.float32)
+        rtt = rngr.uniform(-1, 1, (tn, 3)).astype(np.float32)
+        rt2 = (rtt * rtt).sum(-1)
+        rRt = rngr.uniform(-1, 1, (tn, 3)).astype(np.float32)
+        rsc = ((rsrc * rsrc).sum(-1) + (rdst * rdst).sum(-1)).astype(
+            np.float32)
+        rref = ransac_score_np(rR9, rtt, rt2, rRt, rsrc, rcs9, rdst, rsc, 4.0)
+        rgot = np.asarray(ransac_score(
+            jnp.asarray(rR9), jnp.asarray(rtt), jnp.asarray(rt2),
+            jnp.asarray(rRt), jnp.asarray(rsrc), jnp.asarray(rcs9),
+            jnp.asarray(rdst), jnp.asarray(rsc), 4.0, interpret=False))
+        _RANSAC_SCORE_OK = bool(rref.max() > 0
+                                and np.abs(rgot - rref).max() <= 1)
+        if _RANSAC_SCORE_OK:
+            _ransac_score_call.lower(
+                jax.ShapeDtypeStruct((4096, 16), jnp.float32),
+                jax.ShapeDtypeStruct((65536, 16), jnp.float32),
+                jax.ShapeDtypeStruct((1, 65536), jnp.float32),
+                jax.ShapeDtypeStruct((1,), jnp.float32),
+                128, 2048, False).compile()
+    except Exception:
+        _RANSAC_SCORE_OK = False
     return True
 
 
@@ -882,3 +994,262 @@ def slab_mean_knn(pts_sorted, r: float, k: int, tile: int = 128,
                                   wblk, itp)
     win_end = jnp.repeat((starts_blk + 2) * wblk, tile)
     return mean, cnt, win_end
+
+
+def _kernel_event(name: str, **fields):
+    """Trace-time kernel marker: fires once per (re)trace/launch from the
+    host-side wrapper, so the run journal records WHICH kernels a program
+    took without touching the traced computation. Best-effort — telemetry
+    disabled or absent is never an error on the hot path."""
+    try:
+        from structured_light_for_3d_model_replication_tpu.utils import (
+            telemetry,
+        )
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant(name, **fields)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# knn_mean: dense all-pairs mean-of-k-NN (bucket-resident clouds)
+# ---------------------------------------------------------------------------
+
+def _knn_mean_kernel(q_ref, b_ref, m_ref, n_ref, *, k: int, r2_bits: int,
+                     tile: int, n_base: int, n_iters: int):
+    """Exact mean distance to the k nearest candidates, no sort, no window.
+
+    The dense sibling of _slab_bisect_kernel for the bucket-resident clean
+    chain (clouds <= 32k slots fit whole in VMEM): one program = ``tile``
+    queries vs ALL candidates. Distances by coordinate DIFFERENCES (the
+    package's exact_d2 policy — never the MXU expansion), the k-th order
+    statistic by integer bisection on the f32 bit pattern (exact in <= 31
+    passes), the mean as one masked sum plus the tie correction.
+
+    q_ref [tile, 8] f32; b_ref [8, n_base] f32 (coords in sublanes,
+    candidates along lanes); outputs m_ref [tile, 1] f32 mean,
+    n_ref [tile, 1] i32 count of real candidates (d2 <= r2_bits — valid
+    rows park at _FAR, so their d2 sits an order of magnitude above).
+    """
+    pid = pl.program_id(0)
+    q = q_ref[...]
+    d2 = jnp.zeros((tile, n_base), jnp.float32)
+    for d in range(3):
+        qd = q[:, d][:, None]                        # [tile, 1]
+        cd = b_ref[d, :][None, :]                    # [1, n_base]
+        diff = qd - cd
+        d2 = d2 + diff * diff
+    d2i = jax.lax.bitcast_convert_type(jnp.maximum(d2, 0.0), jnp.int32)
+    # self-exclusion by GLOBAL index, not a distance test
+    qg = pid * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
+    cg = jax.lax.broadcasted_iota(jnp.int32, (1, n_base), 1)
+    d2i = jnp.where(cg == qg, jnp.int32(2**31 - 2), d2i)
+    r2b = jnp.int32(r2_bits)
+    cnt_ok = (d2i <= r2b).astype(jnp.int32).sum(axis=1, keepdims=True)
+
+    def body(_, c):
+        lo, hi = c
+        mid = lo + (hi - lo) // 2
+        cnt = (d2i <= mid).astype(jnp.int32).sum(axis=1, keepdims=True)
+        ge = cnt >= k
+        return jnp.where(ge, lo, mid + 1), jnp.where(ge, mid, hi)
+
+    lo = jnp.zeros((tile, 1), jnp.int32)
+    hi = jnp.full((tile, 1), r2b + 1, jnp.int32)
+    lo, hi = jax.lax.fori_loop(0, n_iters, body, (lo, hi))
+    t = hi                                           # k-th smallest bits
+    lt = d2i < t
+    dist = jnp.sqrt(jax.lax.bitcast_convert_type(d2i, jnp.float32))
+    s = jnp.where(lt, dist, 0.0).sum(axis=1, keepdims=True)
+    c_lt = lt.astype(jnp.int32).sum(axis=1, keepdims=True)
+    tf = jax.lax.bitcast_convert_type(t, jnp.float32)
+    m_ref[...] = (s + (k - c_lt).astype(jnp.float32)
+                  * jnp.sqrt(tf)) / jnp.float32(k)
+    n_ref[...] = cnt_ok
+
+
+@functools.partial(jax.jit, static_argnames=("k", "r2_bits", "tile",
+                                             "interpret"))
+def _knn_mean_call(q8, b8t, k: int, r2_bits: int, tile: int, interpret: bool):
+    L = q8.shape[0]
+    grid = (L // tile,)
+    mean, cnt = pl.pallas_call(
+        functools.partial(_knn_mean_kernel, k=k, r2_bits=r2_bits, tile=tile,
+                          n_base=L, n_iters=31),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, 8), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, L), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((tile, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(jax.ShapeDtypeStruct((L, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((L, 1), jnp.int32)),
+        interpret=interpret,
+    )(q8, b8t)
+    return mean[:, 0], cnt[:, 0]
+
+
+def knn_mean(points, valid, k: int, tile: int = 8,
+             interpret: bool | None = None):
+    """Mean distance to the k nearest VALID neighbors of every point (self
+    excluded), exact, via dense all-pairs bisection (_knn_mean_kernel).
+
+    Returns (mean_d [N] f32 — +inf where the point is invalid or has fewer
+    than k valid neighbors, cnt [N] i32 — valid candidates seen). The
+    engine behind statistical_outlier_mask's kernel arm on bucket-resident
+    clouds; callable traced (inside the fused clean chain) or eagerly."""
+    points = jnp.asarray(points, jnp.float32)
+    if valid is None:
+        valid = jnp.ones(points.shape[0], bool)
+    valid = jnp.asarray(valid)
+    n = points.shape[0]
+    L = -(-max(n, 1) // 128) * 128
+    q8 = _pad8(points, valid, L)
+    b8t = q8.T                                       # [8, L]
+    itp = _interpret() if interpret is None else interpret
+    _kernel_event("kernel.knn_mean", n=int(n), k=int(k),
+                  compiled=not itp,
+                  traced=isinstance(points, jax.core.Tracer))
+    mean, cnt = _knn_mean_call(q8, b8t, int(k), _KNN_R2_BITS, tile, itp)
+    mean = mean[:n]
+    # invalid rows all park at the SAME far coordinate, so they see each
+    # other (and the pad slots) at distance zero — zero their counts, they
+    # carry no signal and the mean is masked to +inf regardless
+    cnt = jnp.where(valid, cnt[:n], 0)
+    return jnp.where(valid & (cnt >= k), mean, jnp.inf), cnt
+
+
+def knn_mean_np(points, valid, k: int):
+    """NumPy numeric twin of ``knn_mean`` (same parking, same cutoff)."""
+    pts = np.asarray(points, np.float32)
+    if valid is None:
+        valid = np.ones(len(pts), bool)
+    val = np.asarray(valid, bool)
+    p = np.where(val[:, None], pts, np.float32(_FAR))
+    d2 = ((p[None, :, :] - p[:, None, :]) ** 2).sum(-1)
+    np.fill_diagonal(d2, np.inf)
+    cnt = np.where(val, (d2 <= np.float32(1e17)).sum(axis=1), 0).astype(
+        np.int32)
+    nd = np.sqrt(np.sort(d2, axis=1)[:, :k])
+    mean = nd.mean(axis=1).astype(np.float32)
+    return np.where(val & (cnt >= k), mean, np.inf).astype(np.float32), cnt
+
+
+# ---------------------------------------------------------------------------
+# ransac_score: hypothesis inlier counting for the RANSAC core
+# ---------------------------------------------------------------------------
+
+def _ransac_score_kernel(h_ref, p_ref, sc_ref, md2_ref, o_ref):
+    """Inlier counts for a block of rigid-transform hypotheses.
+
+    The centered-coordinate d2 expansion of _ransac_core folds into ONE
+    MXU matmul: with H[t] = [Rt, -R9, -tt, t2/2] and P[n] = [src_c, cs9,
+    dst_cc, 1] (both 16-wide), d2[t, n] = sc[n] + 2 * (H @ P^T)[t, n],
+    where sc[n] = s2 + c2 for live correspondences and +inf for dead ones
+    (so they can never count). The output block is revisited along the
+    innermost grid axis — @pl.when(j == 0) zeroes it, every j accumulates.
+
+    h_ref [bt, 16] f32; p_ref [bp, 16] f32; sc_ref [1, bp] f32;
+    md2_ref [1] f32 in SMEM; o_ref [bt, 1] i32.
+    """
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    cross = jax.lax.dot_general(
+        h_ref[...], p_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    d2 = sc_ref[0, :][None, :] + 2.0 * cross
+    inl = d2 <= md2_ref[0]
+    o_ref[...] += inl.sum(axis=1, keepdims=True).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("block_t", "block_p",
+                                             "interpret"))
+def _ransac_score_call(hM, pM, sc, md2, block_t: int, block_p: int,
+                       interpret: bool):
+    t_pad = hM.shape[0]
+    n_pad = pM.shape[0]
+    grid = (t_pad // block_t, n_pad // block_p)
+    counts = pl.pallas_call(
+        _ransac_score_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_t, 16), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_p, 16), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_p), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((block_t, 1), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((t_pad, 1), jnp.int32),
+        interpret=interpret,
+    )(hM, pM, sc, md2)
+    return counts[:, 0]
+
+
+def ransac_score(R9, tt, t2, Rt, src_c, cs9, dst_cc, sc, max_dist2,
+                 block_t: int = 128, block_p: int = 2048,
+                 interpret: bool | None = None):
+    """Inlier counts [T] i32 for T hypotheses against N correspondences.
+
+    Inputs are _ransac_core's scoring prelude, verbatim: R9 [T,9] rotation
+    rows, tt [T,3] effective translation, t2 [T] its square norm, Rt [T,3]
+    R^T t, src_c/dst_cc [N,3] centered coordinates, cs9 [N,9] their outer
+    products, sc [N] = s2+c2 with +inf at dead correspondences, max_dist2
+    the inlier threshold (squared). Padded hypothesis rows are sliced off;
+    padded correspondence slots carry sc=+inf so they never count."""
+    R9 = jnp.asarray(R9, jnp.float32)
+    t = R9.shape[0]
+    n = src_c.shape[0]
+    hM = jnp.concatenate([
+        jnp.asarray(Rt, jnp.float32), -R9,
+        -jnp.asarray(tt, jnp.float32),
+        0.5 * jnp.asarray(t2, jnp.float32)[:, None]], axis=1)
+    pM = jnp.concatenate([
+        jnp.asarray(src_c, jnp.float32), jnp.asarray(cs9, jnp.float32),
+        jnp.asarray(dst_cc, jnp.float32),
+        jnp.ones((n, 1), jnp.float32)], axis=1)
+    block_t = min(block_t, max(8, 1 << (max(t, 1) - 1).bit_length()))
+    block_p = min(block_p, max(128, 1 << (max(n, 1) - 1).bit_length()))
+    t_pad = -(-t // block_t) * block_t
+    n_pad = -(-n // block_p) * block_p
+    hM = jnp.zeros((t_pad, 16), jnp.float32).at[:t].set(hM)
+    pM = jnp.zeros((n_pad, 16), jnp.float32).at[:n].set(pM)
+    scp = jnp.full((1, n_pad), jnp.inf, jnp.float32).at[0, :n].set(
+        jnp.asarray(sc, jnp.float32))
+    md2 = jnp.asarray(max_dist2, jnp.float32).reshape(1)
+    itp = _interpret() if interpret is None else interpret
+    _kernel_event("kernel.ransac_score", trials=int(t), n=int(n),
+                  compiled=not itp,
+                  traced=isinstance(R9, jax.core.Tracer))
+    return _ransac_score_call(hM, pM, scp, md2, block_t, block_p, itp)[:t]
+
+
+def ransac_score_np(R9, tt, t2, Rt, src_c, cs9, dst_cc, sc, max_dist2):
+    """NumPy numeric twin of ``ransac_score`` (same single-matmul fold)."""
+    hM = np.concatenate([
+        np.asarray(Rt, np.float32), -np.asarray(R9, np.float32),
+        -np.asarray(tt, np.float32),
+        0.5 * np.asarray(t2, np.float32)[:, None]], axis=1)
+    pM = np.concatenate([
+        np.asarray(src_c, np.float32), np.asarray(cs9, np.float32),
+        np.asarray(dst_cc, np.float32),
+        np.ones((len(src_c), 1), np.float32)], axis=1)
+    d2 = np.asarray(sc, np.float32)[None, :] + 2.0 * (hM @ pM.T)
+    return (d2 <= np.float32(max_dist2)).sum(axis=-1).astype(np.int32)

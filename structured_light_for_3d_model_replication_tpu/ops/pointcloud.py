@@ -116,6 +116,20 @@ def statistical_outlier_mask(points, valid, nb_neighbors: int = 20,
             # and its exact-brute escape are gone with it)
             return _stat_outlier_voxelized(points, valid, nb_neighbors,
                                            std_ratio, cell)
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pallas_kernels as pk,
+    )
+
+    if n <= 32768 and pk.knn_mean_ok():
+        # bucket-resident clouds where Mosaic compiles (this branch is
+        # reached TRACED inside the fused clean chain, so it must not
+        # consult `concrete`/`accel`): the dense bisection kernel computes
+        # the identical k-NN mean wholly in VMEM — rows with fewer than k
+        # valid neighbors come back +inf, exactly like the brute knn's
+        # inf-padded d2, so the statistics below are unchanged
+        mean_d, _ = pk.knn_mean(points, valid, int(nb_neighbors))
+        return _stat_outlier_from_knn(mean_d, valid, jnp.float32(std_ratio),
+                                      jnp)
     _, d2 = knnlib.knn(points, valid, nb_neighbors)
     mean_d = jnp.sqrt(jnp.maximum(d2, 0.0)).mean(axis=1)
     return _stat_outlier_from_knn(mean_d, valid, jnp.float32(std_ratio), jnp)
@@ -292,10 +306,25 @@ def _voxelized_knn_mean_dist(points, valid, cell, k: int,
             pallas_kernels as pk,
         )
 
-        if pk.slab_bisect_ok() and tile is None and window is None:
+        if (pk.knn_mean_ok() and pts.shape[0] <= 32768 and tile is None
+                and window is None):
+            # small enough that ALL candidates fit one VMEM pass: the
+            # dense bisection kernel needs no sort, no window, and no
+            # certification radius — every row with >= k valid neighbors
+            # comes back exact and finite, so only degenerate rows reach
+            # the caller's host complement
+            selector = "dense"
+        elif pk.slab_bisect_ok() and tile is None and window is None:
             selector, tile, window = "bisect", 64, 8192
         else:
             selector = "topk"
+    if selector == "dense":
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            pallas_kernels as pk,
+        )
+
+        md, _ = pk.knn_mean(pts, val, int(k))
+        return md
     if tile is None:
         tile = 64 if selector == "bisect" else 1024
     if window is None:

@@ -540,31 +540,46 @@ def _ransac_core(src, src_valid, dst, dst_valid, corr_j, corr_ok, max_dist,
     Rt = jnp.einsum("tij,ti->tj", T[:, :3, :3], tt,
                     precision=_MM)  # R^T t [T, 3]
 
-    def score_chunk(args):
-        R9c, ttc, t2c, Rtc = args
-        cross = (jnp.matmul(Rtc, src_c.T, precision=_MM)
-                 - jnp.matmul(R9c, cs9.T, precision=_MM)
-                 - jnp.matmul(ttc, dst_cc.T, precision=_MM))
-        d2 = s2[None, :] + c2[None, :] + t2c[:, None] + 2.0 * cross
-        inl = (d2 <= max_dist * max_dist) & corr_ok[None, :]
-        return inl.sum(-1)
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pallas_kernels as pk,
+    )
 
-    t_chunk = max(1, min(trials, (8 << 20) // max(ns, 1)))
-    pad = (-trials) % t_chunk
-    if pad:
-        # static shapes want equal chunks: pad the hypothesis set to the
-        # next chunk multiple (padded rows score garbage that the slice
-        # below discards) — the 8M-element [T,N] bound holds for ANY
-        # trial count, with no giant-chunk or serialized fallback
-        R9 = jnp.concatenate([R9, jnp.zeros((pad, 9), R9.dtype)])
-        tt = jnp.concatenate([tt, jnp.zeros((pad, 3), tt.dtype)])
-        t2 = jnp.concatenate([t2, jnp.zeros((pad,), t2.dtype)])
-        Rt = jnp.concatenate([Rt, jnp.zeros((pad, 3), Rt.dtype)])
-    counts = jax.lax.map(
-        score_chunk,
-        (R9.reshape(-1, t_chunk, 9), tt.reshape(-1, t_chunk, 3),
-         t2.reshape(-1, t_chunk), Rt.reshape(-1, t_chunk, 3))
-    ).reshape(-1)[:trials]
+    if nn_mode == "pallas" and pk.ransac_score_ok():
+        # Mosaic scoring: the centered expansion above folds into ONE
+        # [T,16] x [16,N] MXU matmul (pallas_kernels._ransac_score_kernel);
+        # dead correspondences carry sc=+inf so they can never count. This
+        # gate rides the same try/except degrade as the pallas nn1
+        # dispatch — any score-time surprise re-runs the registration with
+        # nn_mode="brute" and the chunked jnp scoring below.
+        sc = jnp.where(corr_ok, s2 + c2, jnp.inf)
+        counts = pk.ransac_score(R9, tt, t2, Rt, src_c, cs9, dst_cc, sc,
+                                 max_dist * max_dist)
+    else:
+        def score_chunk(args):
+            R9c, ttc, t2c, Rtc = args
+            cross = (jnp.matmul(Rtc, src_c.T, precision=_MM)
+                     - jnp.matmul(R9c, cs9.T, precision=_MM)
+                     - jnp.matmul(ttc, dst_cc.T, precision=_MM))
+            d2 = s2[None, :] + c2[None, :] + t2c[:, None] + 2.0 * cross
+            inl = (d2 <= max_dist * max_dist) & corr_ok[None, :]
+            return inl.sum(-1)
+
+        t_chunk = max(1, min(trials, (8 << 20) // max(ns, 1)))
+        pad = (-trials) % t_chunk
+        if pad:
+            # static shapes want equal chunks: pad the hypothesis set to
+            # the next chunk multiple (padded rows score garbage that the
+            # slice below discards) — the 8M-element [T,N] bound holds for
+            # ANY trial count, with no giant-chunk or serialized fallback
+            R9 = jnp.concatenate([R9, jnp.zeros((pad, 9), R9.dtype)])
+            tt = jnp.concatenate([tt, jnp.zeros((pad, 3), tt.dtype)])
+            t2 = jnp.concatenate([t2, jnp.zeros((pad,), t2.dtype)])
+            Rt = jnp.concatenate([Rt, jnp.zeros((pad, 3), Rt.dtype)])
+        counts = jax.lax.map(
+            score_chunk,
+            (R9.reshape(-1, t_chunk, 9), tt.reshape(-1, t_chunk, 3),
+             t2.reshape(-1, t_chunk), Rt.reshape(-1, t_chunk, 3))
+        ).reshape(-1)[:trials]
     scores = jnp.where(edge_pass & dist_pass, counts, -1)
     best = jnp.argmax(scores)
     moved_b = transform_points(T[best], src)

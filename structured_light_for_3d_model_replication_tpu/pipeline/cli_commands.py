@@ -174,6 +174,18 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("--pair-batch", type=int, default=None,
                    help="ready pairs per register-lane launch "
                         "(default: merge.pair_batch)")
+    p.add_argument("--fused-clean", dest="fused_clean", action="store_true",
+                   default=None,
+                   help="HBM-resident view fastpath "
+                        "(pipeline.fused_clean): compact + clean + "
+                        "final-compact each batch's views on device and "
+                        "sync once at the collect boundary; byte-identical "
+                        "to the discrete path, degrades per-view on "
+                        "failure (batched executor only)")
+    p.add_argument("--no-fused-clean", dest="fused_clean",
+                   action="store_false",
+                   help="force the discrete host-masked clean path "
+                        "(pipeline.fused_clean=false)")
     p.add_argument("--trace", action="store_true",
                    help="arm the flight recorder (observability.trace; env "
                         "SL3D_TRACE=1): write an append-only crash-safe "
@@ -476,6 +488,8 @@ def _cmd_pipeline(args) -> int:
         cfg.merge.stream = args.stream
     if args.pair_batch is not None:
         cfg.merge.pair_batch = args.pair_batch
+    if args.fused_clean is not None:
+        cfg.pipeline.fused_clean = args.fused_clean
     if args.trace:
         cfg.observability.trace = True
     if args.run_budget is not None:
@@ -507,6 +521,14 @@ def _cmd_pipeline(args) -> int:
                   f"(mean {o['mean_pairs_per_launch']}/launch, register "
                   f"{o['register_s']}s vs critical path "
                   f"{o['critical_path_s']}s)")
+        if o.get("transfer_bytes_h2d") or o.get("transfer_bytes_d2h"):
+            print(f"[pipeline] transfers: "
+                  f"{o.get('transfer_bytes_h2d', 0)} B h2d "
+                  f"({o.get('transfer_bytes_frames', 0)} B frame "
+                  f"uploads) / {o.get('transfer_bytes_d2h', 0)} B d2h")
+        for name, k in sorted((o.get("kernels") or {}).items()):
+            print(f"[pipeline] kernel {name}: {k['launches']} launch(es), "
+                  f"{k['wall_s']}s wall, {k['bytes_moved']} B moved")
     if report.cache:
         print(f"[pipeline] stage cache: {report.cache['hits']} hits, "
               f"{report.cache['misses']} misses")
@@ -937,16 +959,48 @@ def _cmd_warmup(args) -> int:
         n_dev = int(mesh.devices.size) if mesh is not None else 1
         buckets = sorted({_view_bucket(v, cb, n_dev)
                           for v in range(1, cb + 1)})
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            fused_view as fvlib,
+        )
+
+        clean_steps = ("background", "cluster", "radius", "statistical")
         frames_np = np.asarray(frames)
         for b in buckets:
             bucket_stack = np.stack([np.roll(frames_np, 7 * i, axis=2)
                                      for i in range(b)])
             t0 = time.perf_counter()
-            jax.block_until_ready(sc.forward_views_batched(
-                bucket_stack, thresh_mode="manual", mesh=mesh).points)
+            res = sc.forward_views_batched(bucket_stack,
+                                           thresh_mode="manual", mesh=mesh)
+            jax.block_until_ready(res.points)
             print(f"[warmup] forward_views_batched[bucket={b}"
                   f"{f', {n_dev} devices' if mesh is not None else ''}]: "
                   f"{time.perf_counter() - t0:.1f}s")
+            # fused decode->clean ladder: the HBM-resident fastpath runs
+            # its own gather/clean/select programs straight off the decode
+            # output — warm them per bucket so a --fused-clean run pays no
+            # compile inside the drain
+            t0 = time.perf_counter()
+            try:
+                fvlib.fused_clean_views(res.points, res.colors, res.valid,
+                                        cfg.clean, clean_steps)
+                print(f"[warmup] fused_clean[bucket={b}]: "
+                      f"{time.perf_counter() - t0:.1f}s")
+            except Exception as e:
+                print(f"[warmup] fused_clean[bucket={b}] skipped ({e})",
+                      file=sys.stderr)
+
+    # kernel capability probes: each Pallas kernel compiles a tiny probe
+    # once per process and falls back (interpret on CPU, numpy twin on
+    # probe failure) — surface the verdicts so an operator knows which
+    # path real runs will take BEFORE launching one
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pallas_kernels as pk,
+    )
+
+    verdicts = pk.kernel_report()
+    print(f"[warmup] pallas mode: {verdicts.pop('mode')}")
+    for name, ok in sorted(verdicts.items()):
+        print(f"[warmup] kernel {name}: {'ok' if ok else 'fallback'}")
 
     if args.merge_views > 0:
         from structured_light_for_3d_model_replication_tpu.models.reconstruction import (

@@ -122,6 +122,11 @@ class RunAnalysis:
     injected: dict[str, int] = field(default_factory=dict)
     quarantined: list[dict] = field(default_factory=list)
     critical_path_s: float | None = None
+    # kernel table: per-kernel launch/wall/bytes totals with a per-bucket
+    # breakdown (from the `kernel.*` instants the launch accounting and
+    # the Pallas wrappers emit) + the h2d/d2h transfer-byte counters
+    kernels: dict[str, dict] = field(default_factory=dict)
+    transfer: dict[str, int] = field(default_factory=dict)
     manifest: dict | None = None   # failures.json payload
     metrics: dict | None = None    # metrics.json payload
     # stall ledger: watchdog breaches seen in the journal, the last
@@ -208,6 +213,28 @@ def analyze_run(out_dir: str, trace_file: str = "trace.jsonl",
                 a.lane_last_beat[ln] = max(a.lane_last_beat.get(ln, 0.0), t)
             elif name == "executor.finish":
                 a.critical_path_s = ev.get("critical_path_s")
+            elif name == "transfer.bytes":
+                for k in ("h2d", "d2h", "frames"):
+                    v = ev.get(k)
+                    if v:
+                        a.transfer[k] = a.transfer.get(k, 0) + int(v)
+            elif name and name.startswith("kernel."):
+                kn = name[7:]
+                rec = a.kernels.setdefault(
+                    kn, {"launches": 0, "wall_s": 0.0, "bytes": 0,
+                         "compiled": 0, "buckets": {}})
+                rec["launches"] += 1
+                rec["wall_s"] += float(ev.get("wall_s", 0.0) or 0.0)
+                rec["bytes"] += int(ev.get("bytes", 0) or 0)
+                if ev.get("compiled"):
+                    rec["compiled"] += 1
+                b = ev.get("bucket")
+                if b is not None:
+                    bk = rec["buckets"].setdefault(
+                        int(b), {"launches": 0, "wall_s": 0.0, "bytes": 0})
+                    bk["launches"] += 1
+                    bk["wall_s"] += float(ev.get("wall_s", 0.0) or 0.0)
+                    bk["bytes"] += int(ev.get("bytes", 0) or 0)
     a.wall_s = t_max
     for lane in a.lane_intervals:
         a.lane_intervals[lane] = _merge_intervals(a.lane_intervals[lane])
@@ -403,6 +430,27 @@ def render_report(a: RunAnalysis, width: int = 60) -> str:
             L.append(f"  pair batches : {pairs} pair(s) in "
                      f"{len(a.pair_launches)} register launch(es), mean "
                      f"{pairs / len(a.pair_launches):.1f}/launch")
+
+    if a.kernels or a.transfer:
+        L.append("")
+        L.append("kernel table")
+        for kn in sorted(a.kernels):
+            rec = a.kernels[kn]
+            detail = (f", {rec['bytes']} B moved" if rec["bytes"] else "")
+            if rec["compiled"]:
+                detail += f", {rec['compiled']} compiled dispatch(es)"
+            L.append(f"  {kn:<14} {rec['launches']} launch(es), "
+                     f"{rec['wall_s']:.3f}s wall{detail}")
+            for b in sorted(rec["buckets"]):
+                bk = rec["buckets"][b]
+                L.append(f"    bucket {b:<4} x{bk['launches']} "
+                         f"({bk['wall_s']:.3f}s"
+                         + (f", {bk['bytes']} B" if bk["bytes"] else "")
+                         + ")")
+        if a.transfer:
+            L.append(f"  transfers      {a.transfer.get('h2d', 0)} B h2d "
+                     f"({a.transfer.get('frames', 0)} B frame uploads) / "
+                     f"{a.transfer.get('d2h', 0)} B d2h")
 
     if (a.retries or a.failures or a.injected or a.quarantined
             or (a.manifest and a.manifest.get("failures"))):

@@ -634,6 +634,10 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
     dcfg = cfg.decode
     fwd_kw = dict(thresh_mode=dcfg.thresh_mode, shadow_val=dcfg.shadow_val,
                   contrast_val=dcfg.contrast_val)
+    # HBM-resident fastpath (pipeline.fused_clean): the drain compacts +
+    # cleans the whole batch on device and syncs ONCE; any failure inside
+    # degrades to the per-view lane exactly like a poisoned batch
+    use_fused = bool(cfg.pipeline.fused_clean)
 
     mesh = meshlib.views_mesh(cfg.parallel)
     n_dev = int(mesh.devices.size) if mesh is not None else 1
@@ -667,19 +671,35 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
         stats.add("load", time.perf_counter() - t0, view=_item_name(src))
         return out
 
-    def finish_view(idx, src, pts, cols):
+    def finish_view(idx, src, pts, cols, dev=None, cleaned=False):
         """Clean + write/collect ONE compacted view (drain thread) — the
-        per-view tail every executor shares."""
-        if clean_steps is not None:
+        per-view tail every executor shares. ``cleaned`` marks a view the
+        fused drain already cleaned on device; ``dev`` carries its
+        device-resident compact points to the collect hook (the registrar
+        preps them without a re-upload)."""
+        if clean_steps is not None and not cleaned:
             t0 = time.perf_counter()
-            pts, cols, _ = _clean_arrays(pts, cols, cfg, clean_steps)
+
+            def _clean_fired():
+                # the fused-clean injection site fires here too, so a
+                # poisoned view re-running through the per-view lane
+                # quarantines ALONE (its batchmates pass)
+                faults.fire("clean.fused", item=src)
+                return _clean_arrays(pts, cols, cfg, clean_steps,
+                                     stats=stats)
+
+            pts, cols, _ = _retry_stage("clean", _clean_fired, policy,
+                                        lane_retry("clean"))
             stats.add("clean", time.perf_counter() - t0,
                       view=_item_name(src))
         out_path = (_out_path_for(src, mode, output) if write_plys
                     else _item_name(src))
         wfut = wbq.submit(out_path, pts, cols) if write_plys else None
         if collect is not None:
-            collect(idx, src, pts, cols)
+            if dev is not None:
+                collect(idx, src, pts, cols, dev=dev)
+            else:
+                collect(idx, src, pts, cols)
         return ("ok", out_path, len(pts), wfut)
 
     def run_view_fallback(item):
@@ -702,16 +722,50 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
                 raise
             return ("fail", src, e)
 
+    def drain_batch_fused(items, cloud):
+        """HBM-resident drain: compact + clean + final-compact the whole
+        batch on device (ops/fused_view) and sync ONCE. The clean.fused
+        site fires per item BEFORE any device work, so a poisoned batch
+        degrades to the per-view lane, where the site re-fires per view
+        and the poisoned view quarantines alone."""
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            fused_view as fvlib,
+        )
+
+        for _idx, src, _f, _t in items:
+            faults.fire("clean.fused", item=src)
+        t0 = time.perf_counter()
+        views, d2h, clean_s = fvlib.fused_clean_views(
+            cloud.points, cloud.colors, cloud.valid, cfg.clean, clean_steps)
+        wall = time.perf_counter() - t0
+        stats.add("compute", max(0.0, wall - clean_s), items=len(items))
+        if clean_s:
+            stats.add("clean", clean_s)
+        stats.add_transfer(d2h=d2h)
+        stats.add_kernel("fused_view", wall,
+                         bucket=int(cloud.points.shape[1]), bytes_moved=d2h)
+        outs = []
+        for (idx, src, _frames, _texture), v in zip(items, views):
+            outs.append(finish_view(idx, src, v.points, v.colors,
+                                    dev=(v.dev_points, v.count),
+                                    cleaned=True))
+        return outs
+
     def drain_batch(items, cloud):
         """Sync one batched launch (the device wait lives HERE, off the
         dispatch thread) and fan back out into per-view artifacts; any
         failure re-runs the batch's views individually."""
         try:
+            if use_fused:
+                return drain_batch_fused(items, cloud)
             t0 = time.perf_counter()
-            pts_v = np.asarray(cloud.points)      # one sync, whole batch
-            cols_v = np.asarray(cloud.colors)
-            val_v = np.asarray(cloud.valid)
+            # one blocking device_get of the batch pytree (not three
+            # per-leaf np.asarray syncs)
+            pts_v, cols_v, val_v = jax.device_get(
+                (cloud.points, cloud.colors, cloud.valid))
             stats.add("compute", time.perf_counter() - t0, items=len(items))
+            stats.add_transfer(d2h=int(pts_v.nbytes) + int(cols_v.nbytes)
+                               + int(val_v.nbytes))
             outs = []
             for j, (idx, src, _frames, _texture) in enumerate(items):
                 # per-view compaction through the SAME export helper the
@@ -725,6 +779,11 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
         except Exception as e:
             if is_backend_init_error(e):
                 raise
+            if faults.is_transient(e):
+                # the batch-level firing (e.g. clean.fused in the fused
+                # drain) consumed a transient's budget; the per-view
+                # re-run below is its successful retry
+                stats.add_retry("clean" if use_fused else "compute")
             log(f"[reconstruct] batched launch of {len(items)} view(s) "
                 f"failed ({type(e).__name__}: {e}); re-running views "
                 f"individually")
@@ -760,6 +819,7 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
                 else:
                     fv_d = jax.device_put(fv)
                 stats.add("transfer", time.perf_counter() - t0)
+                stats.add_transfer(frames=int(fv.nbytes))
                 t0 = time.perf_counter()
                 cloud = scanner.forward_views_batched(fv_d, mesh=mesh,
                                                       **fwd_kw)
@@ -1008,7 +1068,8 @@ _CLEAN_STEPS = ("background", "cluster", "radius", "statistical")
 
 
 def _clean_arrays(pts: np.ndarray, cols: np.ndarray, cfg: Config,
-                  steps=_CLEAN_STEPS, log=None, step_callback=None):
+                  steps=_CLEAN_STEPS, log=None, step_callback=None,
+                  stats=None):
     """Masked-chain cleanup of one in-memory cloud; the single implementation
     behind clean_cloud, the batch clean, and the fused pipeline's clean lane.
 
@@ -1044,8 +1105,14 @@ def _clean_arrays(pts: np.ndarray, cols: np.ndarray, cfg: Config,
         masks_d, cnts_d = pc.clean_chain(jnp.asarray(pts_pad),
                                          jnp.asarray(valid), cfg.clean,
                                          tuple(steps))
-        masks = np.asarray(masks_d)[:, :n]
+        masks = np.asarray(masks_d)
         cnts = np.asarray(cnts_d)
+        if stats is not None:
+            # the host round-trip the fused fastpath eliminates: cloud up,
+            # step masks back down
+            stats.add_transfer(h2d=int(pts_pad.nbytes) + int(valid.nbytes),
+                               d2h=int(masks.nbytes) + int(cnts.nbytes))
+        masks = masks[:, :n]
     final = masks[-1] if len(params) else np.ones(n, bool)
     for i, (step, _) in enumerate(params):
         counts[step] = int(cnts[i])
@@ -1459,6 +1526,7 @@ class _StreamRegistrar:
         # close() drains it; finish()'s catch-up then owns it single-threaded
         self._digests: dict[int, str] = {}
         self._clouds: dict[int, tuple] = {}
+        self._devs: dict[int, tuple] = {}   # i -> (device points, count)
         self._preps: dict[int, object] = {}
         self._frontier = 0            # first view index not yet collected
         self._chain: list[int] = []   # contiguous prefix of collected views
@@ -1469,11 +1537,13 @@ class _StreamRegistrar:
 
     # ---- public API (any thread) ----------------------------------------
 
-    def feed(self, i: int, pts, cols) -> None:
+    def feed(self, i: int, pts, cols, dev=None) -> None:
         """Hand view ``i``'s cleaned compact cloud to the lane. Safe from
         the executor's drain thread — all work happens on the register
-        worker, so cleaning view N+1 never blocks on registering pair N."""
-        self._futs.append(self._pool.submit(self._note, i, pts, cols))
+        worker, so cleaning view N+1 never blocks on registering pair N.
+        ``dev`` (``(device_points, count)`` from the fused drain) lets the
+        prep consume the HBM-resident buffer instead of re-uploading."""
+        self._futs.append(self._pool.submit(self._note, i, pts, cols, dev))
 
     def close(self) -> None:
         """Drain the worker and surface injected crashes. Idempotent.
@@ -1557,10 +1627,12 @@ class _StreamRegistrar:
 
     # ---- register-worker internals ---------------------------------------
 
-    def _note(self, i, pts, cols):
+    def _note(self, i, pts, cols, dev=None):
         dl.beat("register")   # worker-liveness heartbeat for the watchdog
         self._digests[i] = _stagecache_digest(points=pts, colors=cols)
         self._clouds[i] = (pts, cols)
+        if dev is not None:
+            self._devs[i] = dev
         while self._frontier in self._clouds:
             self._chain.append(self._frontier)
             self._frontier += 1
@@ -1591,8 +1663,15 @@ class _StreamRegistrar:
         p = self._preps.get(i)
         if p is None:
             t0 = time.perf_counter()
-            p = self._recon.prep_view(self._clouds[i][0], self.voxel,
-                                      self.cfg.merge.sample_before)
+            dev = self._devs.get(i)
+            if dev is not None and self.cfg.merge.sample_before <= 1:
+                # fused drain handoff: prep the HBM-resident buffer
+                # directly — bit-identical to prep_view on the host cloud
+                # (prep_view_device's re-pad contract)
+                p = self._recon.prep_view_device(dev[0], dev[1], self.voxel)
+            else:
+                p = self._recon.prep_view(self._clouds[i][0], self.voxel,
+                                          self.cfg.merge.sample_before)
             self.stats.add("register", time.perf_counter() - t0, view=i)
             self._preps[i] = p
         return p
@@ -1957,12 +2036,12 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
             view_dir = os.path.join(out_dir, "views")
             os.makedirs(view_dir, exist_ok=True)
 
-        def collect(j, src, pts, cols):
+        def collect(j, src, pts, cols, dev=None):
             i = missing[j][0]
             collected[i] = (pts, cols)
             cache.put("view", view_keys[i], points=pts, colors=cols)
             if stream is not None:
-                stream.feed(i, pts, cols)
+                stream.feed(i, pts, cols, dev=dev)
 
         batch = BatchReport(run_id=run_id)
         run_args = (miss_sources, calib, cfg, scanner, "batch", view_dir,

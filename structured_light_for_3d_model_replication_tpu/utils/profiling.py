@@ -224,6 +224,15 @@ class OverlapStats:
         # pair-registration launches carried how many real pairs
         self._pair_launches = 0
         self._pairs_dispatched = 0
+        # device<->host transfer accounting (bytes, exact running sums):
+        # ``frames`` is the irreducible input upload (the stripe stacks) so
+        # the fused-vs-discrete comparison can subtract it and compare only
+        # the cloud-path traffic the fusion is supposed to eliminate
+        self._h2d_bytes = 0
+        self._d2h_bytes = 0
+        self._frame_bytes = 0
+        # per-kernel launch accounting: name -> [launches, wall_s, bytes]
+        self._kernels: dict[str, list] = {}
         self.critical_path_s = 0.0
 
     def add(self, stage: str, elapsed_s: float, items: int = 0,
@@ -312,6 +321,40 @@ class OverlapStats:
             tr.instant("pair_launch", pairs=n,
                        dispatch_s=round(dispatch_s, 6))
 
+    def add_transfer(self, h2d: int = 0, d2h: int = 0,
+                     frames: int = 0) -> None:
+        """Accumulate device<->host transfer bytes. ``frames`` counts the
+        stripe-frame upload separately (it also adds into ``h2d``): every
+        arm pays it, so the fused-vs-discrete byte ratio subtracts it and
+        compares only the cloud-path round-trips fusion removes."""
+        h, d, fr = int(h2d), int(d2h), int(frames)
+        with self._lock:
+            self._h2d_bytes += h + fr
+            self._d2h_bytes += d
+            self._frame_bytes += fr
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant("transfer.bytes", h2d=h + fr or None, d2h=d or None,
+                       frames=fr or None)
+
+    def add_kernel(self, name: str, wall_s: float, bucket=None,
+                   bytes_moved: int = 0) -> None:
+        """Record one kernel-lane launch (``fused_view``, ``knn_mean``,
+        ``ransac_score``): wall, optional bucket, and bytes moved across
+        the host boundary on its behalf. The span instant is emitted from
+        this same call (the can't-drift pattern)."""
+        w = float(wall_s)
+        with self._lock:
+            agg = self._kernels.setdefault(name, [0, 0.0, 0])
+            agg[0] += 1
+            agg[1] += w
+            agg[2] += int(bytes_moved)
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant(f"kernel.{name}", wall_s=round(w, 6),
+                       bucket=int(bucket) if bucket is not None else None,
+                       bytes=int(bytes_moved) or None)
+
     def sample_queue(self, depth: int) -> None:
         d = int(depth)
         with self._lock:
@@ -364,6 +407,14 @@ class OverlapStats:
         out["mean_pairs_per_launch"] = (
             round(self._pairs_dispatched / self._pair_launches, 2)
             if self._pair_launches else 0.0)
+        # transfer-byte + kernel gauges (zeros on unaccounted paths)
+        out["transfer_bytes_h2d"] = self._h2d_bytes
+        out["transfer_bytes_d2h"] = self._d2h_bytes
+        out["transfer_bytes_frames"] = self._frame_bytes
+        out["kernels"] = {
+            name: {"launches": agg[0], "wall_s": round(agg[1], 4),
+                   "bytes_moved": agg[2]}
+            for name, agg in sorted(self._kernels.items())}
         items = self._items
         out["compute_per_item_s"] = (round(self._stage_s["compute"] / items, 4)
                                      if items else None)

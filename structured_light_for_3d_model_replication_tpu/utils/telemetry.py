@@ -371,6 +371,18 @@ class Tracer:
         elif ev == "fault.injected":
             reg.inc("sl3d_faults_injected_total", site=fields.get("site"),
                     kind=fields.get("kind"))
+        elif ev == "transfer.bytes":
+            if fields.get("h2d"):
+                reg.inc("sl3d_transfer_bytes_total", float(fields["h2d"]),
+                        dir="h2d")
+            if fields.get("d2h"):
+                reg.inc("sl3d_transfer_bytes_total", float(fields["d2h"]),
+                        dir="d2h")
+        elif ev.startswith("kernel."):
+            reg.inc("sl3d_kernel_events_total", kernel=ev[7:])
+            if fields.get("wall_s") is not None:
+                reg.observe("sl3d_kernel_seconds", fields["wall_s"],
+                            kernel=ev[7:])
         elif ev == "watchdog.stall":
             reg.inc("sl3d_stalls_total", level=fields.get("level"))
         self._emit(self._clean(

@@ -1,0 +1,248 @@
+"""ISSUE 10: the HBM-resident view fastpath (pipeline.fused_clean).
+
+The fused drain contract (ops/fused_view + pipeline/stages):
+  - decode -> compact -> clean -> final-compact runs entirely on device;
+    the ONE host sync is a single device_get at the collect boundary —
+    and the output bytes are IDENTICAL to the discrete arm (host masking
+    + _clean_arrays re-upload), single-device and under the 8-virtual-
+    device mesh, full batches and ragged tails alike
+  - the fused helpers take the count as a dynamic argument: no per-count
+    retrace (jit cache keyed on the bucket ladder only)
+  - the cleaned device buffers hand to the streaming registrar
+    (prep_view_device), so the streamed merge is byte-identical too while
+    the cloud path moves >=3x fewer device<->host bytes than discrete
+  - a clean.fused fault inside a fused batch degrades the batch to the
+    per-view lane where only the victim quarantines; a stall at the site
+    terminates under the PR-7 watchdog — never a hang
+"""
+import os
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.cli import main as cli_main
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.ops import (
+    fused_view as fused_view_mod,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
+VIEWS = 5
+PROJ = (64, 32)
+STEPS = ("statistical",)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("fusedds"))
+    rc = cli_main(["synth", root, "--views", str(VIEWS),
+                   "--cam", "96x72", "--proj", f"{PROJ[0]}x{PROJ[1]}"])
+    assert rc == 0
+    return root
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.reset()
+
+
+def _cfg(compute_batch: int, fused: bool, shard: bool = True) -> Config:
+    cfg = Config()
+    cfg.parallel.backend = "jax"
+    cfg.parallel.io_workers = 4
+    cfg.parallel.compute_batch = compute_batch
+    cfg.parallel.shard_views = shard
+    cfg.decode.n_cols, cfg.decode.n_rows = PROJ
+    cfg.decode.thresh_mode = "manual"
+    cfg.pipeline.fused_clean = fused
+    return cfg
+
+
+def _pipe_cfg(compute_batch: int, fused: bool) -> Config:
+    cfg = _cfg(compute_batch, fused)
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 128
+    cfg.merge.icp_iters = 4
+    cfg.mesh.depth = 3
+    cfg.mesh.density_trim_quantile = 0.0
+    return cfg
+
+
+def _run(dataset, out_dir, cfg, log=None):
+    calib = os.path.join(dataset, "calib.mat")
+    return stages.reconstruct(calib, dataset, mode="batch",
+                              output=str(out_dir), cfg=cfg,
+                              log=log or (lambda m: None))
+
+
+def _pipeline(dataset, out_dir, cfg):
+    calib = os.path.join(dataset, "calib.mat")
+    return stages.run_pipeline(calib, dataset, str(out_dir), cfg=cfg,
+                               steps=STEPS, log=lambda m: None)
+
+
+def _assert_identical_dirs(a, b, n=VIEWS):
+    names_a, names_b = sorted(os.listdir(a)), sorted(os.listdir(b))
+    assert names_a == names_b and len(names_a) == n
+    for f in names_a:
+        assert (a / f).read_bytes() == (b / f).read_bytes(), \
+            f"{f}: fused PLY differs from discrete"
+
+
+def _cloud_bytes(overlap: dict) -> int:
+    # the cloud path's device<->host traffic: total h2d minus the
+    # irreducible frame-stripe uploads, plus d2h
+    return (int(overlap.get("transfer_bytes_h2d", 0))
+            - int(overlap.get("transfer_bytes_frames", 0))
+            + int(overlap.get("transfer_bytes_d2h", 0)))
+
+
+# ---------------------------------------------------------------------------
+# byte parity: fused vs discrete drain
+# ---------------------------------------------------------------------------
+
+def test_fused_reconstruct_byte_identical_sharded(dataset, tmp_path):
+    """The acceptance A/B under the conftest 8-device mesh: a full batch
+    (4 views) plus a ragged tail (1 view), fused drain vs discrete —
+    byte-identical PLYs, with the fused launch accounting recorded."""
+    rep_d = _run(dataset, tmp_path / "discrete", _cfg(4, fused=False))
+    rep_f = _run(dataset, tmp_path / "fused", _cfg(4, fused=True))
+    _assert_identical_dirs(tmp_path / "discrete", tmp_path / "fused")
+    assert rep_d.failed == rep_f.failed == []
+    k = rep_f.overlap["kernels"]["fused_view"]
+    assert k["launches"] == 2                   # 4-view batch + ragged 1
+    assert rep_f.overlap["transfer_bytes_d2h"] > 0
+    # discrete syncs the WHOLE slot stack; fused only the compact results
+    assert rep_f.overlap["transfer_bytes_d2h"] < \
+        rep_d.overlap["transfer_bytes_d2h"]
+
+
+def test_fused_reconstruct_byte_identical_unsharded_ragged(dataset, tmp_path):
+    """shard_views=False (single-device programs): bucket-boundary batches
+    (2 + 2) plus the ragged 1-view tail, byte-identical."""
+    rep_d = _run(dataset, tmp_path / "discrete",
+                 _cfg(2, fused=False, shard=False))
+    rep_f = _run(dataset, tmp_path / "fused",
+                 _cfg(2, fused=True, shard=False))
+    _assert_identical_dirs(tmp_path / "discrete", tmp_path / "fused")
+    assert rep_f.overlap["kernels"]["fused_view"]["launches"] == 3
+    assert rep_d.overlap["launches"] == rep_f.overlap["launches"] == 3
+
+
+def test_fused_helpers_no_retrace_across_batches(dataset, tmp_path):
+    """The fused gather/select helpers take the survivor count as a
+    DYNAMIC argument: a rerun over the same bucket ladder adds no new jit
+    cache entries (per-count retrace would leak one per distinct n)."""
+    _run(dataset, tmp_path / "warm", _cfg(2, fused=True, shard=False))
+    sizes = fused_view_mod._cache_sizes()
+    _run(dataset, tmp_path / "again", _cfg(2, fused=True, shard=False))
+    assert fused_view_mod._cache_sizes() == sizes, \
+        "fused helpers retraced on a warm rerun"
+
+
+# ---------------------------------------------------------------------------
+# full pipeline: device clean + registrar handoff + transfer-byte ratio
+# (slow-marked: tier-1's -m 'not slow' budget excludes it; the FUSED_SMOKE
+# CI arm asserts the same parity + >=3x contract end-to-end every run)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fused_pipeline_clean_merge_identical_and_3x_fewer_bytes(
+        dataset, tmp_path):
+    """run_pipeline with the clean chain and the streamed merge: the fused
+    arm must ship byte-identical view PLYs, merged PLY, and STL (clean
+    runs on device; cleaned device buffers feed prep_view_device), while
+    the cloud path moves >=3x fewer device<->host bytes than discrete."""
+    cfg_d = _pipe_cfg(3, fused=False)
+    cfg_f = _pipe_cfg(3, fused=True)
+    cfg_d.pipeline.write_view_plys = True
+    cfg_f.pipeline.write_view_plys = True
+    rep_d = _pipeline(dataset, tmp_path / "discrete", cfg_d)
+    rep_f = _pipeline(dataset, tmp_path / "fused", cfg_f)
+    assert rep_d.failed == rep_f.failed == []
+    with open(rep_d.merged_ply, "rb") as fa, open(rep_f.merged_ply,
+                                                  "rb") as fb:
+        assert fa.read() == fb.read(), "merged PLY differs"
+    with open(rep_d.stl_path, "rb") as fa, open(rep_f.stl_path, "rb") as fb:
+        assert fa.read() == fb.read(), "STL differs"
+    _assert_identical_dirs(tmp_path / "discrete" / "views",
+                           tmp_path / "fused" / "views")
+    cb_d, cb_f = _cloud_bytes(rep_d.overlap), _cloud_bytes(rep_f.overlap)
+    assert cb_f > 0
+    assert cb_d / cb_f >= 3.0, (
+        f"fused cloud path moved {cb_f} B vs discrete {cb_d} B — "
+        f"ratio {cb_d / cb_f:.2f} < 3x")
+    assert rep_f.overlap["kernels"]["fused_view"]["launches"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# fault containment at the fused site
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fused_clean_permanent_fault_quarantines_only_victim(
+        dataset, tmp_path):
+    """A poisoned view inside a fused batch: the batch degrades to the
+    per-view lane, where clean.fused re-fires per view — ONLY the victim
+    quarantines; its batchmates ship bytes identical to a clean run.
+    (Needs run_pipeline — the per-view fallback only re-enters the
+    clean.fused site when the clean stage is active, and the degraded
+    merge/mesh over the survivors is part of the contract.)"""
+    victim = sorted(
+        d for d in os.listdir(dataset)
+        if os.path.isdir(os.path.join(dataset, d)))[1]
+    cfg = _pipe_cfg(3, fused=True)
+    cfg.pipeline.write_view_plys = True
+    rep_clean = _pipeline(dataset, tmp_path / "clean", cfg)
+    assert rep_clean.failed == []
+
+    faults.configure(f"clean.fused~{victim}:permanent", seed=7)
+    cfg2 = _pipe_cfg(3, fused=True)
+    cfg2.pipeline.write_view_plys = True
+    rep = _pipeline(dataset, tmp_path / "faulted", cfg2)
+    assert len(rep.failed) == 1
+    assert victim in rep.failed[0][0]
+    assert rep.degraded
+    assert rep.stl_path and os.path.getsize(rep.stl_path) > 0
+    # the victim's batchmates ship bytes identical to the clean run
+    clean_views = tmp_path / "clean" / "views"
+    faulted_views = tmp_path / "faulted" / "views"
+    names = sorted(os.listdir(faulted_views))
+    assert len(names) == VIEWS - 1
+    assert not any(victim in n for n in names)
+    for n in names:
+        assert (faulted_views / n).read_bytes() == \
+            (clean_views / n).read_bytes(), f"{n}: batchmate bytes changed"
+
+
+def test_fused_clean_transient_fault_retries_all_views_survive(
+        dataset, tmp_path):
+    victim = sorted(
+        d for d in os.listdir(dataset)
+        if os.path.isdir(os.path.join(dataset, d)))[2]
+    faults.configure(f"clean.fused~{victim}:transient", seed=3)
+    rep = _run(dataset, tmp_path / "out", _cfg(VIEWS, fused=True))
+    assert rep.failed == []
+    assert rep.retries >= 1
+
+
+@pytest.mark.slow
+def test_stall_at_fused_site_terminates_under_watchdog(dataset, tmp_path):
+    """PR-7 contract at the new site: a seeded stall inside the fused
+    drain terminates the run within its deadline envelope — DEGRADED or
+    clean, never a hang."""
+    import time as _time
+
+    cfg = _pipe_cfg(VIEWS, fused=True)
+    cfg.deadlines.drain_s = 1.0
+    cfg.deadlines.soft_stall_s = 3.0
+    cfg.deadlines.hard_stall_s = 10.0
+    cfg.deadlines.watchdog_poll_s = 0.1
+    faults.configure("clean.fused:stall(0.8)")
+    t0 = _time.monotonic()
+    rep = _pipeline(dataset, tmp_path / "out", cfg)
+    wall = _time.monotonic() - t0
+    assert wall < 120.0, f"stall at clean.fused cost {wall:.0f}s — unbounded?"
+    assert rep.stl_path and os.path.getsize(rep.stl_path) > 0

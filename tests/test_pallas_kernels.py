@@ -232,6 +232,86 @@ def test_scanner_fuse_gate_rejects_truncated_and_misaligned(monkeypatch, rng):
     assert sc._can_fuse(frames)                  # accelerator default
 
 
+def test_knn_mean_interpret_matches_np_twin(rng):
+    """ISSUE 10: the dense knn-mean bisection kernel (interpret mode on
+    CPU; same program compiles through Mosaic on TPU) against its NumPy
+    numeric twin — identical candidate counts, identical +inf placement
+    (invalid rows and <k-neighbor rows), means to fp tolerance."""
+    pts = rng.normal(0, 40, (900, 3)).astype(np.float32)
+    valid = rng.random(900) > 0.15
+    m_pl, c_pl = pk.knn_mean(pts, valid, 10, interpret=True)
+    m_np, c_np = pk.knn_mean_np(pts, valid, 10)
+    m_pl, c_pl = np.asarray(m_pl), np.asarray(c_pl)
+    np.testing.assert_array_equal(c_pl, c_np)
+    fin = np.isfinite(m_np)
+    np.testing.assert_array_equal(np.isfinite(m_pl), fin)
+    np.testing.assert_allclose(m_pl[fin], m_np[fin], atol=1e-4)
+    # invalid rows all park at the same far coordinate — their counts must
+    # be ZEROED, not reflect the co-parked rows they'd see at distance 0
+    assert (c_pl[~valid] == 0).all()
+    assert np.isinf(m_pl[~valid]).all()
+
+
+def test_ransac_score_interpret_matches_np_twin(rng):
+    """The single-matmul hypothesis-scoring kernel vs its NumPy twin:
+    identical inlier counts, with dead correspondences (sc=+inf) never
+    counting and padded rows sliced off."""
+    T, N = 37, 500
+    R = np.linalg.qr(rng.normal(size=(T, 3, 3)))[0].astype(np.float32)
+    t = rng.normal(0, 5, (T, 3)).astype(np.float32)
+    R9 = R.reshape(T, 9)
+    t2 = (t ** 2).sum(1)
+    Rt = np.einsum("tij,ti->tj", R, t).astype(np.float32)
+    src = rng.normal(0, 30, (N, 3)).astype(np.float32)
+    dst = rng.normal(0, 30, (N, 3)).astype(np.float32)
+    cs9 = (dst[:, :, None] * src[:, None, :]).reshape(N, 9)
+    sc = ((src ** 2).sum(1) + (dst ** 2).sum(1)).astype(np.float32)
+    sc[::17] = np.inf                   # dead correspondences
+    c_pl = np.asarray(pk.ransac_score(R9, t, t2, Rt, src, cs9, dst, sc,
+                                      100.0, interpret=True))
+    c_np = pk.ransac_score_np(R9, t, t2, Rt, src, cs9, dst, sc, 100.0)
+    assert c_pl.shape == (T,)
+    np.testing.assert_array_equal(c_pl, c_np)
+
+
+def test_statistical_outlier_kernel_arm_matches_dense(monkeypatch):
+    """statistical_outlier_mask's kernel arm (knn_mean_ok gate) must emit
+    the SAME mask as the dense jnp fallthrough — the gate is a pure engine
+    swap, never a semantics change."""
+    from structured_light_for_3d_model_replication_tpu.ops import (
+        pointcloud as pc,
+    )
+
+    r = np.random.default_rng(5)
+    pts = r.normal(0, 30, (2000, 3)).astype(np.float32)
+    pts[:40] += 400                     # a far clump of outliers
+    valid = r.random(2000) > 0.1
+    m_dense = np.asarray(pc.statistical_outlier_mask(pts, valid, 20, 2.0))
+    monkeypatch.setattr(pk, "knn_mean_ok", lambda: True)  # interpret on CPU
+    m_kern = np.asarray(pc.statistical_outlier_mask(pts, valid, 20, 2.0))
+    np.testing.assert_array_equal(m_dense, m_kern)
+    assert 0 < m_kern.sum() < valid.sum()   # the clump actually dropped
+
+
+def test_knn_and_ransac_gates_and_kill_switches(monkeypatch):
+    """Capability-gate policy: False on a host (no compiled Mosaic), True
+    where the probe passed, and the SLSCAN_*_KERNEL=0 operator kill
+    switches win over everything."""
+    monkeypatch.delenv("SLSCAN_KNN_KERNEL", raising=False)
+    monkeypatch.delenv("SLSCAN_RANSAC_KERNEL", raising=False)
+    assert pk.knn_mean_ok() is False        # CPU: use_pallas() is False
+    assert pk.ransac_score_ok() is False
+    monkeypatch.setattr(pk, "use_pallas", lambda: True)
+    assert pk.knn_mean_ok() is True         # probe flags default True
+    assert pk.ransac_score_ok() is True
+    monkeypatch.setenv("SLSCAN_KNN_KERNEL", "0")
+    monkeypatch.setenv("SLSCAN_RANSAC_KERNEL", "off")
+    assert pk.knn_mean_ok() is False        # kill switch wins
+    assert pk.ransac_score_ok() is False
+    rep = pk.kernel_report()
+    assert rep["knn_mean"] is False and rep["ransac_score"] is False
+
+
 def test_merge_timings_dict_populated(rng):
     import numpy as np
 

@@ -13,9 +13,12 @@ echo DOTS_PASSED=$dots
 mkdir -p tools/_ci
 echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) DOTS_PASSED=$dots rc=$rc" >> tools/_ci/tier1_dots.log
 
-# ---- pipeline smoke: completes + byte-identical outputs (no thresholds) ----
+# ---- pipeline smoke: completes + byte-identical outputs (no thresholds).
+# 1200s: the arm aggregates every pipeline A/B (e2e, stream, faults, trace,
+# deadline, multiproc, batched child, fused child) — ~735s before the fused
+# child joined, ~950s with it on this box ----
 smoke_rc=0
-smoke=$(timeout -k 10 870 env JAX_PLATFORMS=cpu python bench.py --pipeline-only 2>/dev/null) || smoke_rc=$?
+smoke=$(timeout -k 10 1200 env JAX_PLATFORMS=cpu python bench.py --pipeline-only 2>/dev/null) || smoke_rc=$?
 echo "$smoke" > tools/_ci/pipeline_smoke.json
 if [ $smoke_rc -eq 0 ] \
    && echo "$smoke" | grep -q '"outputs_identical": true' \
@@ -39,6 +42,28 @@ if [ $batched_rc -eq 0 ] \
   echo "BATCHED_SMOKE=ok"
 else
   echo "BATCHED_SMOKE=FAIL (rc=$batched_rc; see tools/_ci/batched_smoke.json)"
+  [ $rc -eq 0 ] && rc=1
+fi
+
+# ---- fused-residency smoke: the HBM-resident drain (pipeline.fused_clean)
+# must produce merged PLY + STL byte-identical to the discrete arm and
+# move >=3x fewer cloud-path device<->host bytes per view (ISSUE 10);
+# per-kernel capability-probe verdicts land in tools/_ci/kernel_probes.json ----
+fused_rc=0
+fused=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python bench.py --fused-only --views=2 --compute-batch=2 2>/dev/null) || fused_rc=$?
+echo "$fused" > tools/_ci/fused_smoke.json
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -c "
+import json
+from structured_light_for_3d_model_replication_tpu.ops import pallas_kernels as pk
+print(json.dumps(pk.kernel_report()))
+" > tools/_ci/kernel_probes.json 2>/dev/null || true
+if [ $fused_rc -eq 0 ] \
+   && echo "$fused" | grep -q '"merged_identical": true' \
+   && echo "$fused" | grep -q '"stl_identical": true' \
+   && echo "$fused" | grep -q '"cloud_bytes_ratio_ok": true'; then
+  echo "FUSED_SMOKE=ok"
+else
+  echo "FUSED_SMOKE=FAIL (rc=$fused_rc; see tools/_ci/fused_smoke.json)"
   [ $rc -eq 0 ] && rc=1
 fi
 
