@@ -35,6 +35,7 @@ class CaptureSequencer:
                  proj_size: tuple[int, int] = (1920, 1080),
                  brightness: int = 200, downsample: int = 1,
                  scan_settle_ms: int = 200, calib_settle_ms: int = 250,
+                 pack_frames: bool = False, pack_keep_raw: bool = False,
                  log=print):
         self.projector = projector
         self.capture = capture
@@ -43,6 +44,8 @@ class CaptureSequencer:
         self.downsample = downsample
         self.scan_settle_ms = scan_settle_ms
         self.calib_settle_ms = calib_settle_ms
+        self.pack_frames = pack_frames
+        self.pack_keep_raw = pack_keep_raw
         self.log = log
         self._patterns: np.ndarray | None = None
 
@@ -78,8 +81,32 @@ class CaptureSequencer:
     def capture_scan(self, save_dir: str,
                      progress: Callable[[int, int], None] | None = None
                      ) -> list[str]:
-        """One object scan (46 frames at 1080p), scan settle time."""
-        return self.capture_sequence(save_dir, self.scan_settle_ms, progress)
+        """One object scan (46 frames at 1080p), scan settle time.
+
+        With ``pack_frames`` the landed sequence is immediately packed to
+        the 1-bit bit-plane container (``frames.slbp``, io/images.py) —
+        the scan folder ships ~8x fewer bytes and the pipeline's packed
+        ingest uploads it as-is. Calibration captures are never packed:
+        chessboard detection needs the full grayscale frames. A failure
+        here raises like any capture failure, so auto-scan's per-view
+        retry budget (``acquire.capture_retries``) covers it."""
+        paths = self.capture_sequence(save_dir, self.scan_settle_ms,
+                                      progress)
+        if self.pack_frames:
+            from structured_light_for_3d_model_replication_tpu.io import (
+                images as imio,
+            )
+            from structured_light_for_3d_model_replication_tpu.utils import (
+                faults,
+            )
+
+            faults.fire("frame.pack", item=save_dir)
+            packed = imio.pack_scan_folder(save_dir,
+                                           keep_raw=self.pack_keep_raw)
+            self.log(f"[capture] packed -> {packed} "
+                     f"({os.path.getsize(packed)} B)")
+            paths = [packed] + (paths if self.pack_keep_raw else [])
+        return paths
 
     def capture_calibration(self, save_dir: str, num_poses: int,
                             on_pose: Callable[[int], None] | None = None,

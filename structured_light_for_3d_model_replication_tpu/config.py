@@ -179,6 +179,14 @@ class AcquireConfig:
     http_backoff_s: float = 0.2
     rotate_retries: int = 1
     capture_retries: int = 1
+    # pack each captured view to the 1-bit bit-plane container
+    # (frames.slbp, io/images.py) right after its sequence lands: stripe
+    # frames threshold to pat>inv bits at capture time, so the scan folder
+    # ships ~8x fewer bytes and the pipeline's packed ingest lane can
+    # upload them as-is. pack_keep_raw retains the raw PNGs beside the
+    # container (debugging / re-thresholding); default removes them.
+    pack_frames: bool = False
+    pack_keep_raw: bool = False
 
 
 @dataclass
@@ -283,6 +291,15 @@ class PipelineConfig:
     # failure inside degrades to the per-view lane. Opt-in while the
     # discrete arm remains the reference path.
     fused_clean: bool = False
+    # capture-rate ingest (batched executor only): load each view as a
+    # packed bit-plane stack (frames.slbp where present, packed in the
+    # loader thread otherwise), stream the ~8x-smaller planes to HBM as
+    # they arrive, and decode from bits on device (ops/graycode.py
+    # decode_packed). The stored bits ARE the decoder's pat>inv
+    # comparisons, so maps/masks/textures — and every artifact downstream
+    # — are byte-identical to the raw lane. Opt-in while raw ingest
+    # remains the reference path.
+    packed_ingest: bool = False
 
 
 def _env_flag(name: str) -> bool:

@@ -1,4 +1,4 @@
-"""Capture-stack image IO.
+"""Capture-stack image IO + the packed bit-plane codec.
 
 The reference reads scan folders of 46 numbered frames ("01.png".."46.png",
 server/sl_system.py:126-150) one cv2.imread at a time inside the decode loop
@@ -6,19 +6,53 @@ server/sl_system.py:126-150) one cv2.imread at a time inside the decode loop
 the white frame additionally as RGB texture), so the decode kernel sees a
 single device buffer. cv2 is used when present; a PNG/PPM fallback via PIL
 keeps the path alive without it.
+
+Packed bit-plane format (``frames.slbp``)
+-----------------------------------------
+Gray-code decode reads each pattern/inverse frame pair exactly once, as the
+comparison ``pattern > inverse`` — one bit per pixel per pair. The packed
+format stores precisely what decode consumes:
+
+  - the white and black frames VERBATIM as u8 (thresholds and the shadow/
+    contrast mask depend only on these two frames, so storing them whole
+    preserves threshold resolution and masking bit-for-bit)
+  - each of the P = (F-2)//2 pattern pairs collapsed to its comparison bit,
+    packed 8 planes/byte, plane-major, LSB-first: plane p lands in byte
+    p//8 at bit p%8 of a u8 [ceil(P/8), H, W] array
+  - the RGB texture (color of the white frame) in the container, so a
+    packed source round-trips ``load_stack``'s return contract
+
+A 46-frame 1080p stack (46·H·W upload bytes) becomes 2·H·W (white+black)
++ ceil(22/8)·H·W (packed planes) = 5·H·W on the wire — 9.2x fewer frame
+bytes, and decode from the planes is bit-identical to ``decode_stack_np``
+on the raw stack because the stored bits ARE decode's comparisons.
+
+The on-disk container is a deterministic flat binary (magic + JSON header +
+raw sections) rather than an npz: zip archives embed timestamps, and the
+stage cache keys on content bytes — a re-pack of identical frames must hash
+identically.
 """
 from __future__ import annotations
 
 import glob
+import json
 import os
+import struct
 import threading
+from dataclasses import dataclass
 
 import numpy as np
 
 __all__ = ["list_frame_files", "load_stack", "save_stack", "load_gray",
-           "load_color", "save_image"]
+           "load_color", "save_image", "PackedStack", "pack_stack",
+           "unpack_stack", "save_packed_stack", "load_packed_stack",
+           "probe_packed", "packed_file", "is_packed_source", "count_frames",
+           "pack_scan_folder", "PACKED_NAME"]
 
 _EXTS = (".bmp", ".png", ".jpg", ".jpeg", ".ppm", ".pgm")
+PACKED_EXT = ".slbp"
+PACKED_NAME = "frames" + PACKED_EXT
+_PACKED_MAGIC = b"SLBP1\n"
 
 # one shared decode pool for the whole process: per-call executors cost
 # ~ms of thread spin-up — more than a small frame decodes in — and a shared
@@ -93,12 +127,18 @@ def list_frame_files(source) -> list[str]:
     """Resolve a scan source (folder or explicit file list) to a sorted frame list.
 
     Mirrors the reference's resolution order: .bmp glob first, then .png
-    (processing.py:49-54), extended with the other common formats.
+    (processing.py:49-54), extended with the other common formats. A folder
+    holding a packed container (``frames.slbp``) resolves to just that file —
+    downstream content hashing (the stage cache keys on the bytes of every
+    listed file) then covers the packed bytes exactly like raw frames.
     """
     if isinstance(source, (list, tuple)):
         return list(source)
     if not os.path.isdir(source):
         raise FileNotFoundError(f"scan folder not found: {source}")
+    packed = os.path.join(source, PACKED_NAME)
+    if os.path.isfile(packed):
+        return [packed]
     for ext in _EXTS:
         files = sorted(glob.glob(os.path.join(source, f"*{ext}")))
         if files:
@@ -122,6 +162,12 @@ def load_stack(source, expected: int | None = None,
     from structured_light_for_3d_model_replication_tpu.io import native
 
     files = list_frame_files(source)
+    if len(files) == 1 and files[0].endswith(PACKED_EXT):
+        ps = load_packed_stack(files[0])
+        if expected is not None and ps.n_frames < expected:
+            raise ValueError(
+                f"{source}: expected >= {expected} frames, found {ps.n_frames}")
+        return unpack_stack(ps)
     if expected is not None and len(files) < expected:
         raise ValueError(f"{source}: expected >= {expected} frames, found {len(files)}")
     if len(files) < 4:
@@ -168,3 +214,216 @@ def save_stack(folder: str, frames: np.ndarray, ext: str = "png") -> list[str]:
         _imwrite(p, np.asarray(frame, np.uint8))
         paths.append(p)
     return paths
+
+
+# ---------------------------------------------------------------------------
+# Packed bit-plane codec (format spec in the module docstring)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class PackedStack:
+    """A Gray-code capture stack collapsed to what decode actually reads.
+
+    ``planes`` is u8 [ceil(n_pairs/8), H, W]: pattern pair p's comparison bit
+    (``pattern > inverse``) lives in byte p//8 at bit p%8 (LSB-first).
+    ``white``/``black`` are the first two frames verbatim. A trailing unpaired
+    frame (odd F-2) is never read by decode and is not stored; it unpacks as
+    zeros.
+    """
+
+    planes: np.ndarray
+    white: np.ndarray
+    black: np.ndarray
+    n_frames: int
+    texture: np.ndarray | None = None
+
+    @property
+    def n_pairs(self) -> int:
+        return (self.n_frames - 2) // 2
+
+    @property
+    def shape(self) -> tuple[int, int, int]:
+        # matches the raw stack's [F, H, W] so shape-keyed batching logic
+        # (bucket flushes, heterogeneity checks) is format-agnostic
+        return (self.n_frames,) + self.white.shape
+
+    @property
+    def nbytes(self) -> int:
+        """Wire size: the bytes a device upload of this stack actually moves."""
+        return self.planes.nbytes + self.white.nbytes + self.black.nbytes
+
+
+def pack_stack(frames: np.ndarray, texture: np.ndarray | None = None) -> PackedStack:
+    """Pack a raw [F, H, W] u8 stack to bit-planes. Lossless for decode:
+    ``decode_packed_np(pack_stack(f), ...)`` is bit-identical to
+    ``decode_stack_np(f, ...)`` (the stored bits ARE decode's comparisons,
+    and thresholds/mask read only the preserved white/black frames)."""
+    frames = np.asarray(frames, np.uint8)
+    if frames.ndim != 3 or frames.shape[0] < 4:
+        raise ValueError(f"pack_stack: need [F>=4, H, W] u8, got {frames.shape}")
+    n_pairs = (frames.shape[0] - 2) // 2
+    pat = frames[2:2 + 2 * n_pairs:2].astype(np.int16)
+    inv = frames[3:3 + 2 * n_pairs:2].astype(np.int16)
+    bits = (pat > inv).astype(np.uint8)
+    # bitorder="little" puts plane p at byte p//8, bit p%8 — the LSB-first
+    # layout the on-device unpack kernel extracts with (byte >> (p & 7)) & 1
+    planes = np.packbits(bits, axis=0, bitorder="little")
+    return PackedStack(planes=planes, white=frames[0].copy(),
+                       black=frames[1].copy(), n_frames=int(frames.shape[0]),
+                       texture=None if texture is None
+                       else np.asarray(texture, np.uint8))
+
+
+def unpack_stack(ps: PackedStack):
+    """Inverse of :func:`pack_stack` up to binarization: returns
+    (frames u8 [F, H, W], texture u8 [H, W, 3]).
+
+    Pattern frames come back binarized (pattern = 255*bit, inverse =
+    255*(1-bit)); every decode comparison ``pattern > inverse`` evaluates
+    identically to the raw stack's, so downstream results are bit-exact.
+    Texture falls back to the white frame replicated to RGB when the
+    container carries none."""
+    F = ps.n_frames
+    n_pairs = ps.n_pairs
+    out = np.zeros((F,) + ps.white.shape, np.uint8)
+    out[0] = ps.white
+    out[1] = ps.black
+    if n_pairs:
+        bits = np.unpackbits(ps.planes, axis=0, count=n_pairs,
+                             bitorder="little")
+        out[2:2 + 2 * n_pairs:2] = bits * np.uint8(255)
+        out[3:3 + 2 * n_pairs:2] = (1 - bits) * np.uint8(255)
+    texture = ps.texture
+    if texture is None:
+        texture = np.repeat(ps.white[:, :, None], 3, axis=2)
+    return out, texture
+
+
+def packed_file(source) -> str | None:
+    """The packed-container path for a source, or None if the source is raw."""
+    if isinstance(source, (list, tuple)):
+        if len(source) == 1 and str(source[0]).endswith(PACKED_EXT):
+            return str(source[0])
+        return None
+    if isinstance(source, str):
+        if source.endswith(PACKED_EXT) and os.path.isfile(source):
+            return source
+        if os.path.isdir(source):
+            p = os.path.join(source, PACKED_NAME)
+            if os.path.isfile(p):
+                return p
+    return None
+
+
+def is_packed_source(source) -> bool:
+    return packed_file(source) is not None
+
+
+def count_frames(source) -> int:
+    """Logical frame count of a source — header-only for packed containers,
+    so planning never pays an unpack."""
+    p = packed_file(source)
+    if p is not None:
+        hdr = probe_packed(p)
+        if hdr is None:
+            raise IOError(f"corrupt packed container: {p}")
+        return int(hdr["n_frames"])
+    return len(list_frame_files(source))
+
+
+def probe_packed(path: str) -> dict | None:
+    """Read just the header of a packed container; None if not one."""
+    try:
+        with open(path, "rb") as f:
+            magic = f.read(len(_PACKED_MAGIC))
+            if magic != _PACKED_MAGIC:
+                return None
+            (hlen,) = struct.unpack("<Q", f.read(8))
+            if hlen > 1 << 20:
+                return None
+            return json.loads(f.read(hlen).decode("utf-8"))
+    except (OSError, ValueError, struct.error):
+        return None
+
+
+def save_packed_stack(target: str, ps: PackedStack) -> str:
+    """Write a packed container. ``target`` is the .slbp path or a folder
+    (-> ``<folder>/frames.slbp``). The layout is a deterministic flat binary
+    — magic, length-prefixed JSON header, raw sections — NOT an npz: zip
+    members embed timestamps, and the stage cache keys on content bytes, so
+    re-packing identical frames must produce identical bytes."""
+    path = target if target.endswith(PACKED_EXT) \
+        else os.path.join(target, PACKED_NAME)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    h, w = ps.white.shape
+    header = {
+        "height": int(h),
+        "n_frames": int(ps.n_frames),
+        "n_planes": int(ps.planes.shape[0]),
+        "texture": ps.texture is not None,
+        "version": 1,
+        "width": int(w),
+    }
+    blob = json.dumps(header, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_PACKED_MAGIC)
+        f.write(struct.pack("<Q", len(blob)))
+        f.write(blob)
+        f.write(np.ascontiguousarray(ps.white, np.uint8).tobytes())
+        f.write(np.ascontiguousarray(ps.black, np.uint8).tobytes())
+        f.write(np.ascontiguousarray(ps.planes, np.uint8).tobytes())
+        if ps.texture is not None:
+            f.write(np.ascontiguousarray(ps.texture, np.uint8).tobytes())
+    os.replace(tmp, path)  # atomic: readers never see a torn container
+    return path
+
+
+def load_packed_stack(source) -> PackedStack:
+    """Load a packed container from a .slbp path or a folder holding one."""
+    path = packed_file(source)
+    if path is None:
+        raise FileNotFoundError(f"no packed container at {source}")
+    with open(path, "rb") as f:
+        magic = f.read(len(_PACKED_MAGIC))
+        if magic != _PACKED_MAGIC:
+            raise IOError(f"bad magic in {path}")
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        hdr = json.loads(f.read(hlen).decode("utf-8"))
+        h, w = int(hdr["height"]), int(hdr["width"])
+        n_planes = int(hdr["n_planes"])
+
+        def section(count, shape):
+            raw = f.read(count)
+            if len(raw) != count:
+                raise IOError(f"truncated packed container: {path}")
+            return np.frombuffer(raw, np.uint8).reshape(shape).copy()
+
+        white = section(h * w, (h, w))
+        black = section(h * w, (h, w))
+        planes = section(n_planes * h * w, (n_planes, h, w))
+        texture = section(h * w * 3, (h, w, 3)) if hdr.get("texture") else None
+    return PackedStack(planes=planes, white=white, black=black,
+                       n_frames=int(hdr["n_frames"]), texture=texture)
+
+
+def pack_scan_folder(folder: str, keep_raw: bool = False) -> str:
+    """Pack a captured raw-frame folder in place -> the .slbp path.
+
+    Used by the acquire lane (``acquire.pack_frames``) right after a view's
+    stripes land: the white frame's color read becomes the container texture,
+    and unless ``keep_raw`` the now-redundant per-frame images are removed so
+    ``list_frame_files`` resolves to the container alone."""
+    files = list_frame_files(folder)
+    if len(files) == 1 and files[0].endswith(PACKED_EXT):
+        return files[0]  # already packed
+    frames, texture = load_stack(folder)
+    path = save_packed_stack(folder, pack_stack(frames, texture=texture))
+    if not keep_raw:
+        for p in files:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+    return path

@@ -258,6 +258,50 @@ class SLScanner:
             return _scan_forward_views_donated(frames_v, *args,
                                                cfg=self._static)
 
+    def forward_views_packed(self, planes_v, white_v, black_v, *,
+                             n_frames: int, thresh_mode: str = "otsu",
+                             shadow_val: float = 40.0,
+                             contrast_val: float = 10.0,
+                             mesh=None) -> CloudResult:
+        """Packed-ingest twin of ``forward_views_batched``: the bucket arrives
+        as bit-planes (u8 [V, ceil(P/8), H, W], io/images.py pack layout) plus
+        the verbatim white/black frames [V, H, W] — ~8x fewer upload bytes
+        than the raw [V, F, H, W] stack for the same decode inputs.
+
+        Bit-identical to the raw path: thresholds read only white/black
+        (resolve_thresholds_views on a 2-frame stack), the texture channel IS
+        the white frame (exactly ``_forward_math``'s frames[0]), and
+        ``_decode_packed_impl`` extracts the same comparison bits the raw
+        decode computes (through the Pallas unpack+decode kernel where the
+        capability probe admits it). ``n_frames`` is the logical frame count
+        of the packed stacks (static — part of the compile key).
+        """
+        planes_v = jnp.asarray(planes_v)
+        white_v = jnp.asarray(white_v)
+        black_v = jnp.asarray(black_v)
+        ss, cs = graycode.resolve_thresholds_views(
+            jnp.stack([white_v, black_v], axis=1), thresh_mode, shadow_val,
+            contrast_val)
+        args = (jnp.asarray(ss, jnp.float32), jnp.asarray(cs, jnp.float32),
+                self.rays, self.oc, self.plane_col, self.plane_row,
+                self.poly_col, self.poly_row,
+                jnp.float32(self.epipolar_tol))
+        cfg = (self._static, int(n_frames))
+        if mesh is not None:
+            n_dev = int(mesh.devices.size)
+            if planes_v.shape[0] % n_dev:
+                raise ValueError(
+                    f"sharded view batch: {planes_v.shape[0]} views not a "
+                    f"multiple of the {n_dev}-device mesh (the executor's "
+                    f"bucket padding must round to the device count)")
+            with _quiet_donation():
+                pts, cols, valid = _sharded_views_packed_fn(mesh, cfg)(
+                    planes_v, white_v, black_v, *args)
+            return CloudResult(pts, cols, valid)
+        with _quiet_donation():
+            return _scan_forward_views_packed_donated(
+                planes_v, white_v, black_v, *args, cfg=cfg)
+
 
 def _forward_math(frames, shadow, contrast, rays, oc, plane_col, plane_row,
                   poly_col, poly_row, epipolar_tol, cfg):
@@ -333,6 +377,85 @@ def _scan_forward_views_donated(frames_v, shadow_v, contrast_v, rays, oc,
     # instead of holding frames + outputs live simultaneously
     return _views_math(frames_v, shadow_v, contrast_v, rays, oc, plane_col,
                        plane_row, poly_col, poly_row, epipolar_tol, cfg)
+
+
+def _forward_math_packed(planes, white, black, shadow, contrast, rays, oc,
+                         plane_col, plane_row, poly_col, poly_row,
+                         epipolar_tol, cfg):
+    from structured_light_for_3d_model_replication_tpu.ops.graycode import (
+        _decode_packed_impl,
+    )
+    from structured_light_for_3d_model_replication_tpu.ops.triangulate import (
+        _triangulate_impl,
+    )
+
+    (n_cols, n_rows, n_sets_col, n_sets_row, downsample, row_mode,
+     use_poly), n_frames = cfg
+    # the texture channel IS the white frame — identical to _forward_math's
+    # frames[0], so packed and raw buckets compact to the same colors
+    texture = white[..., None].astype(jnp.uint8)
+    dec = _decode_packed_impl(planes, white, black, texture, shadow, contrast,
+                              n_frames=n_frames, n_cols=n_cols, n_rows=n_rows,
+                              n_sets_col=n_sets_col, n_sets_row=n_sets_row,
+                              downsample=downsample, xp=jnp)
+    return _triangulate_impl(
+        dec.col_map, dec.row_map, dec.mask, dec.texture,
+        rays, oc, plane_col, plane_row,
+        row_mode=row_mode, epipolar_tol=epipolar_tol, xp=jnp,
+        poly=(poly_col, poly_row) if use_poly else None,
+    )
+
+
+def _views_math_packed(planes_v, white_v, black_v, shadow_v, contrast_v, rays,
+                       oc, plane_col, plane_row, poly_col, poly_row,
+                       epipolar_tol, cfg):
+    # same lax.map-not-vmap rationale as _views_math: one view's worth of
+    # live intermediates, single-view Pallas lowering preserved
+    return jax.lax.map(
+        lambda args: _forward_math_packed(args[0], args[1], args[2], args[3],
+                                          args[4], rays, oc, plane_col,
+                                          plane_row, poly_col, poly_row,
+                                          epipolar_tol, cfg),
+        (planes_v, white_v, black_v, shadow_v, contrast_v))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("planes_v",))
+def _scan_forward_views_packed_donated(planes_v, white_v, black_v, shadow_v,
+                                       contrast_v, rays, oc, plane_col,
+                                       plane_row, poly_col, poly_row,
+                                       epipolar_tol, *, cfg):
+    return _views_math_packed(planes_v, white_v, black_v, shadow_v,
+                              contrast_v, rays, oc, plane_col, plane_row,
+                              poly_col, poly_row, epipolar_tol, cfg)
+
+
+@functools.cache
+def _sharded_views_packed_fn(mesh, cfg):
+    """Packed twin of :func:`_sharded_views_fn`: planes/white/black shard
+    data-major on the view axis, calibration replicates, planes donated."""
+    from jax.sharding import PartitionSpec
+
+    from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (
+        shard_map_unchecked,
+    )
+
+    axes = tuple(mesh.axis_names)
+    vspec = PartitionSpec(axes)
+    rep = PartitionSpec()
+
+    def local(planes_v, white_v, black_v, shadow_v, contrast_v, rays, oc,
+              plane_col, plane_row, poly_col, poly_row, epipolar_tol):
+        return tuple(_views_math_packed(planes_v, white_v, black_v, shadow_v,
+                                        contrast_v, rays, oc, plane_col,
+                                        plane_row, poly_col, poly_row,
+                                        epipolar_tol, cfg))
+
+    return jax.jit(shard_map_unchecked(
+        mesh=mesh,
+        in_specs=(vspec,) * 5 + (rep,) * 7,
+        out_specs=(vspec, vspec, vspec),
+    )(local), donate_argnums=(0,))
 
 
 @functools.cache

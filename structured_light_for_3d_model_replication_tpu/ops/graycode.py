@@ -34,6 +34,8 @@ __all__ = [
     "otsu_threshold_np",
     "decode_stack",
     "decode_stack_np",
+    "decode_packed",
+    "decode_packed_np",
     "DecodeResult",
 ]
 
@@ -274,6 +276,103 @@ def _decode_impl(
     return DecodeResult(col_map.astype(xp.int32), row_map.astype(xp.int32), mask, texture)
 
 
+def _decode_axis_packed(planes, pair_start, max_bits, n_use, xp, n_pairs=None):
+    """Packed twin of :func:`_decode_axis`: the comparison bits already exist
+    in the bit-plane array (plane p at byte p//8, bit p%8 — the io/images.py
+    pack layout), so "decode" is a shift-and-mask extraction feeding the same
+    weights / Gray->binary cascade / rescale arithmetic.
+
+    ``pair_start`` indexes pattern PAIRS, not frames: the raw stack's frame
+    offset ``2 + 2*pair_start`` maps to plane ``pair_start``. ``n_pairs``
+    (truncated-stack variant) clamps like _decode_axis's n_frames: with F
+    frames the pairs readable from frame offset ``2 + 2*s`` number
+    ``(F - 2 - 2*s)//2 = (F-2)//2 - s``, i.e. exactly ``n_pairs - s``.
+    """
+    avail = n_use if n_pairs is None else max(0, min(n_use, n_pairs - pair_start))
+    if avail == 0:
+        gray = xp.zeros(planes.shape[1:], xp.int32)
+    else:
+        p = np.arange(pair_start, pair_start + avail)
+        shifts = xp.asarray((p & 7).astype(np.uint8))[:, None, None]
+        bits = ((planes[p >> 3] >> shifts) & 1).astype(xp.int32)  # [avail, H, W]
+        weights = (1 << np.arange(n_use - 1, n_use - 1 - avail, -1, dtype=np.int32))
+        gray = xp.sum(bits * xp.asarray(weights)[:, None, None], axis=0)
+    binary = _gray_to_binary(gray, xp)
+    return binary * (1 << (max_bits - n_use))
+
+
+def _decode_packed_impl(
+    planes,          # uint8 [ceil(P/8), H, W] bit-planes (pack_stack layout)
+    white,           # uint8 [H, W] frame 0, verbatim
+    black,           # uint8 [H, W] frame 1, verbatim
+    texture,         # uint8 [H, W, 3]
+    shadow_thresh,
+    contrast_thresh,
+    *,
+    n_frames: int,   # logical frame count of the packed stack (static)
+    n_cols: int,
+    n_rows: int,
+    n_sets_col: int,
+    n_sets_row: int,
+    downsample: int,
+    xp,
+    skip_remaining_before_row: bool = False,
+):
+    n_cols = n_cols // downsample
+    n_rows = n_rows // downsample
+    max_col_bits = _n_bits(n_cols)
+    max_row_bits = _n_bits(n_rows)
+    n_use_col = max(1, min(int(n_sets_col), max_col_bits))
+    n_use_row = max(1, min(int(n_sets_row), max_row_bits))
+
+    need = 2 + 2 * (max_col_bits + max_row_bits)
+    n_pairs = None
+    if n_frames < need:
+        if not skip_remaining_before_row:
+            raise ValueError(
+                f"Not enough frames: got {n_frames}, need {need} "
+                f"(white + black + 2*({max_col_bits} col + {max_row_bits} row "
+                f"bit-planes)) for a {n_cols}x{n_rows} projector. Pass "
+                f"skip_remaining_before_row=True for the legacy "
+                f"truncated-stack decode."
+            )
+        n_pairs = (n_frames - 2) // 2
+
+    if xp is not np and n_pairs is None:
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            pallas_kernels as pk,
+        )
+
+        h, w = white.shape
+        if (pk.decode_packed_kernel_ok() and planes.dtype == jnp.uint8
+                and h % 8 == 0 and w % 128 == 0):
+            # fused Pallas unpack+decode: one VMEM pass over the packed
+            # planes; bit-exact twin of the arithmetic below, same gating
+            # discipline as decode_maps_fused above (probe + kill switch;
+            # except arm only helps eager callers).
+            try:
+                col, row, mask = pk.decode_packed_maps_fused(
+                    planes, white, black, shadow_thresh, contrast_thresh,
+                    n_bits_col=max_col_bits, n_bits_row=max_row_bits,
+                    n_use_col=n_use_col, n_use_row=n_use_row)
+                return DecodeResult((col * downsample).astype(xp.int32),
+                                    (row * downsample).astype(xp.int32),
+                                    mask, texture)
+            except Exception:
+                pass  # fall through to the jnp twin below
+
+    w16 = white.astype(xp.int16)
+    b16 = black.astype(xp.int16)
+    mask = (w16 > shadow_thresh) & ((w16 - b16) > contrast_thresh)
+
+    col_map = _decode_axis_packed(planes, 0, max_col_bits, n_use_col, xp,
+                                  n_pairs=n_pairs) * downsample
+    row_map = _decode_axis_packed(planes, max_col_bits, max_row_bits,
+                                  n_use_row, xp, n_pairs=n_pairs) * downsample
+    return DecodeResult(col_map.astype(xp.int32), row_map.astype(xp.int32),
+                        mask, texture)
+
+
 def _shadow_contrast_hists(white_u8, diff_u8, xp):
     """256-bin histograms of the white frame and the clipped white-black diff."""
     if xp is np:
@@ -434,5 +533,102 @@ def decode_stack(
         jnp.asarray(shadow_val, jnp.float32), jnp.asarray(contrast_val, jnp.float32),
         n_cols=n_cols, n_rows=n_rows, n_sets_col=n_sets_col, n_sets_row=n_sets_row,
         otsu_device=otsu_device, downsample=downsample,
+        skip_remaining_before_row=skip_remaining_before_row,
+    )
+
+
+def decode_packed_np(
+    planes: np.ndarray,
+    white: np.ndarray,
+    black: np.ndarray,
+    texture: np.ndarray | None = None,
+    *,
+    n_frames: int,
+    n_cols: int = 1920,
+    n_rows: int = 1080,
+    n_sets_col: int = 11,
+    n_sets_row: int = 11,
+    thresh_mode: str = "otsu",
+    shadow_val: float = 40.0,
+    contrast_val: float = 10.0,
+    downsample: int = 1,
+    skip_remaining_before_row: bool = False,
+) -> DecodeResult:
+    """NumPy decode of a packed bit-plane stack (io/images.py ``pack_stack``
+    layout) — bit-identical to ``decode_stack_np`` on the raw stack the planes
+    were packed from: thresholds and mask read only the verbatim white/black
+    frames, and the stored bits ARE the per-pair comparisons decode computes.
+    """
+    if texture is None:
+        texture = np.repeat(white[..., None], 3, axis=-1).astype(np.uint8)
+    shadow, contrast = resolve_thresholds(
+        np.stack([white, black]), thresh_mode, shadow_val, contrast_val, np)
+    return _decode_packed_impl(
+        planes, white, black, texture, shadow, contrast,
+        n_frames=n_frames, n_cols=n_cols, n_rows=n_rows,
+        n_sets_col=n_sets_col, n_sets_row=n_sets_row, downsample=downsample,
+        xp=np, skip_remaining_before_row=skip_remaining_before_row,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_frames", "n_cols", "n_rows", "n_sets_col", "n_sets_row",
+                     "otsu_device", "downsample", "skip_remaining_before_row"),
+)
+def _decode_packed_jit(
+    planes, white, black, texture, shadow_val, contrast_val,
+    *, n_frames, n_cols, n_rows, n_sets_col, n_sets_row, otsu_device,
+    downsample, skip_remaining_before_row,
+):
+    if otsu_device:
+        frames2 = jnp.stack([white, black])
+        white_u8, diff_u8 = _white_diff_u8(frames2, jnp)
+        shadow = otsu_threshold(white_u8).astype(jnp.int16)
+        contrast = otsu_threshold(diff_u8).astype(jnp.int16)
+    else:
+        shadow, contrast = shadow_val, contrast_val
+    return _decode_packed_impl(
+        planes, white, black, texture, shadow, contrast,
+        n_frames=n_frames, n_cols=n_cols, n_rows=n_rows,
+        n_sets_col=n_sets_col, n_sets_row=n_sets_row, downsample=downsample,
+        xp=jnp, skip_remaining_before_row=skip_remaining_before_row,
+    )
+
+
+def decode_packed(
+    planes: jax.Array,
+    white: jax.Array,
+    black: jax.Array,
+    texture: jax.Array | None = None,
+    *,
+    n_frames: int,
+    n_cols: int = 1920,
+    n_rows: int = 1080,
+    n_sets_col: int = 11,
+    n_sets_row: int = 11,
+    thresh_mode: str = "otsu",
+    shadow_val: float = 40.0,
+    contrast_val: float = 10.0,
+    downsample: int = 1,
+    skip_remaining_before_row: bool = False,
+) -> DecodeResult:
+    """JAX/TPU decode of a packed bit-plane stack. Same threshold modes as
+    ``decode_stack``; the stack arrives as ~8x fewer bytes (the streaming
+    ingest lane's wire format) and decode runs straight from the packed bits
+    — through the Pallas unpack+decode kernel when the capability probe
+    admits it, the jnp twin otherwise."""
+    if texture is None:
+        texture = jnp.repeat(white[..., None], 3, axis=-1).astype(jnp.uint8)
+    otsu_device = thresh_mode == "otsu_device"
+    if thresh_mode == "otsu":
+        shadow_val, contrast_val = resolve_thresholds(
+            jnp.stack([white, black]), "otsu", shadow_val, contrast_val, jnp)
+    return _decode_packed_jit(
+        planes, white, black, texture,
+        jnp.asarray(shadow_val, jnp.float32), jnp.asarray(contrast_val, jnp.float32),
+        n_frames=n_frames, n_cols=n_cols, n_rows=n_rows,
+        n_sets_col=n_sets_col, n_sets_row=n_sets_row, otsu_device=otsu_device,
+        downsample=downsample,
         skip_remaining_before_row=skip_remaining_before_row,
     )

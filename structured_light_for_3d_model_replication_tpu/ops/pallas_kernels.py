@@ -36,7 +36,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["use_pallas", "pallas_mode", "nn1", "radius_count_pallas",
-           "decode_maps_fused", "scan_points_fused_views",
+           "decode_maps_fused", "decode_packed_maps_fused",
+           "decode_packed_kernel_ok", "scan_points_fused_views",
            "slab_mean_knn", "slab_bisect_ok",
            "knn_mean", "knn_mean_np", "knn_mean_ok",
            "ransac_score", "ransac_score_np", "ransac_score_ok",
@@ -46,6 +47,8 @@ _FAR = 1e9
 
 _PALLAS_MODE: str | None = None  # "compiled" | "interpret" (probe result, cached)
 _VIEWS_KERNEL_OK = True          # view-batched decode lowering probe result
+_PACKED_KERNEL_OK = True         # packed bit-plane decode probe result
+_PACKED_VIEWS_OK = True          # view-batched packed decode probe result
 _SCAN_FUSED_OK = True            # fused decode+triangulate lowering probe result
 _SLAB_BISECT_OK = True           # slab bisection kernel probe result
 _KNN_MEAN_OK = True              # dense knn-mean kernel probe result
@@ -94,6 +97,17 @@ def ransac_score_ok() -> bool:
     return use_pallas() and _RANSAC_SCORE_OK
 
 
+def decode_packed_kernel_ok() -> bool:
+    """True when the COMPILED packed bit-plane decode kernel passed its
+    capability probe (False in interpret mode — graycode's packed decode
+    then keeps its jnp twin; CPU parity tests run the kernel via interpret
+    explicitly). ``SLSCAN_PACKED_KERNEL=0`` is the operator kill switch."""
+    if os.environ.get("SLSCAN_PACKED_KERNEL", "").strip().lower() in (
+            "0", "off", "false"):
+        return False
+    return use_pallas() and _PACKED_KERNEL_OK
+
+
 def kernel_report() -> dict:
     """Per-kernel capability verdicts (probe results + kill switches) —
     what `sl3d warmup` logs so an operator can see which Mosaic lowerings
@@ -106,6 +120,8 @@ def kernel_report() -> dict:
         "radius_count": compiled,
         "decode": compiled,
         "decode_views": compiled and _VIEWS_KERNEL_OK,
+        "decode_packed": decode_packed_kernel_ok(),
+        "decode_packed_views": decode_packed_kernel_ok() and _PACKED_VIEWS_OK,
         "scan_fused": scan_fused_ok(),
         "slab_bisect": slab_bisect_ok(),
         "knn_mean": knn_mean_ok(),
@@ -159,6 +175,50 @@ def _probe_compiled() -> bool:
         _VIEWS_KERNEL_OK = colb.shape == (2, 8, 256)
     except Exception:
         _VIEWS_KERNEL_OK = False
+
+    # packed bit-plane decode kernel: COMPILED run on a varied small stack
+    # checked bit-for-bit against the raw-stack decode kernel, then a
+    # compile-only lowering at the 1080p production geometry (22 pairs ->
+    # 3 plane bytes). A failure demotes only the packed fastpath — the jnp
+    # packed twin in graycode._decode_packed_impl remains.
+    global _PACKED_KERNEL_OK, _PACKED_VIEWS_OK
+    try:
+        rngq = np.random.default_rng(7)
+        pstack = rngq.integers(0, 256, (10, 8, 256), dtype=np.uint8)
+        pbits = (pstack[2::2].astype(np.int16)
+                 > pstack[3::2].astype(np.int16)).astype(np.uint8)
+        pplanes = jnp.asarray(np.packbits(pbits, axis=0, bitorder="little"))
+        pthr = jnp.asarray([40.0, 10.0], jnp.float32)
+        cr, rr, mr = _decode_call(jnp.asarray(pstack), pthr,
+                                  3, 1, 3, 1, 8, 256, False)
+        cp, rp, mp = _decode_packed_call(
+            pplanes, jnp.asarray(pstack[0]), jnp.asarray(pstack[1]), pthr,
+            3, 1, 3, 1, 8, 256, False)
+        _PACKED_KERNEL_OK = bool(
+            np.array_equal(np.asarray(cp), np.asarray(cr))
+            and np.array_equal(np.asarray(rp), np.asarray(rr))
+            and np.array_equal(np.asarray(mp), np.asarray(mr)))
+        if _PACKED_KERNEL_OK:
+            _decode_packed_call.lower(
+                jax.ShapeDtypeStruct((3, 1080, 1920), jnp.uint8),
+                jax.ShapeDtypeStruct((1080, 1920), jnp.uint8),
+                jax.ShapeDtypeStruct((1080, 1920), jnp.uint8),
+                jax.ShapeDtypeStruct((2,), jnp.float32),
+                11, 11, 11, 11, 8, 128, False).compile()
+    except Exception:
+        _PACKED_KERNEL_OK = False
+    try:
+        cpv, rpv, mpv = _decode_packed_call_views(
+            jnp.stack([pplanes, pplanes]),
+            jnp.stack([jnp.asarray(pstack[0])] * 2),
+            jnp.stack([jnp.asarray(pstack[1])] * 2),
+            jnp.asarray([[40.0, 10.0], [35.0, 8.0]], jnp.float32),
+            3, 1, 3, 1, 8, 256, False)
+        _PACKED_VIEWS_OK = (_PACKED_KERNEL_OK and cpv.shape == (2, 8, 256)
+                            and np.array_equal(np.asarray(cpv[0]),
+                                               np.asarray(cp)))
+    except Exception:
+        _PACKED_VIEWS_OK = False
 
     global _SCAN_FUSED_OK
     try:
@@ -844,6 +904,206 @@ def decode_maps_fused(frames, shadow, contrast, *, n_bits_col: int,
     call = _decode_caller(n_bits_col, n_bits_row, n_use_col, n_use_row,
                           tile_h, tile_w, itp)
     return call(frames, thr)
+
+
+# ---------------------------------------------------------------------------
+# decode_packed_maps_fused: unpack + Gray decode straight from bit-planes
+# ---------------------------------------------------------------------------
+
+def _decode_packed_tile(read_plane_byte, white_i32, black_i32, shadow,
+                        contrast, *, n_bits_col: int, n_bits_row: int,
+                        n_use_col: int, n_use_row: int):
+    """Packed twin of :func:`_decode_tile`: the per-pair ``pattern > inverse``
+    compare is replaced by a shift-and-mask bit extraction from the packed
+    planes (io/images.py layout: pair p at byte p//8, bit p%8), feeding the
+    identical XOR cascade and rescale shift. ``read_plane_byte(k)`` returns
+    plane-byte k of the tile as int32; the plane index arithmetic is static
+    (unrolled loop), so consecutive bits of one byte share a single VMEM read.
+    """
+    white = white_i32.astype(jnp.float32)
+    black = black_i32.astype(jnp.float32)
+    mask = (white > shadow) & ((white - black) > contrast)
+
+    def decode_axis(pair_start, n_bits, n_use):
+        shape = white.shape
+        binary = jnp.zeros(shape, jnp.int32)
+        gray_prev = jnp.zeros(shape, jnp.int32)
+        for b in range(n_use):  # static unroll: n_use <= 11
+            p = pair_start + b
+            g = (read_plane_byte(p >> 3) >> (p & 7)) & 1
+            bit = gray_prev ^ g
+            binary = (binary << 1) | bit
+            gray_prev = bit
+        return binary << (n_bits - n_use)
+
+    col = decode_axis(0, n_bits_col, n_use_col)
+    row = decode_axis(n_bits_col, n_bits_row, n_use_row)
+    return col, row, mask
+
+
+def _decode_packed_kernel(planes_ref, white_ref, black_ref, thr_ref, col_ref,
+                          row_ref, mask_ref, *, n_bits_col: int,
+                          n_bits_row: int, n_use_col: int, n_use_row: int):
+    """planes_ref [Pb, th, tw] u8; white/black_ref [th, tw] u8; thr_ref [2]."""
+    col, row, mask = _decode_packed_tile(
+        lambda k: planes_ref[k].astype(jnp.int32),
+        white_ref[...].astype(jnp.int32), black_ref[...].astype(jnp.int32),
+        thr_ref[0], thr_ref[1],
+        n_bits_col=n_bits_col, n_bits_row=n_bits_row, n_use_col=n_use_col,
+        n_use_row=n_use_row)
+    col_ref[:] = col
+    row_ref[:] = row
+    mask_ref[:] = mask
+
+
+def _decode_packed_kernel_views(planes_ref, white_ref, black_ref, thr_ref,
+                                col_ref, row_ref, mask_ref, *,
+                                n_bits_col: int, n_bits_row: int,
+                                n_use_col: int, n_use_row: int):
+    """View-batched twin; thr [V, 2] whole in SMEM, indexed by the view grid
+    coordinate (same SMEM-can't-batch workaround as _decode_kernel_views)."""
+    v = pl.program_id(0)
+    col, row, mask = _decode_packed_tile(
+        lambda k: planes_ref[0, k].astype(jnp.int32),
+        white_ref[0].astype(jnp.int32), black_ref[0].astype(jnp.int32),
+        thr_ref[v, 0], thr_ref[v, 1],
+        n_bits_col=n_bits_col, n_bits_row=n_bits_row, n_use_col=n_use_col,
+        n_use_row=n_use_row)
+    col_ref[0] = col
+    row_ref[0] = row
+    mask_ref[0] = mask
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_bits_col", "n_bits_row", "n_use_col", "n_use_row", "tile_h", "tile_w",
+    "interpret"))
+def _decode_packed_call(planes, white, black, thr, n_bits_col: int,
+                        n_bits_row: int, n_use_col: int, n_use_row: int,
+                        tile_h: int, tile_w: int, interpret: bool):
+    pb, h, w = planes.shape
+    grid = (h // tile_h, w // tile_w)
+    hw_spec = pl.BlockSpec((tile_h, tile_w), lambda i, j: (i, j),
+                           memory_space=pltpu.VMEM)
+    col, row, mask = pl.pallas_call(
+        functools.partial(_decode_packed_kernel, n_bits_col=n_bits_col,
+                          n_bits_row=n_bits_row, n_use_col=n_use_col,
+                          n_use_row=n_use_row),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((pb, tile_h, tile_w), lambda i, j: (0, i, j),
+                         memory_space=pltpu.VMEM),
+            hw_spec, hw_spec,
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(hw_spec, hw_spec, hw_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((h, w), jnp.int32),
+            jax.ShapeDtypeStruct((h, w), jnp.int32),
+            jax.ShapeDtypeStruct((h, w), jnp.bool_),
+        ),
+        interpret=interpret,
+    )(planes, white, black, thr)
+    return col, row, mask
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_bits_col", "n_bits_row", "n_use_col", "n_use_row", "tile_h", "tile_w",
+    "interpret"))
+def _decode_packed_call_views(planes, white, black, thr, n_bits_col: int,
+                              n_bits_row: int, n_use_col: int, n_use_row: int,
+                              tile_h: int, tile_w: int, interpret: bool):
+    v, pb, h, w = planes.shape
+    grid = (v, h // tile_h, w // tile_w)
+    hw_spec = pl.BlockSpec((1, tile_h, tile_w), lambda v, i, j: (v, i, j),
+                           memory_space=pltpu.VMEM)
+    col, row, mask = pl.pallas_call(
+        functools.partial(_decode_packed_kernel_views, n_bits_col=n_bits_col,
+                          n_bits_row=n_bits_row, n_use_col=n_use_col,
+                          n_use_row=n_use_row),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, pb, tile_h, tile_w), lambda v, i, j: (v, 0, i, j),
+                         memory_space=pltpu.VMEM),
+            hw_spec, hw_spec,
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # thr [V,2] whole in SMEM
+        ],
+        out_specs=(hw_spec, hw_spec, hw_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((v, h, w), jnp.int32),
+            jax.ShapeDtypeStruct((v, h, w), jnp.int32),
+            jax.ShapeDtypeStruct((v, h, w), jnp.bool_),
+        ),
+        interpret=interpret,
+    )(planes, white, black, thr)
+    return col, row, mask
+
+
+@functools.lru_cache(maxsize=None)
+def _decode_packed_caller(n_bits_col: int, n_bits_row: int, n_use_col: int,
+                          n_use_row: int, tile_h: int, tile_w: int,
+                          interpret: bool):
+    """custom_vmap wrapper, same construction as _decode_caller: vmap over
+    views dispatches the natively view-batched packed kernel instead of
+    Mosaic's generic batching rule (which rejects batched SMEM operands)."""
+
+    @jax.custom_batching.custom_vmap
+    def call(planes, white, black, thr):
+        return _decode_packed_call(planes, white, black, thr, n_bits_col,
+                                   n_bits_row, n_use_col, n_use_row, tile_h,
+                                   tile_w, interpret)
+
+    @call.def_vmap
+    def _batched(axis_size, in_batched, planes, white, black, thr):
+        pb, wb, bb, tb = in_batched
+        if not pb:
+            planes = jnp.broadcast_to(planes[None],
+                                      (axis_size,) + planes.shape)
+        if not wb:
+            white = jnp.broadcast_to(white[None], (axis_size,) + white.shape)
+        if not bb:
+            black = jnp.broadcast_to(black[None], (axis_size,) + black.shape)
+        if not tb:
+            thr = jnp.broadcast_to(thr[None], (axis_size, 2))
+        if _PACKED_VIEWS_OK:
+            out = _decode_packed_call_views(planes, white, black, thr,
+                                            n_bits_col, n_bits_row, n_use_col,
+                                            n_use_row, tile_h, tile_w,
+                                            interpret)
+        else:  # views lowering unavailable: serialize the single-view kernel
+            out = jax.lax.map(
+                lambda t: _decode_packed_call(t[0], t[1], t[2], t[3],
+                                              n_bits_col, n_bits_row,
+                                              n_use_col, n_use_row, tile_h,
+                                              tile_w, interpret),
+                (planes, white, black, thr))
+        return out, (True, True, True)
+
+    return call
+
+
+def decode_packed_maps_fused(planes, white, black, shadow, contrast, *,
+                             n_bits_col: int, n_bits_row: int, n_use_col: int,
+                             n_use_row: int, tile_h: int = 8,
+                             tile_w: int = 256,
+                             interpret: bool | None = None):
+    """Fused col/row/mask decode straight from a packed bit-plane stack
+    (planes u8 [ceil(P/8), H, W] + white/black u8 [H, W], the io/images.py
+    pack layout). The stack never exists unpacked anywhere — HBM holds the
+    ~8x-smaller planes and the kernel extracts bits in VMEM. Bit-exact twin
+    of ops/graycode._decode_packed_impl's jnp arithmetic; vmap-safe over
+    views (one level) via the view-batched kernel."""
+    planes = jnp.asarray(planes)
+    pb, h, w = planes.shape
+    while h % tile_h:
+        tile_h //= 2
+    while w % tile_w:
+        tile_w //= 2
+    thr = jnp.stack([jnp.asarray(shadow, jnp.float32),
+                     jnp.asarray(contrast, jnp.float32)])
+    itp = _interpret() if interpret is None else interpret
+    call = _decode_packed_caller(n_bits_col, n_bits_row, n_use_col, n_use_row,
+                                 tile_h, tile_w, itp)
+    return call(planes, jnp.asarray(white), jnp.asarray(black), thr)
 
 
 # ---------------------------------------------------------------------------

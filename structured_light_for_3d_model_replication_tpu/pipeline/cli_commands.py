@@ -115,6 +115,18 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
                         "sharded across devices when >1 is attached; <=1 "
                         "forces the per-view dispatch loop; default: "
                         "parallel.compute_batch)")
+    p.add_argument("--packed-ingest", dest="packed_ingest",
+                   action="store_true", default=None,
+                   help="capture-rate ingest (pipeline.packed_ingest): "
+                        "load each view as a packed 1-bit bit-plane stack "
+                        "(frames.slbp, or packed in the loader), stream "
+                        "the ~8x-smaller planes to the device and decode "
+                        "from bits on device; byte-identical outputs "
+                        "(batched executor only)")
+    p.add_argument("--no-packed-ingest", dest="packed_ingest",
+                   action="store_false",
+                   help="force raw frame-stack ingest "
+                        "(pipeline.packed_ingest=false)")
     add_config_args(p)
 
     p = sub.add_parser("clean",
@@ -186,6 +198,18 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
                    action="store_false",
                    help="force the discrete host-masked clean path "
                         "(pipeline.fused_clean=false)")
+    p.add_argument("--packed-ingest", dest="packed_ingest",
+                   action="store_true", default=None,
+                   help="capture-rate ingest (pipeline.packed_ingest): "
+                        "load each view as a packed 1-bit bit-plane stack "
+                        "(frames.slbp, or packed in the loader), stream "
+                        "the ~8x-smaller planes to the device and decode "
+                        "from bits on device; byte-identical outputs "
+                        "(batched executor only)")
+    p.add_argument("--no-packed-ingest", dest="packed_ingest",
+                   action="store_false",
+                   help="force raw frame-stack ingest "
+                        "(pipeline.packed_ingest=false)")
     p.add_argument("--trace", action="store_true",
                    help="arm the flight recorder (observability.trace; env "
                         "SL3D_TRACE=1): write an append-only crash-safe "
@@ -426,6 +450,8 @@ def _cmd_reconstruct(args) -> int:
         cfg.parallel.prefetch_depth = args.prefetch_depth
     if args.compute_batch is not None:
         cfg.parallel.compute_batch = args.compute_batch
+    if args.packed_ingest is not None:
+        cfg.pipeline.packed_ingest = args.packed_ingest
     report = stages.reconstruct(args.calib, args.target, mode=args.mode,
                                 output=args.output, cfg=cfg)
     if report.overlap:
@@ -490,6 +516,8 @@ def _cmd_pipeline(args) -> int:
         cfg.merge.pair_batch = args.pair_batch
     if args.fused_clean is not None:
         cfg.pipeline.fused_clean = args.fused_clean
+    if args.packed_ingest is not None:
+        cfg.pipeline.packed_ingest = args.packed_ingest
     if args.trace:
         cfg.observability.trace = True
     if args.run_budget is not None:
@@ -817,6 +845,7 @@ def _build_capture_rig(cfg):
         brightness=cfg.projector.brightness,
         downsample=cfg.projector.downsample,
         scan_settle_ms=a.settle_ms_scan, calib_settle_ms=a.settle_ms_calib,
+        pack_frames=a.pack_frames, pack_keep_raw=a.pack_keep_raw,
     )
     turntable = open_turntable("sim" if a.simulate else "auto",
                                port=a.serial_port or None)
@@ -988,6 +1017,29 @@ def _cmd_warmup(args) -> int:
             except Exception as e:
                 print(f"[warmup] fused_clean[bucket={b}] skipped ({e})",
                       file=sys.stderr)
+            # packed-decode ladder: the capture-rate ingest lane decodes
+            # from packed bit-planes through its OWN donated / shard_map
+            # programs — warm them per bucket so a --packed-ingest run
+            # pays no compile inside the streaming drain
+            t0 = time.perf_counter()
+            try:
+                from structured_light_for_3d_model_replication_tpu.io import (
+                    images as imio,
+                )
+
+                packed = [imio.pack_stack(v) for v in bucket_stack]
+                res_p = sc.forward_views_packed(
+                    jnp.asarray(np.stack([p.planes for p in packed])),
+                    jnp.asarray(np.stack([p.white for p in packed])),
+                    jnp.asarray(np.stack([p.black for p in packed])),
+                    n_frames=int(frames_np.shape[0]),
+                    thresh_mode="manual", mesh=mesh)
+                jax.block_until_ready(res_p.points)
+                print(f"[warmup] forward_views_packed[bucket={b}]: "
+                      f"{time.perf_counter() - t0:.1f}s")
+            except Exception as e:
+                print(f"[warmup] forward_views_packed[bucket={b}] "
+                      f"skipped ({e})", file=sys.stderr)
 
     # kernel capability probes: each Pallas kernel compiles a tiny probe
     # once per process and falls back (interpret on CPU, numpy twin on

@@ -214,7 +214,7 @@ def analyze_run(out_dir: str, trace_file: str = "trace.jsonl",
             elif name == "executor.finish":
                 a.critical_path_s = ev.get("critical_path_s")
             elif name == "transfer.bytes":
-                for k in ("h2d", "d2h", "frames"):
+                for k in ("h2d", "d2h", "frames", "frames_raw"):
                     v = ev.get(k)
                     if v:
                         a.transfer[k] = a.transfer.get(k, 0) + int(v)
@@ -448,9 +448,17 @@ def render_report(a: RunAnalysis, width: int = 60) -> str:
                          + (f", {bk['bytes']} B" if bk["bytes"] else "")
                          + ")")
         if a.transfer:
+            fr = a.transfer.get("frames", 0)
+            raw = a.transfer.get("frames_raw", 0)
+            packed = ""
+            if fr and raw > fr:
+                # frames_raw is only journaled when it differs from the
+                # wire size, i.e. packed ingest was on — show both sides
+                packed = (f"; packed ingest: {fr} B wire for {raw} B raw "
+                          f"({raw / fr:.1f}x fewer frame bytes)")
             L.append(f"  transfers      {a.transfer.get('h2d', 0)} B h2d "
-                     f"({a.transfer.get('frames', 0)} B frame uploads) / "
-                     f"{a.transfer.get('d2h', 0)} B d2h")
+                     f"({fr} B frame uploads) / "
+                     f"{a.transfer.get('d2h', 0)} B d2h" + packed)
 
     if (a.retries or a.failures or a.injected or a.quarantined
             or (a.manifest and a.manifest.get("failures"))):

@@ -109,8 +109,10 @@ def _scan_sources(target: str, mode: str, need: int, log=None) -> list[str]:
         out = []
         for s in subs:
             try:
-                n = len(imio.list_frame_files(s))
-            except (FileNotFoundError, NotADirectoryError):
+                # header-only for packed containers (frames.slbp) — planning
+                # never pays an unpack
+                n = imio.count_frames(s)
+            except (FileNotFoundError, NotADirectoryError, IOError):
                 if log is not None:
                     log(f"[reconstruct] skipping {s}: no frame images found")
                 continue
@@ -258,7 +260,28 @@ def _load_fired(src, cfg: Config):
     # has moved at all" by the entry beat
     dl.beat("load")
     faults.fire("frame.load", item=src)
+    if imio.is_packed_source(src):
+        # a packed source's unpack IS the codec step on this lane
+        dl.beat("load")
+        faults.fire("frame.pack", item=src)
     return imio.load_stack(src, io_workers=cfg.parallel.io_workers)
+
+
+def _load_packed_fired(src, cfg: Config):
+    """Packed-ingest load: a packed source loads its container directly; a
+    raw source is packed at load time (the capture-side codec step) behind
+    the ``frame.pack`` injection site. Either way the prefetch thread hands
+    the executor a PackedStack — ~8x fewer bytes on the h2d stream."""
+    dl.beat("load")
+    faults.fire("frame.load", item=src)
+    if imio.is_packed_source(src):
+        dl.beat("load")
+        faults.fire("frame.pack", item=src)
+        return imio.load_packed_stack(src)
+    frames, texture = imio.load_stack(src, io_workers=cfg.parallel.io_workers)
+    dl.beat("load")
+    faults.fire("frame.pack", item=src)
+    return imio.pack_stack(frames, texture=texture)
 
 
 def _compute_fired(frames, texture, calib, cfg, scanner, src,
@@ -638,6 +661,15 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
     # cleans the whole batch on device and syncs ONCE; any failure inside
     # degrades to the per-view lane exactly like a poisoned batch
     use_fused = bool(cfg.pipeline.fused_clean)
+    # packed ingest (pipeline.packed_ingest): the prefetch threads hand the
+    # executor bit-plane PackedStacks (raw sources pack at load, packed
+    # sources load their container) and each view's ~8x-smaller buffers
+    # stream to the device AS THEY ARRIVE — h2d overlaps the previous
+    # bucket's compute instead of serializing at bucket assembly. A
+    # schedule/format knob only: decode from packed bits is bit-identical
+    # (see ops/graycode.decode_packed_np), and the knob lives outside the
+    # stage-cache key material.
+    use_packed = bool(cfg.pipeline.packed_ingest)
 
     mesh = meshlib.views_mesh(cfg.parallel)
     n_dev = int(mesh.devices.size) if mesh is not None else 1
@@ -670,6 +702,32 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
                            lane_retry("load"))
         stats.add("load", time.perf_counter() - t0, view=_item_name(src))
         return out
+
+    def load_one_packed(src):
+        """Packed-ingest prefetch: load/pack the stack AND stream its
+        bit-plane buffers to the device right here on the prefetch thread —
+        the h2d of view k+1 overlaps the compute of bucket k (JAX async
+        dispatch; single-device lane). The mesh lane defers the upload to
+        the sharded bucket put at assembly. Returns (PackedStack, device
+        buffers | None) — the executor's (frames, texture) item slots."""
+        t0 = time.perf_counter()
+        ps = _retry_stage("load", lambda: _load_packed_fired(src, cfg),
+                          policy, lane_retry("load"))
+        stats.add("load", time.perf_counter() - t0, view=_item_name(src))
+        dev = None
+        if mesh is None:
+            t0 = time.perf_counter()
+            dev = (jax.device_put(ps.planes), jax.device_put(ps.white),
+                   jax.device_put(ps.black))
+            stats.add("transfer", time.perf_counter() - t0,
+                      view=_item_name(src))
+        # frame h2d at actual WIRE size (the packed bytes), with the raw u8
+        # equivalent alongside so report can show the ratio
+        stats.add_transfer(frames=int(ps.nbytes),
+                           frames_raw=int(np.prod(ps.shape)))
+        return ps, dev
+
+    loader = load_one_packed if use_packed else load_one
 
     def finish_view(idx, src, pts, cols, dev=None, cleaned=False):
         """Clean + write/collect ONE compacted view (drain thread) — the
@@ -704,8 +762,13 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
 
     def run_view_fallback(item):
         """The per-view lane a poisoned batch degrades to: identical
-        retry/quarantine semantics to the serial/pipelined executors."""
+        retry/quarantine semantics to the serial/pipelined executors. A
+        packed item unpacks here — decode on the binarized stack is
+        bit-identical (pack_stack's contract), so quarantine survivors
+        match the raw lane byte for byte."""
         idx, src, frames, texture = item
+        if isinstance(frames, imio.PackedStack):
+            frames, texture = imio.unpack_stack(frames)
         try:
             t0 = time.perf_counter()
             cloud = _retry_stage(
@@ -808,21 +871,58 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
         if poisoned is None:
             try:
                 t0 = time.perf_counter()
-                fv = np.stack([f for _, _, f, _ in items])
                 v = len(items)
                 bucket = _view_bucket(v, batch_n, n_dev)
-                if bucket > v:
-                    fv = np.concatenate(
-                        [fv, np.repeat(fv[-1:], bucket - v, axis=0)])
-                if mesh is not None:
-                    fv_d = jax.device_put(fv, meshlib.batch_sharding(mesh))
+                if use_packed:
+                    import jax.numpy as jnp
+
+                    n_frames = int(items[0][2].n_frames)
+                    if mesh is not None:
+                        # sharded bucket put of the PACKED host arrays —
+                        # still ~8x fewer wire bytes than the raw stack
+                        def pad(a):
+                            if bucket > v:
+                                a = np.concatenate(
+                                    [a, np.repeat(a[-1:], bucket - v,
+                                                  axis=0)])
+                            return jax.device_put(
+                                a, meshlib.batch_sharding(mesh))
+
+                        planes_d = pad(np.stack(
+                            [it[2].planes for it in items]))
+                        white_d = pad(np.stack(
+                            [it[2].white for it in items]))
+                        black_d = pad(np.stack(
+                            [it[2].black for it in items]))
+                    else:
+                        # per-view buffers already streamed to HBM by the
+                        # prefetch threads; bucket assembly is an on-device
+                        # stack (padding repeats device buffers — no h2d)
+                        devs = [it[3] for it in items]
+                        devs = devs + [devs[-1]] * (bucket - v)
+                        planes_d = jnp.stack([d[0] for d in devs])
+                        white_d = jnp.stack([d[1] for d in devs])
+                        black_d = jnp.stack([d[2] for d in devs])
+                    stats.add("transfer", time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    cloud = scanner.forward_views_packed(
+                        planes_d, white_d, black_d, n_frames=n_frames,
+                        mesh=mesh, **fwd_kw)
                 else:
-                    fv_d = jax.device_put(fv)
-                stats.add("transfer", time.perf_counter() - t0)
-                stats.add_transfer(frames=int(fv.nbytes))
-                t0 = time.perf_counter()
-                cloud = scanner.forward_views_batched(fv_d, mesh=mesh,
-                                                      **fwd_kw)
+                    fv = np.stack([f for _, _, f, _ in items])
+                    if bucket > v:
+                        fv = np.concatenate(
+                            [fv, np.repeat(fv[-1:], bucket - v, axis=0)])
+                    if mesh is not None:
+                        fv_d = jax.device_put(fv,
+                                              meshlib.batch_sharding(mesh))
+                    else:
+                        fv_d = jax.device_put(fv)
+                    stats.add("transfer", time.perf_counter() - t0)
+                    stats.add_transfer(frames=int(fv.nbytes))
+                    t0 = time.perf_counter()
+                    cloud = scanner.forward_views_batched(fv_d, mesh=mesh,
+                                                          **fwd_kw)
                 stats.add_launch(v, bucket, time.perf_counter() - t0)
                 cloud = tri.CloudResult(cloud.points[:v], cloud.colors[:v],
                                         cloud.valid[:v])
@@ -850,7 +950,7 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
             next_i = 0
             while next_i < len(pending) and len(inflight) < depth:
                 idx, src = pending[next_i]
-                inflight.append((idx, src, load_pool.submit(load_one, src)))
+                inflight.append((idx, src, load_pool.submit(loader, src)))
                 next_i += 1
 
             batch_items: list[tuple] = []
@@ -880,7 +980,7 @@ def _reconstruct_batched(sources, calib, cfg, scanner, mode, output, report,
                 stats.sample_queue(len(inflight))
                 if next_i < len(pending):     # keep the prefetch window full
                     j, s = pending[next_i]
-                    inflight.append((j, s, load_pool.submit(load_one, s)))
+                    inflight.append((j, s, load_pool.submit(loader, s)))
                     next_i += 1
                 try:
                     frames, texture = _lane_wait(
@@ -973,9 +1073,14 @@ def _build_scanner(sources, calib, cfg: Config):
     )
 
     first = imio.list_frame_files(sources[0])
-    probe = imio.load_gray(first[0])
+    hdr = imio.probe_packed(first[0])
+    if hdr is not None:      # packed container: geometry from the header
+        cam_size = (int(hdr["width"]), int(hdr["height"]))
+    else:
+        probe = imio.load_gray(first[0])
+        cam_size = (probe.shape[1], probe.shape[0])
     return SLScanner(
-        calib, (probe.shape[1], probe.shape[0]),
+        calib, cam_size,
         proj_size=(cfg.decode.n_cols, cfg.decode.n_rows),
         row_mode=cfg.triangulate.row_mode,
         epipolar_tol=cfg.triangulate.epipolar_tol,

@@ -14,6 +14,9 @@ supplies the two halves of making that chain resilient:
 
    ====================  ====================================================
    ``frame.load``        per-view frame-stack load (both batch executors)
+   ``frame.pack``        bit-plane pack/unpack codec step: the packed
+                         ingest loader (pipeline/stages.py) and the
+                         pack-on-capture step (acquire/sequencer.py)
    ``compute.view``      per-view decode+triangulate dispatch
    ``ply.write``         every PLY/STL artifact write (io/ply.py, io/stl.py)
    ``cache.get``         stage-cache lookup (pipeline/stagecache.py)

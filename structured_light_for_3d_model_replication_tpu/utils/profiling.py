@@ -231,6 +231,9 @@ class OverlapStats:
         self._h2d_bytes = 0
         self._d2h_bytes = 0
         self._frame_bytes = 0
+        # what the frame uploads would have cost unpacked (raw u8 stacks);
+        # equals _frame_bytes when the ingest is raw, ~8x it when packed
+        self._frame_raw_bytes = 0
         # per-kernel launch accounting: name -> [launches, wall_s, bytes]
         self._kernels: dict[str, list] = {}
         self.critical_path_s = 0.0
@@ -322,20 +325,31 @@ class OverlapStats:
                        dispatch_s=round(dispatch_s, 6))
 
     def add_transfer(self, h2d: int = 0, d2h: int = 0,
-                     frames: int = 0) -> None:
+                     frames: int = 0, frames_raw: int = 0) -> None:
         """Accumulate device<->host transfer bytes. ``frames`` counts the
         stripe-frame upload separately (it also adds into ``h2d``): every
         arm pays it, so the fused-vs-discrete byte ratio subtracts it and
-        compares only the cloud-path round-trips fusion removes."""
+        compares only the cloud-path round-trips fusion removes.
+        ``frames_raw`` is the unpacked size of the same stacks — when the
+        packed ingest lane is on, ``frames`` is the wire size (~1/8th) and
+        ``frames_raw`` what a raw upload would have cost; defaults to
+        ``frames`` so the raw lane needs no changes."""
         h, d, fr = int(h2d), int(d2h), int(frames)
+        fr_raw = int(frames_raw) or fr
         with self._lock:
             self._h2d_bytes += h + fr
             self._d2h_bytes += d
             self._frame_bytes += fr
+            self._frame_raw_bytes += fr_raw
         tr = telemetry.current()
         if tr is not None:
             tr.instant("transfer.bytes", h2d=h + fr or None, d2h=d or None,
-                       frames=fr or None)
+                       frames=fr or None,
+                       frames_raw=fr_raw if fr_raw != fr else None)
+            if fr and fr_raw > fr:
+                tr.instant("transfer.packed_ratio",
+                           ratio=round(fr_raw / fr, 3),
+                           wire=fr, raw=fr_raw)
 
     def add_kernel(self, name: str, wall_s: float, bucket=None,
                    bytes_moved: int = 0) -> None:
@@ -411,6 +425,10 @@ class OverlapStats:
         out["transfer_bytes_h2d"] = self._h2d_bytes
         out["transfer_bytes_d2h"] = self._d2h_bytes
         out["transfer_bytes_frames"] = self._frame_bytes
+        out["transfer_bytes_frames_raw"] = self._frame_raw_bytes
+        out["frame_bytes_ratio"] = (
+            round(self._frame_raw_bytes / self._frame_bytes, 2)
+            if self._frame_bytes else None)
         out["kernels"] = {
             name: {"launches": agg[0], "wall_s": round(agg[1], 4),
                    "bytes_moved": agg[2]}

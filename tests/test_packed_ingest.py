@@ -1,0 +1,281 @@
+"""ISSUE 11: capture-rate ingest — packed bit-plane frames + streaming
+on-device decode (pipeline.packed_ingest).
+
+The packed-ingest contract (io/images.py + ops/graycode.py +
+pipeline/stages.py):
+  - a Gray-code capture thresholds to 1 bit/pixel at pack time (the
+    stored bit IS the decoder's pat>inv comparison), so decode from
+    packed planes is bit-identical to ``decode_stack_np`` on the raw
+    stack — full stacks, ragged set counts, and truncated captures alike
+  - the ``frames.slbp`` container is byte-deterministic and transparent:
+    ``load_stack`` on a packed folder returns a decodable (binarized)
+    stack, so every raw-lane consumer keeps working unchanged
+  - the batched executor's packed lane uploads the ~8x-smaller planes
+    and produces PLYs byte-identical to the raw lane — single-device and
+    under the conftest 8-virtual-device mesh, full batches and ragged
+    tails alike — while ``OverlapStats`` counts frame h2d at actual wire
+    size (>=6x fewer frame bytes at this geometry)
+  - a ``frame.pack`` fault retries on the per-view budget; a permanent
+    hit quarantines ONLY the victim and its batchmates ship bytes
+    identical to a clean run
+"""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.cli import main as cli_main
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io import images as imio
+from structured_light_for_3d_model_replication_tpu.ops import graycode as gc
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
+VIEWS = 5
+PROJ = (64, 32)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("packedds"))
+    rc = cli_main(["synth", root, "--views", str(VIEWS),
+                   "--cam", "96x72", "--proj", f"{PROJ[0]}x{PROJ[1]}"])
+    assert rc == 0
+    return root
+
+
+@pytest.fixture(scope="module")
+def packed_dataset(dataset, tmp_path_factory):
+    """The same views as .slbp containers (the pack-on-capture product)."""
+    root = str(tmp_path_factory.mktemp("packedds_slbp"))
+    shutil.copytree(dataset, root, dirs_exist_ok=True)
+    for d in sorted(os.listdir(root)):
+        p = os.path.join(root, d)
+        if os.path.isdir(p):
+            imio.pack_scan_folder(p, keep_raw=False)
+    return root
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.reset()
+
+
+def _view_dirs(root):
+    return sorted(d for d in os.listdir(root)
+                  if os.path.isdir(os.path.join(root, d)))
+
+
+def _synth_stack(n_pairs=11, h=48, w=96, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256,
+                        size=(2 + 2 * n_pairs, h, w)).astype(np.uint8)
+
+
+def _assert_decode_equal(a, b):
+    for f in a._fields:
+        np.testing.assert_array_equal(getattr(a, f), getattr(b, f), err_msg=f)
+
+
+def _assert_identical_dirs(a, b, n=VIEWS):
+    names_a, names_b = sorted(os.listdir(a)), sorted(os.listdir(b))
+    assert names_a == names_b and len(names_a) == n
+    for f in names_a:
+        assert (a / f).read_bytes() == (b / f).read_bytes(), \
+            f"{f}: packed-ingest PLY differs from raw"
+
+
+# ---------------------------------------------------------------------------
+# codec: pack/unpack + container
+# ---------------------------------------------------------------------------
+
+def test_packed_decode_bit_exact_full_and_ragged():
+    """The stored bits ARE decode's comparisons: decode from packed planes
+    (and from the binarized unpack) matches decode_stack_np bit-for-bit —
+    full stacks, ragged set counts, and truncated captures."""
+    kw = dict(n_cols=PROJ[0], n_rows=PROJ[1], n_sets_col=6, n_sets_row=5,
+              thresh_mode="manual")
+    cases = [
+        (_synth_stack(11), kw),
+        (_synth_stack(11, seed=3), dict(kw, n_sets_col=4, n_sets_row=3)),
+        # truncated capture (legacy skip_remaining decode)
+        (_synth_stack(8, seed=5), dict(kw, skip_remaining_before_row=True)),
+    ]
+    for frames, k in cases:
+        ref = gc.decode_stack_np(frames, **k)
+        ps = imio.pack_stack(frames)
+        got = gc.decode_packed_np(ps.planes, ps.white, ps.black,
+                                  n_frames=ps.n_frames, **k)
+        _assert_decode_equal(got, ref)
+        unpacked, _tex = imio.unpack_stack(ps)
+        _assert_decode_equal(gc.decode_stack_np(unpacked, **k), ref)
+
+
+def test_packed_wire_size_at_least_6x_smaller():
+    frames = _synth_stack(11)
+    ps = imio.pack_stack(frames)
+    assert frames.nbytes / ps.nbytes >= 6.0
+    assert ps.planes.shape[0] == (ps.n_pairs + 7) // 8
+
+
+def test_container_roundtrip_deterministic_and_transparent(tmp_path):
+    frames = _synth_stack(9, seed=7)
+    ps = imio.pack_stack(frames)
+    d = tmp_path / "view"
+    path = imio.save_packed_stack(str(d), ps)
+    assert os.path.basename(path) == imio.PACKED_NAME
+    first = open(path, "rb").read()
+    imio.save_packed_stack(str(d), ps)       # re-save: byte-deterministic
+    assert open(path, "rb").read() == first
+    back = imio.load_packed_stack(str(d))
+    np.testing.assert_array_equal(back.planes, ps.planes)
+    np.testing.assert_array_equal(back.white, ps.white)
+    np.testing.assert_array_equal(back.black, ps.black)
+    assert back.n_frames == ps.n_frames
+    # header-only frame count + transparent raw-lane load
+    assert imio.count_frames(str(d)) == frames.shape[0]
+    loaded, _tex = imio.load_stack(str(d))
+    unpacked, _ = imio.unpack_stack(ps)
+    np.testing.assert_array_equal(loaded, unpacked)
+
+
+def test_pack_scan_folder_replaces_raw(dataset, tmp_path):
+    src = os.path.join(dataset, _view_dirs(dataset)[0])
+    work = tmp_path / "view"
+    shutil.copytree(src, work)
+    n_raw = imio.count_frames(str(work))
+    path = imio.pack_scan_folder(str(work), keep_raw=False)
+    assert sorted(os.listdir(work)) == [imio.PACKED_NAME]
+    assert imio.count_frames(str(work)) == n_raw
+    assert imio.probe_packed(path) is not None
+
+
+# ---------------------------------------------------------------------------
+# executor byte parity: packed ingest vs raw lane
+# ---------------------------------------------------------------------------
+
+def _cfg(compute_batch: int, packed: bool, shard: bool = True) -> Config:
+    cfg = Config()
+    cfg.parallel.backend = "jax"
+    cfg.parallel.io_workers = 4
+    cfg.parallel.compute_batch = compute_batch
+    cfg.parallel.shard_views = shard
+    cfg.decode.n_cols, cfg.decode.n_rows = PROJ
+    cfg.decode.thresh_mode = "manual"
+    cfg.pipeline.packed_ingest = packed
+    return cfg
+
+
+def _run(data, out_dir, cfg):
+    calib = os.path.join(data, "calib.mat")
+    return stages.reconstruct(calib, data, mode="batch",
+                              output=str(out_dir), cfg=cfg,
+                              log=lambda m: None)
+
+
+def test_packed_reconstruct_byte_identical_sharded(dataset, tmp_path):
+    """The acceptance A/B under the conftest 8-device mesh: a full batch
+    (4 views) plus a ragged tail (1 view), packed ingest vs raw —
+    byte-identical PLYs, with frame h2d counted at wire size (>=6x fewer
+    frame bytes than the raw-equivalent upload)."""
+    rep_r = _run(dataset, tmp_path / "raw", _cfg(4, packed=False))
+    rep_p = _run(dataset, tmp_path / "packed", _cfg(4, packed=True))
+    _assert_identical_dirs(tmp_path / "raw", tmp_path / "packed")
+    assert rep_r.failed == rep_p.failed == []
+    o = rep_p.overlap
+    assert o["transfer_bytes_frames_raw"] > o["transfer_bytes_frames"] > 0
+    assert o["frame_bytes_ratio"] >= 6.0
+    # the raw lane's accounting is unchanged: wire == raw, ratio 1
+    assert rep_r.overlap["frame_bytes_ratio"] == 1.0
+
+
+def test_packed_reconstruct_byte_identical_unsharded_ragged(dataset,
+                                                            tmp_path):
+    """shard_views=False (single-device programs, per-view device_put on
+    the prefetch threads): bucket-boundary batches (2 + 2) plus the
+    ragged 1-view tail, byte-identical."""
+    rep_r = _run(dataset, tmp_path / "raw",
+                 _cfg(2, packed=False, shard=False))
+    rep_p = _run(dataset, tmp_path / "packed",
+                 _cfg(2, packed=True, shard=False))
+    _assert_identical_dirs(tmp_path / "raw", tmp_path / "packed")
+    assert rep_r.overlap["launches"] == rep_p.overlap["launches"] == 3
+    assert rep_p.overlap["frame_bytes_ratio"] >= 6.0
+
+
+def test_packed_ingest_from_slbp_dataset(dataset, packed_dataset, tmp_path):
+    """Views landed as frames.slbp (the pack-on-capture product): the
+    packed lane uploads the container's planes as-is, AND the raw lane
+    transparently unpacks — both byte-identical to the raw-dataset run."""
+    rep_ref = _run(dataset, tmp_path / "ref", _cfg(4, packed=False))
+    rep_p = _run(packed_dataset, tmp_path / "packed", _cfg(4, packed=True))
+    rep_r = _run(packed_dataset, tmp_path / "rawlane", _cfg(4, packed=False))
+    assert rep_ref.failed == rep_p.failed == rep_r.failed == []
+    _assert_identical_dirs(tmp_path / "ref", tmp_path / "packed")
+    _assert_identical_dirs(tmp_path / "ref", tmp_path / "rawlane")
+
+
+@pytest.mark.slow
+def test_packed_pipeline_merged_and_stl_identical(dataset, packed_dataset,
+                                                  tmp_path):
+    """Full scan-to-print over packed ingest (discrete AND fused drains):
+    merged PLY + STL byte-identical to the raw run. (Tier-1 excludes
+    slow; the PACKED_SMOKE CI arm asserts the same contract every run.)"""
+    def pipe(data, out, packed, fused=False):
+        cfg = _cfg(3, packed=packed)
+        cfg.pipeline.fused_clean = fused
+        cfg.merge.voxel_size = 4.0
+        cfg.merge.ransac_trials = 128
+        cfg.merge.icp_iters = 4
+        cfg.mesh.depth = 3
+        cfg.mesh.density_trim_quantile = 0.0
+        calib = os.path.join(data, "calib.mat")
+        return stages.run_pipeline(calib, data, str(out), cfg=cfg,
+                                   steps=("statistical",),
+                                   log=lambda m: None)
+
+    rep_raw = pipe(dataset, tmp_path / "raw", packed=False)
+    rep_p = pipe(packed_dataset, tmp_path / "packed", packed=True)
+    rep_pf = pipe(packed_dataset, tmp_path / "packed_fused", packed=True,
+                  fused=True)
+    for rep in (rep_p, rep_pf):
+        assert rep.failed == []
+        assert open(rep.merged_ply, "rb").read() == \
+            open(rep_raw.merged_ply, "rb").read()
+        assert open(rep.stl_path, "rb").read() == \
+            open(rep_raw.stl_path, "rb").read()
+
+
+# ---------------------------------------------------------------------------
+# fault containment at the frame.pack site
+# ---------------------------------------------------------------------------
+
+def test_frame_pack_transient_retries_all_views_survive(dataset, tmp_path):
+    victim = _view_dirs(dataset)[2]
+    ref = _run(dataset, tmp_path / "ref", _cfg(4, packed=True))
+    assert ref.failed == []
+    faults.configure(f"frame.pack~{victim}:transient", seed=3)
+    rep = _run(dataset, tmp_path / "out", _cfg(4, packed=True))
+    assert rep.failed == []
+    assert rep.retries >= 1
+    _assert_identical_dirs(tmp_path / "ref", tmp_path / "out")
+
+
+def test_frame_pack_permanent_quarantines_only_victim(dataset, tmp_path):
+    """A permanently poisoned pack: the victim quarantines at the load
+    lane; its batchmates ship bytes identical to a clean packed run."""
+    victim = _view_dirs(dataset)[1]
+    ref = _run(dataset, tmp_path / "ref", _cfg(4, packed=True))
+    assert ref.failed == []
+    faults.configure(f"frame.pack~{victim}:permanent", seed=7)
+    rep = _run(dataset, tmp_path / "out", _cfg(4, packed=True))
+    assert len(rep.failed) == 1
+    assert victim in rep.failed[0][0]
+    names = sorted(os.listdir(tmp_path / "out"))
+    assert len(names) == VIEWS - 1
+    assert not any(victim in n for n in names)
+    for n in names:
+        assert (tmp_path / "out" / n).read_bytes() == \
+            (tmp_path / "ref" / n).read_bytes(), f"{n}: batchmate changed"

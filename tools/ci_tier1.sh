@@ -67,6 +67,24 @@ else
   [ $rc -eq 0 ] && rc=1
 fi
 
+# ---- packed-ingest smoke: the capture-rate ingest lane (packed bit-plane
+# frames + streaming on-device decode, pipeline.packed_ingest) must produce
+# merged PLY + STL byte-identical to the raw arm — discrete AND fused
+# drains — while uploading >=6x fewer frame bytes (ISSUE 11) ----
+packed_rc=0
+packed=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python bench.py --packed-only --views=2 --compute-batch=2 2>/dev/null) || packed_rc=$?
+echo "$packed" > tools/_ci/packed_smoke.json
+if [ $packed_rc -eq 0 ] \
+   && echo "$packed" | grep -q '"merged_identical": true' \
+   && echo "$packed" | grep -q '"stl_identical": true' \
+   && echo "$packed" | grep -q '"fused_identical": true' \
+   && echo "$packed" | grep -q '"frame_bytes_ratio_ok": true'; then
+  echo "PACKED_SMOKE=ok"
+else
+  echo "PACKED_SMOKE=FAIL (rc=$packed_rc; see tools/_ci/packed_smoke.json)"
+  [ $rc -eq 0 ] && rc=1
+fi
+
 # ---- streaming-merge smoke: the streamed register lane must produce
 # byte-identical merged PLY + STL vs the barrier arm (ISSUE 5) ----
 stream_rc=0

@@ -48,8 +48,9 @@ sys.path.insert(0, ROOT)
 # the full wired-site pool (utils/faults.py docstring); http.capture and
 # serial.rotate never fire in a pipeline run but stay listed so a drawn
 # rule exercises the no-op path too
-SITES = ["frame.load", "compute.view", "ply.write", "cache.get",
-         "cache.put", "register.pair", "http.capture", "serial.rotate"]
+SITES = ["frame.load", "frame.pack", "compute.view", "ply.write",
+         "cache.get", "cache.put", "register.pair", "http.capture",
+         "serial.rotate"]
 KINDS = ["transient", "permanent", "crash", "stall(0.8)", "slow(0.3)"]
 
 # host-scope kill matrix (ISSUE 9): every rule targets the per-item
@@ -135,6 +136,14 @@ def main() -> int:
         calib = os.path.join(root, "calib.mat")
         view_names = sorted(d for d in os.listdir(root)
                             if os.path.isdir(os.path.join(root, d)))
+        # pack the last two views to the bit-plane container so the
+        # frame.pack site fires (the unpack codec step runs inside the
+        # loader for packed sources on every backend)
+        from structured_light_for_3d_model_replication_tpu.io import (
+            images as imio,
+        )
+        for name in view_names[-2:]:
+            imio.pack_scan_folder(os.path.join(root, name), keep_raw=False)
 
         def cfg() -> Config:
             c = Config()
