@@ -1393,6 +1393,216 @@ def bench_multiproc(views: int = PIPE_VIEWS) -> dict:
     return out
 
 
+def bench_serve(tenants: int = 3, scans_per_tenant: int = 2,
+                views: int = 2, compute_batch: int = 4,
+                rate_hz: float = 5.0, seed: int = 0) -> dict:
+    """Multi-tenant serving A/B (ISSUE 12): the ``sl3d serve`` gateway
+    under ``tools/loadgen.py`` — N tenants, seeded Poisson arrivals,
+    distinct synthetic scans per tenant.
+
+    Arm ``single``: ``serving.max_active_scans=1`` — one tenant's scan in
+    the engine at a time, so every batched launch can only fill with that
+    scan's own views (fill <= views/scan). Arm ``cross``: all scans
+    admitted together, so grant sets interleave tenants and views from
+    DIFFERENT scans fill the same bucket launch. The contract number is
+    ``launch_fill_gain`` = cross-arm mean views/launch over the single-
+    arm's — strictly > 1 whenever tenants overlap (the acceptance bar).
+
+    Headline serving numbers come from the load generator exactly as an
+    operator would read them: scans/hour and p50/p99 request latency
+    (submit -> terminal, queue wait included). Byte parity: the first
+    scan of every tenant in the cross arm is compared byte-for-byte
+    against a solo ``run_pipeline`` of the same input (the PR-8
+    construction carried to serving).
+
+    REQUIRES jax (the batched lane needs a device scanner) — runs under
+    ``--serve-only`` (CPU-pinned unless the caller chose a platform) or
+    the ``_run_serve_child`` subprocess from ``--pipeline-only``. The
+    single arm runs FIRST, so the cross arm inherits its warm compile
+    cache — walls are regime records; the fill ratio is schedule
+    accounting and compile-neutral."""
+    import importlib.util
+    import shutil
+    import tempfile
+    import threading
+
+    from structured_light_for_3d_model_replication_tpu.config import Config
+    from structured_light_for_3d_model_replication_tpu.io import (
+        images as imio,
+        matfile,
+    )
+    from structured_light_for_3d_model_replication_tpu.pipeline import (
+        serving, stages,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        synthetic as syn,
+    )
+
+    spec = importlib.util.spec_from_file_location(
+        "sl3d_loadgen", os.path.join(ROOT, "tools", "loadgen.py"))
+    lg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lg)
+
+    out: dict = {"tenants": tenants, "scans_per_tenant": scans_per_tenant,
+                 "views_per_scan": views, "compute_batch": compute_batch,
+                 "rate_hz": rate_hz, "seed": seed,
+                 "host_cpus": os.cpu_count()}
+    import jax
+
+    out["device_count"] = jax.device_count()
+    out["backend"] = jax.devices()[0].platform
+    tmp = tempfile.mkdtemp(prefix="slbench_serve_")
+    try:
+        # ---- distinct synthetic scans: tenants x scans_per_tenant. A
+        # per-scan satellite offset makes EVERY view's bytes distinct
+        # (at 0 deg the turntable transform is the identity, so a pivot
+        # shift alone leaves view 0 byte-identical across scans — and
+        # identical bytes dedup to one shared cache entry, silently
+        # shrinking the engine work both arms are supposed to measure)
+        rig = syn.default_rig(cam_size=PIPE_CAM, proj_size=PIPE_PROJ)
+        scene = syn.sphere_on_background()
+        obj, background = scene.objects
+        calib_path = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(calib_path, rig.calibration())
+        manifest: dict = {"tenants": {}}
+        step = 360.0 / views
+        pivot = np.array([0.0, 0.0, 420.0])
+        for ti in range(tenants):
+            name = f"t{ti:02d}"
+            entries = []
+            for si in range(scans_per_tenant):
+                tgt = os.path.join(tmp, f"in_{name}_{si}")
+                shift = 9.0 * (ti * scans_per_tenant + si)
+                satellite = syn.Sphere(
+                    np.array([48.0 + shift, -92.0, 430.0]), 16.0)
+                for vi, (R, t) in enumerate(
+                        syn.turntable_poses(views, step, pivot)):
+                    frames, _ = syn.render_scene(
+                        rig, syn.Scene([obj.transformed(R, t),
+                                        satellite.transformed(R, t),
+                                        background]))
+                    imio.save_stack(
+                        os.path.join(
+                            tgt,
+                            f"scan_{int(round(vi * step)):03d}deg_scan"),
+                        frames)
+                entries.append({"target": tgt, "calib": calib_path})
+            manifest["tenants"][name] = entries
+
+        def mkcfg(max_active: int) -> Config:
+            c = Config()
+            c.decode.n_cols, c.decode.n_rows = PIPE_PROJ
+            c.decode.thresh_mode = "manual"
+            c.merge.voxel_size = 4.0
+            c.merge.ransac_trials = 512
+            c.merge.icp_iters = 10
+            c.mesh.depth = 5
+            c.mesh.density_trim_quantile = 0.0
+            c.parallel.compute_batch = compute_batch
+            c.serving.clean_steps = "statistical"
+            c.serving.host = "127.0.0.1"
+            c.serving.port = 0
+            c.serving.max_active_scans = max_active
+            return c
+
+        def run_arm(tag: str, max_active: int) -> tuple[dict, dict]:
+            root = os.path.join(tmp, f"svc_{tag}")
+            httpd, svc = serving.start_gateway(
+                root, cfg=mkcfg(max_active), log=lambda m: None)
+            th = threading.Thread(target=httpd.serve_forever,
+                                  kwargs={"poll_interval": 0.1},
+                                  daemon=True)
+            th.start()
+            base = (f"http://{httpd.server_address[0]}:"
+                    f"{httpd.server_address[1]}")
+            try:
+                res = lg.run_load(base, manifest, scans_per_tenant,
+                                  rate_hz, seed=seed, log=log)
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+                svc.close()
+            outs = {r["scan_id"]: svc.adm.jobs[r["scan_id"]]
+                    for r in res.get("results", []) if "scan_id" in r}
+            keep = {k: v for k, v in res.items() if k != "results"}
+            keep["all_completed"] = all(
+                r["state"] in ("done", "degraded")
+                for r in res.get("results", []))
+            return keep, outs
+
+        out["single"], _ = run_arm("single", max_active=1)
+        out["cross"], jobs = run_arm("cross", max_active=tenants)
+
+        fill_c = out["cross"].get("mean_views_per_launch")
+        fill_s = out["single"].get("mean_views_per_launch")
+        out["launch_fill_gain"] = (round(fill_c / fill_s, 3)
+                                   if fill_c and fill_s else None)
+        out["cross_fill_above_single"] = bool(
+            fill_c and fill_s and fill_c > fill_s)
+
+        # ---- byte parity: first scan of every tenant vs solo ----
+        parity_ply = parity_stl = True
+        solo_cfg = mkcfg(1)
+        checked = 0
+        for name, entries in manifest["tenants"].items():
+            tgt = entries[0]["target"]
+            job = next((j for j in jobs.values()
+                        if j.target == os.path.abspath(tgt)), None)
+            if job is None or job.state not in ("done", "degraded"):
+                parity_ply = parity_stl = False
+                continue
+            solo_out = os.path.join(tmp, f"solo_{name}")
+            rep = stages.run_pipeline(calib_path, tgt, solo_out,
+                                      cfg=mkcfg(1),
+                                      steps=("statistical",),
+                                      log=lambda m: None)
+            assert not rep.failed, rep.failed
+            for fname, flag in (("merged.ply", "ply"),
+                                ("model.stl", "stl")):
+                with open(os.path.join(solo_out, fname), "rb") as fa, \
+                        open(os.path.join(job.out_dir, fname), "rb") as fb:
+                    same = fa.read() == fb.read()
+                if flag == "ply":
+                    parity_ply = parity_ply and same
+                else:
+                    parity_stl = parity_stl and same
+            checked += 1
+        out["parity_ply"] = parity_ply
+        out["parity_stl"] = parity_stl
+        out["parity_scans_checked"] = checked
+        _ = solo_cfg
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _run_serve_child(tenants: int = 3, scans_per_tenant: int = 1,
+                     views: int = 2, compute_batch: int = 4,
+                     timeout: int = 900) -> dict:
+    """Run ``bench_serve`` in a JAX_PLATFORMS=cpu subprocess — same
+    containment as ``_run_batched_child``: the numpy parent never
+    initializes a backend; the serving gateway + engine live and die in
+    the child."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--serve-only",
+             f"--tenants={tenants}", f"--scans={scans_per_tenant}",
+             f"--views={views}", f"--compute-batch={compute_batch}",
+             "--no-record"],
+            capture_output=True, text=True, timeout=timeout, env=env)
+        for line in reversed(p.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        return {"error": f"no JSON line (rc={p.returncode}, "
+                         f"stderr: {p.stderr.strip()[-200:]})"}
+    except subprocess.TimeoutExpired:
+        return {"error": f"serve child timed out after {timeout}s"}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 # ---------------------------------------------------------------------------
 # child: all jax work, per-phase persisted results
 # ---------------------------------------------------------------------------
@@ -2167,6 +2377,11 @@ if __name__ == "__main__":
             line["pipeline_trace"] = bench_pipeline_trace()
             line["pipeline_deadline"] = bench_pipeline_deadline()
             line["multiproc"] = bench_multiproc()
+            # multi-tenant serving A/B: same containment (jax stays in a
+            # cpu-pinned child); launch-fill gain + byte parity certified
+            # there — both are schedule/bit contracts, so one scan per
+            # tenant suffices; the big regime comes from --serve-only
+            line["serve"] = _run_serve_child(tenants=3, scans_per_tenant=1)
             fused = line["pipeline_e2e"].get("fused_s")
             disabled = line["pipeline_faults"].get("disabled_s")
             if fused and disabled:
@@ -2276,6 +2491,52 @@ if __name__ == "__main__":
             line["value"] = line.get("batched_s")
         except Exception as e:
             line["error"] = f"{type(e).__name__}: {e}"[:200]
+        emit(line)
+        sys.exit(0)
+    if "--serve-only" in sys.argv[1:]:
+        # standalone record of the multi-tenant serving A/B: one JSON line
+        # on stdout, plus BENCH_SERVE_r01.json in the repo root (skipped
+        # with --no-record, which the --pipeline-only child passes). This
+        # arm REQUIRES jax (the cross-tenant fill contract lives in the
+        # batched engine lane); pins itself to CPU unless the caller
+        # already chose a platform.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        tenants, scans, views, compute_batch = 3, 2, 2, 4
+        rate_hz, seed = 5.0, 0
+        for a in sys.argv[1:]:
+            if a.startswith("--tenants="):
+                tenants = int(a.split("=")[1])
+            elif a.startswith("--scans="):
+                scans = int(a.split("=")[1])
+            elif a.startswith("--views="):
+                views = int(a.split("=")[1])
+            elif a.startswith("--compute-batch="):
+                compute_batch = int(a.split("=")[1])
+            elif a.startswith("--rate="):
+                rate_hz = float(a.split("=")[1])
+            elif a.startswith("--seed="):
+                seed = int(a.split("=")[1])
+        line = {"metric": "serve_mean_views_per_launch", "unit": "views",
+                "value": None, "error": None}
+        try:
+            line.update(bench_serve(tenants=tenants,
+                                    scans_per_tenant=scans, views=views,
+                                    compute_batch=compute_batch,
+                                    rate_hz=rate_hz, seed=seed))
+            line["value"] = line.get("cross", {}).get(
+                "mean_views_per_launch")
+        except Exception as e:
+            line["error"] = f"{type(e).__name__}: {e}"[:200]
+        if "--no-record" not in sys.argv[1:]:
+            from structured_light_for_3d_model_replication_tpu.utils import (
+                telemetry as _tel,
+            )
+
+            line.setdefault("run_id", _tel.new_run_id())
+            with open(os.path.join(ROOT, "BENCH_SERVE_r01.json"),
+                      "w") as f:
+                json.dump(line, f, indent=2, sort_keys=True)
+                f.write("\n")
         emit(line)
         sys.exit(0)
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":

@@ -406,6 +406,54 @@ class FaultsConfig:
 
 
 @dataclass
+class ServingConfig:
+    """Persistent multi-tenant scan service (pipeline/serving.py, CLI
+    ``sl3d serve``). Many tenants' scans multiplex onto ONE shared device
+    mesh: a stdlib-HTTP gateway admits submissions through a multi-scan
+    generalization of the coordinator's lease/ledger protocol, an engine
+    thread fills the batched ``forward_views`` bucket ladder with views
+    drawn from DIFFERENT scans (cross-tenant batching), and each request
+    is then assembled by the proven single-process pipeline reading the
+    warmed content-addressed cache — so every response is byte-identical
+    to a solo ``sl3d pipeline`` run of the same input."""
+
+    # gateway bind address; loopback by default — the service speaks
+    # plaintext HTTP and has no auth layer of its own
+    host: str = "127.0.0.1"
+    # 0 = ephemeral (the chosen port is logged and written to status)
+    port: int = 8089
+    # scans admitted to the engine simultaneously (the cross-tenant
+    # batching pool); queued scans wait in weighted-fair order
+    max_active_scans: int = 4
+    # per-tenant caps: active scans in flight / scans waiting in queue.
+    # A submit beyond the queue quota is rejected at the door (HTTP 429)
+    tenant_active_quota: int = 2
+    tenant_queue_quota: int = 8
+    # total queue depth across all tenants (backpressure; 429 when full)
+    queue_depth: int = 64
+    # engine item-lease lifetime (sec); an engine lane that stops
+    # heartbeating has its granted views stolen back to pending
+    lease_s: float = 30.0
+    # default per-request SLO budget (sec) when a submission does not
+    # carry its own ``budget_s``; 0 = no deadline.  Breach aborts THAT
+    # request with its own failures.json; the service keeps running
+    default_budget_s: float = 0.0
+    # default tenant weight for weighted-fair admission + grant
+    # interleaving (a tenant at weight 2 drains twice as fast as one
+    # at weight 1); per-submit override via the ``weight`` field
+    default_weight: float = 1.0
+    # engine lanes pulling view grants (each lane assembles one batched
+    # launch at a time); 1 is correct and keeps device contention simple
+    engine_lanes: int = 1
+    # per-view clean-chain steps (comma list, the `sl3d pipeline --steps`
+    # vocabulary). Service-global because steps are view-cache key
+    # material: one value keeps every tenant's entries dedupable
+    clean_steps: str = "background,cluster,radius,statistical"
+    # gateway idle poll cadence for the admit/sweep loop (sec)
+    poll_s: float = 0.05
+
+
+@dataclass
 class Config:
     """Root configuration for the whole framework."""
 
@@ -424,6 +472,7 @@ class Config:
     deadlines: DeadlinesConfig = field(default_factory=DeadlinesConfig)
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     scan_root: str = ""  # dated scan folder; empty = ./scans/<date>
 
     def to_dict(self) -> dict[str, Any]:

@@ -1,0 +1,343 @@
+"""Multi-scan admission control: the PR-8 work ledger across tenants.
+
+``parallel/coordinator.py`` coordinates ONE scan's items across worker
+processes. The serving gateway needs the same machinery one level up —
+many tenants' scans multiplexing onto one device mesh — so this module
+generalizes the work ledger instead of reinventing it:
+
+  - items gain a tenant/scan scope: ids are ``<scan_id>/view:<i>`` (the
+    coordinator's ``view:<i>`` namespaced by scan), so one ledger and one
+    lease table cover every in-flight request at once;
+  - grants go through the SAME ``LeaseTable`` (grant / renew / steal /
+    generation bump) — the grantee is an in-process engine lane instead
+    of a worker process, and a lane that wedges past ``lease_s`` has its
+    items swept back to pending exactly like a dead worker;
+  - every submit / admit / grant / complete / failed / abort is journaled
+    to the coordinator's fsync'd ``Ledger`` (schema ``sl3d-ledger-v1``)
+    with ``tenant=``/``scan=`` fields, and ``Ledger.replay`` folds it
+    back unchanged (completed ids already embed their scan).
+
+What is NEW at this level is policy: per-tenant quotas (queued + active
+caps → a submit over quota is REJECTED at the door, never silently
+queued) and weighted-fair scheduling. Fairness is stride-style: every
+tenant accumulates ``served / weight`` virtual time, and both scan
+admission and item grants pick the eligible tenant with the lowest
+virtual time — a weight-3 tenant gets 3x the grant rate of a weight-1
+tenant under contention and exactly its demand otherwise.
+
+No HTTP, no device code, no stages import — policy stays unit-testable
+with fake items, the way ``lease.py`` keeps expiry testable with a fake
+clock.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from structured_light_for_3d_model_replication_tpu.parallel.coordinator import (
+    Ledger,
+)
+from structured_light_for_3d_model_replication_tpu.parallel.lease import (
+    LeaseTable,
+)
+
+__all__ = ["ScanJob", "AdmissionController"]
+
+# scan lifecycle (the request's /status surface):
+#   queued -> admitted -> warmed -> assembling -> done|degraded|failed|aborted
+_TERMINAL = ("done", "degraded", "failed", "aborted", "rejected")
+
+
+class ScanJob:
+    """One tenant's scan request, from submit to terminal state."""
+
+    def __init__(self, scan_id: str, tenant: str, target: str,
+                 calib: str, out_dir: str, weight: float = 1.0,
+                 budget_s: float = 0.0, meta: dict | None = None):
+        self.scan_id = scan_id
+        self.tenant = tenant
+        self.target = target
+        self.calib = calib
+        self.out_dir = out_dir
+        self.weight = max(0.1, float(weight))
+        self.budget_s = float(budget_s)      # 0 = no per-request SLO
+        self.meta = dict(meta or {})
+        self.state = "queued"
+        self.error = ""
+        self.submitted_mono = time.monotonic()
+        self.submitted_unix = time.time()
+        self.finished_mono: float | None = None
+        self.report: dict = {}               # assembly summary for /status
+
+    def elapsed_s(self) -> float:
+        end = self.finished_mono or time.monotonic()
+        return end - self.submitted_mono
+
+    def budget_remaining(self) -> float | None:
+        """Remaining per-request SLO budget, None when no budget armed.
+        The clock starts at SUBMIT — queue wait burns budget too, which is
+        what makes it a request SLO rather than a compute budget."""
+        if self.budget_s <= 0:
+            return None
+        return self.budget_s - self.elapsed_s()
+
+    def as_dict(self) -> dict:
+        d = {"scan_id": self.scan_id, "tenant": self.tenant,
+             "state": self.state, "elapsed_s": round(self.elapsed_s(), 3),
+             "weight": self.weight, "budget_s": self.budget_s,
+             "submitted_unix": self.submitted_unix}
+        if self.error:
+            d["error"] = self.error
+        if self.report:
+            d["report"] = self.report
+        return d
+
+
+class _Item:
+    __slots__ = ("id", "scan_id", "tenant", "spec", "state")
+
+    def __init__(self, id: str, scan_id: str, tenant: str, spec: dict):
+        self.id = id
+        self.scan_id = scan_id
+        self.tenant = tenant
+        self.spec = spec
+        self.state = "pending"      # pending -> granted -> done|failed
+
+
+class AdmissionController:
+    """Quotas + weighted-fair scheduling over the multi-scan ledger."""
+
+    def __init__(self, ledger_path: str, run_id: str, lease_s: float = 30.0,
+                 max_active_scans: int = 4, tenant_active_quota: int = 2,
+                 tenant_queue_quota: int = 8, queue_depth: int = 64,
+                 log=print):
+        self.lock = threading.RLock()
+        self.log = log
+        self.max_active_scans = int(max_active_scans)
+        self.tenant_active_quota = int(tenant_active_quota)
+        self.tenant_queue_quota = int(tenant_queue_quota)
+        self.queue_depth = int(queue_depth)
+        self.leases = LeaseTable(lease_s)
+        self.ledger = Ledger(ledger_path, run_id, meta={"mode": "serving"})
+        self.jobs: dict[str, ScanJob] = {}       # scan_id -> job
+        self.queue: list[str] = []               # queued scan_ids, FIFO/tenant
+        self.items: dict[str, _Item] = {}        # item id -> item
+        self._scan_items: dict[str, list[str]] = {}
+        self._vtime: dict[str, float] = {}       # tenant -> virtual time
+        self._seq = itertools.count(1)
+
+    # ---- submit / quotas -------------------------------------------------
+
+    def submit(self, job: ScanJob) -> tuple[bool, str]:
+        """Admit-or-reject at the door. Over-quota submissions are refused
+        with a reason (the gateway's 429), never silently queued — a
+        rejected request costs the service nothing."""
+        with self.lock:
+            queued = [j for j in self.jobs.values() if j.state == "queued"]
+            if len(queued) >= self.queue_depth:
+                return False, (f"service queue full "
+                               f"({self.queue_depth} queued)")
+            t_queued = sum(1 for j in queued if j.tenant == job.tenant)
+            if t_queued >= self.tenant_queue_quota:
+                return False, (f"tenant {job.tenant!r} queue quota reached "
+                               f"({self.tenant_queue_quota})")
+            self.jobs[job.scan_id] = job
+            self.queue.append(job.scan_id)
+            self._vtime.setdefault(job.tenant, self._min_vtime())
+            self.ledger.event("submit", scan=job.scan_id, tenant=job.tenant,
+                              target=job.target, weight=job.weight,
+                              budget_s=job.budget_s)
+        return True, "queued"
+
+    def _min_vtime(self) -> float:
+        """New tenants join at the floor of current virtual time so they
+        can't bank unfair credit from before they existed."""
+        return min(self._vtime.values(), default=0.0)
+
+    def _active(self) -> list[ScanJob]:
+        return [j for j in self.jobs.values()
+                if j.state in ("admitted", "warmed", "assembling")]
+
+    # ---- weighted-fair admission ----------------------------------------
+
+    def admit_next(self) -> list[ScanJob]:
+        """Move queued scans into the admitted set while capacity allows,
+        picking the lowest-virtual-time tenant each round. Returns the
+        newly admitted jobs (the engine plans their items)."""
+        out: list[ScanJob] = []
+        with self.lock:
+            while True:
+                active = self._active()
+                if len(active) >= self.max_active_scans:
+                    break
+                per_tenant: dict[str, int] = {}
+                for j in active:
+                    per_tenant[j.tenant] = per_tenant.get(j.tenant, 0) + 1
+                eligible: dict[str, str] = {}    # tenant -> first scan_id
+                for sid in self.queue:
+                    j = self.jobs[sid]
+                    if j.tenant in eligible:
+                        continue
+                    if (per_tenant.get(j.tenant, 0)
+                            >= self.tenant_active_quota):
+                        continue
+                    eligible[j.tenant] = sid
+                if not eligible:
+                    break
+                tenant = min(eligible,
+                             key=lambda t: (self._vtime.get(t, 0.0), t))
+                sid = eligible[tenant]
+                self.queue.remove(sid)
+                job = self.jobs[sid]
+                job.state = "admitted"
+                self.ledger.event("admit", scan=sid, tenant=tenant,
+                                  wait_s=round(job.elapsed_s(), 3))
+                out.append(job)
+        return out
+
+    # ---- items -----------------------------------------------------------
+
+    def add_items(self, scan_id: str, specs: list[dict]) -> list[str]:
+        """Register a newly admitted scan's work items (one per cache-miss
+        view). Ids are ``<scan_id>/view:<i>`` — the coordinator's item ids
+        namespaced by scan, so one ledger covers every tenant."""
+        job = self.jobs[scan_id]
+        ids = []
+        with self.lock:
+            for spec in specs:
+                iid = f"{scan_id}/view:{spec['index']}"
+                self.items[iid] = _Item(iid, scan_id, job.tenant, spec)
+                ids.append(iid)
+            self._scan_items[scan_id] = list(ids)
+            self.ledger.event("plan", scan=scan_id, tenant=job.tenant,
+                              items=len(ids))
+        return ids
+
+    def next_views(self, lane: str, max_n: int) -> list[tuple[str, int, dict]]:
+        """Grant up to ``max_n`` pending view items to an engine lane,
+        interleaved weighted-fair across tenants — THE cross-tenant
+        batching hook: one bucket launch is assembled from exactly one of
+        these grant sets, so views from different scans fill the same
+        launch whenever more than one tenant has pending work. Returns
+        [(item_id, lease_gen, spec), ...]; charges each grant to its
+        tenant's virtual time."""
+        grants: list[tuple[str, int, dict]] = []
+        with self.lock:
+            self.leases.renew(lane)
+            pending: dict[str, list[_Item]] = {}
+            for iid in sorted(self.items):
+                it = self.items[iid]
+                if it.state == "pending":
+                    pending.setdefault(it.tenant, []).append(it)
+            while len(grants) < max_n and pending:
+                tenant = min(pending,
+                             key=lambda t: (self._vtime.get(t, 0.0), t))
+                it = pending[tenant].pop(0)
+                if not pending[tenant]:
+                    del pending[tenant]
+                lease = self.leases.grant(it.id, lane)
+                it.state = "granted"
+                w = self.jobs[it.scan_id].weight
+                self._vtime[tenant] = self._vtime.get(tenant, 0.0) + 1.0 / w
+                self.ledger.event("grant", item=it.id, scan=it.scan_id,
+                                  tenant=tenant, worker=lane,
+                                  gen=lease.gen)
+                grants.append((it.id, lease.gen, it.spec))
+        return grants
+
+    def beat(self, lane: str) -> int:
+        return self.leases.renew(lane)
+
+    def complete(self, item_id: str, lane: str, gen: int) -> bool:
+        with self.lock:
+            it = self.items.get(item_id)
+            accepted = self.leases.complete(item_id, lane, gen)
+            if accepted and it is not None:
+                it.state = "done"
+                self.ledger.event("complete", item=item_id,
+                                  scan=it.scan_id, tenant=it.tenant,
+                                  worker=lane, gen=gen)
+            elif it is not None:
+                self.ledger.event("late-complete", item=item_id,
+                                  scan=it.scan_id, worker=lane, gen=gen)
+            return accepted
+
+    def failed(self, item_id: str, lane: str, gen: int,
+               error: str = "") -> None:
+        """A failed item settles as failed and is NOT retried here — the
+        assembly pass recomputes it through the full per-view
+        retry/quarantine lane, so failure policy lives in exactly one
+        place (the PR-8 construction)."""
+        with self.lock:
+            it = self.items.get(item_id)
+            self.leases.complete(item_id, lane, gen)
+            if it is not None and it.state != "done":
+                it.state = "failed"
+                self.ledger.event("failed", item=item_id, scan=it.scan_id,
+                                  tenant=it.tenant, worker=lane,
+                                  error=str(error)[:500])
+
+    def sweep_expired(self) -> int:
+        """Steal expired lane leases back to pending (a wedged engine lane
+        is the in-process twin of a dead worker)."""
+        n = 0
+        for lease in self.leases.expired():
+            with self.lock:
+                it = self.items.get(lease.item)
+                if it is None or it.state != "granted":
+                    continue
+                gen = self.leases.steal(lease.item)
+                it.state = "pending"
+                self.ledger.event("steal", item=lease.item,
+                                  worker=lease.worker, gen=gen,
+                                  reason="lease-expired")
+                n += 1
+        return n
+
+    def scan_settled(self, scan_id: str) -> bool:
+        """True when every item of ``scan_id`` is done or failed — the
+        scan is WARMED and ready for its assembly pass."""
+        with self.lock:
+            return all(self.items[iid].state in ("done", "failed")
+                       for iid in self._scan_items.get(scan_id, []))
+
+    def scan_item_states(self, scan_id: str) -> dict:
+        with self.lock:
+            out: dict[str, int] = {}
+            for iid in self._scan_items.get(scan_id, []):
+                s = self.items[iid].state
+                out[s] = out.get(s, 0) + 1
+            return out
+
+    # ---- terminal transitions -------------------------------------------
+
+    def finish(self, scan_id: str, state: str, error: str = "",
+               report: dict | None = None) -> None:
+        with self.lock:
+            job = self.jobs[scan_id]
+            job.state = state
+            job.error = error
+            job.finished_mono = time.monotonic()
+            if report:
+                job.report = report
+            for iid in self._scan_items.pop(scan_id, []):
+                self.items.pop(iid, None)
+            self.ledger.event("finish", scan=scan_id, tenant=job.tenant,
+                              state=state, error=str(error)[:500],
+                              elapsed_s=round(job.elapsed_s(), 3))
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            states: dict[str, int] = {}
+            for j in self.jobs.values():
+                states[j.state] = states.get(j.state, 0) + 1
+            return {"scans": {sid: j.as_dict()
+                              for sid, j in self.jobs.items()},
+                    "states": states,
+                    "queued": len(self.queue),
+                    "active": len(self._active()),
+                    "vtime": dict(self._vtime)}
+
+    def close(self) -> None:
+        self.ledger.close()

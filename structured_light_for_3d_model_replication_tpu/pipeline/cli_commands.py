@@ -12,7 +12,12 @@ implementation.
   calibrate     tab 8    analyze poses, prune by error, stereo solve -> calib
   inspect-calib (O11)    human-readable calibration summary
   patterns      (A4)     write the Gray-code pattern stack to disk
-  serve         (A2)     run the phone-capture HTTP server standalone
+  capture-serve (A2)     run the phone-capture HTTP server standalone
+  serve         (new)    persistent multi-tenant scan service: submissions
+                         from many tenants multiplex onto one device mesh
+                         with cross-tenant batching, weighted-fair
+                         admission, per-request SLOs, and Prometheus
+                         /metrics (pipeline/serving.py)
   viewer        (A22)    web viewer for per-stage clouds/meshes (the operator
                          front-end: merge previews, cleanup inspection)
   scan          tab 1    capture one structured-light sequence
@@ -322,13 +327,34 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("out_dir")
     add_config_args(p)
 
-    p = sub.add_parser("serve", help="run the phone-capture HTTP server")
+    p = sub.add_parser("capture-serve",
+                       help="run the phone-capture HTTP server")
     p.add_argument("--save-dir", default="captures",
                    help="where manual /upload images land")
     p.add_argument("--viewer", action="store_true",
                    help="also serve the artifact web viewer (next port up)")
     p.add_argument("--artifact-dir", default="artifacts",
                    help="directory the --viewer browses")
+    add_config_args(p)
+
+    p = sub.add_parser(
+        "serve",
+        help="persistent multi-tenant scan service: POST /submit scan "
+             "requests, cross-tenant batched warming on one device mesh, "
+             "per-request SLOs, per-tenant quotas, Prometheus /metrics; "
+             "every result byte-identical to a solo `sl3d pipeline` run")
+    p.add_argument("root", help="service state directory (scans/, shared "
+                                "stage cache, ledger.jsonl, serve.json)")
+    p.add_argument("--host", default=None,
+                   help="bind address (default: serving.host)")
+    p.add_argument("--port", type=int, default=None,
+                   help="bind port, 0 = ephemeral (default: serving.port)")
+    p.add_argument("--max-active-scans", type=int, default=None,
+                   help="scans admitted to the engine at once "
+                        "(default: serving.max_active_scans)")
+    p.add_argument("--ready-file", default=None,
+                   help="also write the bound-address JSON here once "
+                        "listening (CI/loadgen discovery handshake)")
     add_config_args(p)
 
     p = sub.add_parser("viewer",
@@ -763,6 +789,23 @@ def _cmd_patterns(args) -> int:
 
 @_runner("serve")
 def _cmd_serve(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.pipeline import (
+        serving,
+    )
+
+    cfg = _cfg(args)
+    if args.host is not None:
+        cfg.serving.host = args.host
+    if args.port is not None:
+        cfg.serving.port = args.port
+    if args.max_active_scans is not None:
+        cfg.serving.max_active_scans = args.max_active_scans
+    return serving.serve(args.root, cfg=cfg,
+                         ready_file=args.ready_file)
+
+
+@_runner("capture-serve")
+def _cmd_capture_serve(args) -> int:
     import time
 
     from structured_light_for_3d_model_replication_tpu.acquire.server import (

@@ -1,0 +1,714 @@
+"""``sl3d serve`` — the persistent multi-tenant scan service.
+
+The paper's L2 layer is a one-shot broker: a phone uploads frames, a CLI
+run turns them into a model. This module is its serving-shaped
+replacement — ONE long-lived process, many tenants, one shared device
+mesh — built by composing layers this repo already proved one at a time:
+
+  gateway   stdlib ``ThreadingHTTPServer`` speaking JSON, the same
+            no-deps transport discipline as the PR-8 coordinator's
+            newline-JSON wire protocol. ``/submit`` · ``/status/<id>`` ·
+            ``/result/<id>`` · ``/metrics`` · ``/healthz``.
+  admission ``parallel/admission.py``: per-tenant quotas (a submit over
+            quota is a 429 at the door) + weighted-fair scheduling over
+            the multi-scan generalization of the PR-8 lease/ledger —
+            every grant/steal/complete is journaled fsync'd.
+  engine    in-process lanes that warm the content-addressed stage
+            cache, drawing view grants interleaved across tenants so
+            views from DIFFERENT scans fill the same bucket-padded
+            ``forward_views_batched`` launch (cross-tenant batching —
+            the MRI-serving shape: keep the dense solve saturated with
+            whoever's work is ready). Numpy-backend deployments take
+            the per-view lane; either way the item program is exactly
+            the PR-8 worker's (load → compute → compact → clean → put).
+  assembly  one request at a time, the proven single-process
+            ``run_pipeline`` over the warmed cache with a per-tenant
+            cache namespace (``TenantCache``) — so every response is
+            **byte-identical to a solo ``sl3d pipeline`` run** of the
+            same input, by the PR-8 construction: engine lanes only
+            warm; assembly recomputes anything missing through the full
+            retry/quarantine lane.
+
+Failure domains are per REQUEST: a poisoned view quarantines inside its
+own scan's assembly (PR-3 semantics — that request completes DEGRADED
+with its own ``failures.json``); a per-request SLO (``budget_s``,
+clock starting at submit) aborts only that request via the PR-7 run
+budget; the service keeps running through all of it.
+
+Cache sharing is content-addressed and tenant-scoped at once: identical
+frame bytes + config from two tenants hash to ONE cached entry (dedup),
+while ``TenantCache`` ref-marker namespaces keep eviction and listing
+per-tenant — evicting tenant A never deletes a payload tenant B still
+references, and outputs never alias because every request owns its
+``out_dir``.
+"""
+from __future__ import annotations
+
+import copy
+import json
+import os
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.parallel.admission import (
+    AdmissionController,
+    ScanJob,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline.stagecache import (
+    TenantCache,
+)
+from structured_light_for_3d_model_replication_tpu.utils import (
+    deadline as dl,
+)
+from structured_light_for_3d_model_replication_tpu.utils import faults
+from structured_light_for_3d_model_replication_tpu.utils import (
+    telemetry as tel,
+)
+
+__all__ = ["ScanService", "serve", "start_gateway"]
+
+_ID_RE = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+def _safe_id(s: str, fallback: str) -> str:
+    s = _ID_RE.sub("-", str(s or "")).strip("-.")[:64]
+    return s or fallback
+
+
+class _ScanCtx:
+    """Everything the engine holds for one admitted scan: the shared plan
+    (``stages._view_plan`` — the SAME key derivation the assembly pass
+    will use), this tenant's cache namespace, and the scanner key that
+    lets different scans share one batched launch."""
+
+    __slots__ = ("job", "steps", "calib", "sources", "view_keys", "cache",
+                 "scanner_key")
+
+    def __init__(self, job, steps, calib, sources, view_keys, cache,
+                 scanner_key):
+        self.job = job
+        self.steps = steps
+        self.calib = calib
+        self.sources = sources
+        self.view_keys = view_keys
+        self.cache = cache
+        self.scanner_key = scanner_key
+
+
+class ScanService:
+    """The serving core: admission + engine + assembly over one shared
+    stage-cache store. HTTP lives in ``_Handler``/``serve`` so tests can
+    drive this object directly."""
+
+    def __init__(self, root: str, cfg: Config | None = None, log=print):
+        from structured_light_for_3d_model_replication_tpu.pipeline import (
+            stages,
+        )
+
+        self.cfg = cfg or Config()
+        self.log = log
+        self.root = os.path.abspath(root)
+        self.scans_dir = os.path.join(self.root, "scans")
+        self.store_root = os.path.join(self.root, "cache")
+        self.ns_root = os.path.join(self.root, "cache-ns")
+        os.makedirs(self.scans_dir, exist_ok=True)
+        os.makedirs(self.store_root, exist_ok=True)
+        self.run_id = tel.new_run_id()
+        self.registry = tel.MetricsRegistry()
+        scfg = self.cfg.serving
+        self.adm = AdmissionController(
+            os.path.join(self.root, "ledger.jsonl"), self.run_id,
+            lease_s=scfg.lease_s, max_active_scans=scfg.max_active_scans,
+            tenant_active_quota=scfg.tenant_active_quota,
+            tenant_queue_quota=scfg.tenant_queue_quota,
+            queue_depth=scfg.queue_depth, log=log)
+        self._stages = stages
+        self._policy = stages._retry_policy(self.cfg)
+        self._fwd_kw = dict(thresh_mode=self.cfg.decode.thresh_mode,
+                            shadow_val=self.cfg.decode.shadow_val,
+                            contrast_val=self.cfg.decode.contrast_val)
+        self._scans: dict[str, _ScanCtx] = {}
+        self._scanners: dict[tuple, object] = {}   # scanner_key -> scanner
+        self._scan_lock = threading.Lock()
+        self._assembly_q: list[str] = []
+        self._assembly_cv = threading.Condition()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        scfg = self.cfg.serving
+        for i in range(max(1, scfg.engine_lanes)):
+            t = threading.Thread(target=self._engine_loop,
+                                 args=(f"lane{i}",),
+                                 name=f"sl3d-serve-engine-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._assembler_loop,
+                             name="sl3d-serve-assembler", daemon=True)
+        t.start()
+        self._threads.append(t)
+        self.log(f"[serve] service up (run {self.run_id}) root={self.root}")
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._assembly_cv:
+            self._assembly_cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self.adm.close()
+
+    # ---- submit ----------------------------------------------------------
+
+    def submit(self, payload: dict) -> tuple[bool, dict]:
+        """One scan submission: validate, quota-check, queue. Returns
+        (accepted, body) where body is the /submit response JSON."""
+        tenant = _safe_id(payload.get("tenant"), "anon")
+        target = str(payload.get("target") or "")
+        calib = str(payload.get("calib") or "")
+        if not target or not os.path.isdir(target):
+            return False, {"error": f"target is not a directory: {target!r}"}
+        if not calib or not os.path.isfile(calib):
+            return False, {"error": f"calib is not a file: {calib!r}"}
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        scan_id = _safe_id(payload.get("scan_id"),
+                           f"s{seq:04d}") or f"s{seq:04d}"
+        scan_id = f"{tenant}-{scan_id}"
+        out_dir = os.path.join(self.scans_dir, scan_id)
+        scfg = self.cfg.serving
+        budget = payload.get("budget_s", scfg.default_budget_s)
+        job = ScanJob(scan_id, tenant, os.path.abspath(target),
+                      os.path.abspath(calib), out_dir,
+                      weight=float(payload.get("weight",
+                                               scfg.default_weight)),
+                      budget_s=float(budget or 0.0))
+        with self.adm.lock:
+            if scan_id in self.adm.jobs:
+                return False, {"error": f"scan_id {scan_id!r} already exists"}
+            ok, reason = self.adm.submit(job)
+        if not ok:
+            self.registry.inc("sl3d_serve_rejected_total", tenant=tenant)
+            return False, {"error": reason, "tenant": tenant}
+        self.registry.inc("sl3d_serve_submitted_total", tenant=tenant)
+        return True, {"scan_id": scan_id, "tenant": tenant,
+                      "state": "queued"}
+
+    def status(self, scan_id: str) -> dict | None:
+        with self.adm.lock:
+            job = self.adm.jobs.get(scan_id)
+            if job is None:
+                return None
+            d = job.as_dict()
+            d["items"] = self.adm.scan_item_states(scan_id)
+            return d
+
+    def result_path(self, scan_id: str, artifact: str) -> tuple[str, dict]:
+        """Path of a finished request's artifact, or ("", error-body)."""
+        with self.adm.lock:
+            job = self.adm.jobs.get(scan_id)
+        if job is None:
+            return "", {"error": f"unknown scan_id {scan_id!r}"}
+        if job.state not in ("done", "degraded"):
+            return "", {"error": f"scan {scan_id!r} is {job.state}",
+                        "state": job.state}
+        name = {"ply": "merged.ply", "stl": "model.stl"}.get(artifact)
+        if name is None:
+            return "", {"error": f"unknown artifact {artifact!r} "
+                                 "(want ply|stl)"}
+        path = os.path.join(job.out_dir, name)
+        if not os.path.isfile(path):
+            return "", {"error": f"{name} missing for {scan_id!r}"}
+        return path, {}
+
+    def snapshot(self) -> dict:
+        snap = self.adm.snapshot()
+        snap["run_id"] = self.run_id
+        return snap
+
+    # ---- engine: plan ----------------------------------------------------
+
+    def _plan(self, job) -> None:
+        """Plan one admitted scan: derive sources + content-addressed view
+        keys through the SAME ``_view_plan`` the assembly pass uses, probe
+        the scanner key, register the cache-miss views as grantable items.
+        A warm view (this tenant or ANY other — the keys carry no
+        identity) completes at plan time: cross-tenant dedup is free."""
+        st = self._stages
+        job_log = self._job_log(job)
+        cache = TenantCache(self.store_root, job.tenant,
+                            ns_root=self.ns_root, enabled=True,
+                            verify=self.cfg.pipeline.verify_cache,
+                            log=lambda *_: None)
+        calib, sources, _view_cfg, view_keys = st._view_plan(
+            job.calib, job.target, self.cfg, self._engine_steps(), cache,
+            job_log)
+        scanner_key = self._scanner_key(job.calib, sources)
+        specs, warm = [], 0
+        for i, (src, key) in enumerate(zip(sources, view_keys)):
+            if cache.get("view", key) is not None:
+                warm += 1          # get() also marked this tenant's ref
+                continue
+            specs.append({"index": i, "src": src, "key": key,
+                          "scan": job.scan_id})
+        ctx = _ScanCtx(job, self._engine_steps(), calib, sources,
+                       view_keys, cache, scanner_key)
+        with self._scan_lock:
+            self._scans[job.scan_id] = ctx
+        self.adm.add_items(job.scan_id, specs)
+        self.registry.inc("sl3d_serve_views_planned_total",
+                          len(specs) + warm, tenant=job.tenant)
+        self.registry.inc("sl3d_serve_views_dedup_total", warm,
+                          tenant=job.tenant)
+        job_log(f"[serve] {job.scan_id}: planned {len(specs)} view(s) to "
+                f"warm, {warm} already cached")
+
+    def _engine_steps(self) -> tuple:
+        s = tuple(x.strip() for x in
+                  self.cfg.serving.clean_steps.split(",") if x.strip())
+        return s or tuple(self._stages._CLEAN_STEPS)
+
+    def _scanner_key(self, calib_path: str, sources) -> tuple | None:
+        """Scans sharing (calib file, camera geometry) share one scanner —
+        the identity a cross-scan batched launch groups on. None on the
+        numpy/bitexact paths (no device scanner; per-view lane)."""
+        cfg = self.cfg
+        if cfg.parallel.backend == "numpy" or cfg.triangulate.bitexact:
+            return None
+        from structured_light_for_3d_model_replication_tpu.io import (
+            images as imio,
+        )
+
+        first = imio.list_frame_files(sources[0])
+        hdr = imio.probe_packed(first[0])
+        if hdr is not None:
+            cam_size = (int(hdr["width"]), int(hdr["height"]))
+        else:
+            probe = imio.load_gray(first[0])
+            cam_size = (probe.shape[1], probe.shape[0])
+        return (os.path.abspath(calib_path), cam_size)
+
+    def _scanner_for(self, ctx: _ScanCtx):
+        if ctx.scanner_key is None:
+            return None
+        with self._scan_lock:
+            sc = self._scanners.get(ctx.scanner_key)
+            if sc is None:
+                sc = self._stages._build_scanner(ctx.sources, ctx.calib,
+                                                 self.cfg)
+                self._scanners[ctx.scanner_key] = sc
+            return sc
+
+    # ---- engine: item programs ------------------------------------------
+
+    def _engine_loop(self, lane: str) -> None:
+        poll = max(0.01, self.cfg.serving.poll_s)
+        batch_n = max(1, self.cfg.parallel.compute_batch)
+        while not self._stop.is_set():
+            try:
+                self.adm.sweep_expired()
+                for job in self.adm.admit_next():
+                    try:
+                        self._plan(job)
+                    except Exception as e:
+                        self.adm.finish(job.scan_id, "failed",
+                                        error=f"plan: {e}")
+                        self._finish_metrics(job, "failed")
+                        self.log(f"[serve] {job.scan_id}: plan FAILED "
+                                 f"({type(e).__name__}: {e})")
+                self._queue_settled()
+                grants = self.adm.next_views(lane, batch_n)
+                if not grants:
+                    self._stop.wait(poll)
+                    continue
+                self._run_grants(lane, grants)
+            except BaseException as e:
+                # the engine must survive anything an item throws at it
+                # (incl. an injected crash — the service IS the process
+                # that must not die); affected leases age into steals
+                self.log(f"[serve] engine {lane}: {type(e).__name__}: {e}")
+                self._stop.wait(poll)
+
+    def _run_grants(self, lane: str, grants) -> None:
+        """One grant set → loads → one (or more) launches. Grouping is by
+        (scanner, frame shape): views from different scans land in the
+        SAME group whenever their geometry matches — this is where
+        cross-tenant batching actually happens."""
+        st = self._stages
+        loaded: dict[tuple | None, list] = {}
+        for iid, gen, spec in grants:
+            with self._scan_lock:
+                ctx = self._scans.get(spec["scan"])
+            if ctx is None:            # scan finished/failed underneath us
+                self.adm.failed(iid, lane, gen, "scan context gone")
+                continue
+            try:
+                frames, texture = st._retry_stage(
+                    "load",
+                    lambda s=spec["src"]: st._load_fired(s, self.cfg),
+                    self._policy)
+            except BaseException as e:
+                self.adm.failed(iid, lane, gen, f"load: {e}")
+                self.registry.inc("sl3d_serve_view_failures_total",
+                                  tenant=ctx.job.tenant)
+                continue
+            gkey = (None if ctx.scanner_key is None
+                    else ctx.scanner_key + (frames.shape,))
+            loaded.setdefault(gkey, []).append(
+                (iid, gen, spec, ctx, frames, texture))
+            self.adm.beat(lane)
+        for gkey, items in loaded.items():
+            if gkey is None or len(items) == 1:
+                for it in items:
+                    self._view_single(lane, it)
+            else:
+                self._view_batched(lane, items)
+
+    def _finish_item(self, lane, iid, gen, spec, ctx, pts, cols) -> None:
+        """Clean + cache one computed view (the PR-8 worker tail) and
+        settle its lease."""
+        st = self._stages
+        pts, cols, _ = st._clean_arrays(pts, cols, self.cfg, ctx.steps)
+        ctx.cache.put("view", spec["key"], points=pts, colors=cols)
+        self.adm.complete(iid, lane, gen)
+        self.registry.inc("sl3d_serve_views_warmed_total",
+                          tenant=ctx.job.tenant)
+
+    def _view_single(self, lane: str, item) -> None:
+        """The per-view engine lane: exactly the PR-8 worker's
+        ``_do_view`` program. ``compute.view`` fires inside
+        ``_compute_fired`` — a seeded fault fails the item here, the item
+        is NOT cached, and the request's assembly pass recomputes it
+        through the full retry/quarantine lane (failure policy lives in
+        one place)."""
+        st = self._stages
+        iid, gen, spec, ctx, frames, texture = item
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            triangulate as tri,
+        )
+
+        try:
+            scanner = self._scanner_for(ctx)
+            pts, cols = st._retry_stage(
+                "compute",
+                lambda: tri.compact_cloud(st._compute_fired(
+                    frames, texture, ctx.calib, self.cfg, scanner,
+                    spec["src"])),
+                self._policy)
+            self._finish_item(lane, iid, gen, spec, ctx, pts, cols)
+        except BaseException as e:
+            self.adm.failed(iid, lane, gen, f"compute: {e}")
+            self.registry.inc("sl3d_serve_view_failures_total",
+                              tenant=ctx.job.tenant)
+
+    def _view_batched(self, lane: str, items) -> None:
+        """One bucket-padded ``forward_views_batched`` launch over views
+        from possibly MANY scans — ``_reconstruct_batched``'s dispatch
+        math with the grant set as the batch. The ``compute.view`` site
+        fires per item at assembly (chaos semantics survive batching);
+        any batch-level failure degrades the whole group to the per-view
+        lane, where a poisoned view fails ALONE and its groupmates (other
+        tenants included) complete normally."""
+        st = self._stages
+        from structured_light_for_3d_model_replication_tpu.ops import (
+            triangulate as tri,
+        )
+
+        poisoned = None
+        for iid, gen, spec, ctx, _f, _t in items:
+            try:
+                faults.fire("compute.view", item=spec["src"])
+            except BaseException as e:
+                poisoned = e
+                break
+        if poisoned is None:
+            try:
+                import jax
+
+                scanner = self._scanner_for(items[0][3])
+                v = len(items)
+                batch_n = max(1, self.cfg.parallel.compute_batch)
+                bucket = st._view_bucket(v, batch_n)
+                fv = np.stack([f for _, _, _, _, f, _ in items])
+                if bucket > v:
+                    fv = np.concatenate(
+                        [fv, np.repeat(fv[-1:], bucket - v, axis=0)])
+                fv_d = jax.device_put(fv)
+                cloud = scanner.forward_views_batched(fv_d, mesh=None,
+                                                      **self._fwd_kw)
+                pts_v, cols_v, val_v = jax.device_get(
+                    (cloud.points[:v], cloud.colors[:v], cloud.valid[:v]))
+                tenants = {it[3].job.tenant for it in items}
+                scans = {it[2]["scan"] for it in items}
+                self.registry.inc("sl3d_serve_launches_total")
+                self.registry.inc("sl3d_serve_launch_views_total", v)
+                if len(scans) > 1:
+                    self.registry.inc("sl3d_serve_cross_scan_launches_total")
+                if len(tenants) > 1:
+                    self.registry.inc(
+                        "sl3d_serve_cross_tenant_launches_total")
+                for j, (iid, gen, spec, ctx, _f, _t) in enumerate(items):
+                    try:
+                        pts, cols = tri.compact_cloud(
+                            tri.CloudResult(pts_v[j], cols_v[j], val_v[j]))
+                        self._finish_item(lane, iid, gen, spec, ctx, pts,
+                                          cols)
+                    except BaseException as e:
+                        self.adm.failed(iid, lane, gen, f"drain: {e}")
+                        self.registry.inc("sl3d_serve_view_failures_total",
+                                          tenant=ctx.job.tenant)
+                return
+            except BaseException as e:
+                poisoned = e
+        self.log(f"[serve] batch of {len(items)} view(s) degraded to "
+                 f"per-view compute ({type(poisoned).__name__}: "
+                 f"{poisoned})")
+        for it in items:
+            self._view_single(lane, it)
+
+    # ---- assembly --------------------------------------------------------
+
+    def _queue_settled(self) -> None:
+        """Flip admitted scans whose items all settled to WARMED and hand
+        them to the assembler (a scan with zero cache-miss items settles
+        immediately — the fully-deduped fast path)."""
+        with self.adm.lock:
+            ready = [sid for sid, j in self.adm.jobs.items()
+                     if j.state == "admitted"
+                     and self.adm.scan_settled(sid)]
+            for sid in ready:
+                self.adm.jobs[sid].state = "warmed"
+                self.adm.ledger.event("warmed", scan=sid)
+        if ready:
+            with self._assembly_cv:
+                self._assembly_q.extend(ready)
+                self._assembly_cv.notify_all()
+
+    def _assembler_loop(self) -> None:
+        """ONE assembly at a time: requests share the engine for warming
+        but serialize through the proven single-process pipeline — device
+        contention stays simple and the byte-parity argument stays
+        exactly PR-8's."""
+        while True:
+            with self._assembly_cv:
+                while not self._assembly_q and not self._stop.is_set():
+                    self._assembly_cv.wait(timeout=0.5)
+                if self._stop.is_set() and not self._assembly_q:
+                    return
+                sid = self._assembly_q.pop(0)
+            with self.adm.lock:
+                job = self.adm.jobs.get(sid)
+            if job is not None:
+                self._assemble(job)
+
+    def _job_log(self, job):
+        def _log(msg):
+            self.log(f"[{job.scan_id}] {msg}")
+        return _log
+
+    def _assemble(self, job) -> None:
+        """The request's answer: ``run_pipeline`` over the warmed shared
+        cache, in this tenant's namespace, under the request's REMAINING
+        SLO budget. Terminal state maps: clean run → done; quarantined
+        views above the floor → degraded (its own failures.json); budget
+        breach → aborted (PR-7 manifest); anything else → failed. The
+        service outlives every one of these."""
+        st = self._stages
+        with self._scan_lock:
+            ctx = self._scans.get(job.scan_id)
+        with self.adm.lock:
+            job.state = "assembling"
+        rcfg = copy.deepcopy(self.cfg)
+        rcfg.coordinator.workers = 0
+        rem = job.budget_remaining()
+        if rem is not None:
+            # the PR-7 run budget, re-based to what the queue+warm phases
+            # left; an already-blown budget aborts at the first stage
+            # boundary and still leaves a manifest
+            rcfg.pipeline.run_budget_s = max(0.05, rem)
+        cache = (ctx.cache if ctx is not None else TenantCache(
+            self.store_root, job.tenant, ns_root=self.ns_root,
+            enabled=True, verify=rcfg.pipeline.verify_cache,
+            log=lambda *_: None))
+        steps = ctx.steps if ctx is not None else self._engine_steps()
+        t0 = time.monotonic()
+        state, error, report_d = "failed", "", {}
+        try:
+            report = st.run_pipeline(job.calib, job.target, job.out_dir,
+                                     cfg=rcfg, steps=steps,
+                                     log=self._job_log(job), cache=cache)
+            state = "degraded" if report.degraded else "done"
+            report_d = {"run_id": report.run_id,
+                        "views_computed": report.views_computed,
+                        "views_cached": report.views_cached,
+                        "merged_points": report.merged_points,
+                        "failed_views": len(report.failed),
+                        "merged_ply": report.merged_ply,
+                        "stl_path": report.stl_path,
+                        "assembly_s": round(report.elapsed_s, 3)}
+        except dl.DeadlineExceeded as e:
+            state, error = "aborted", f"SLO budget exceeded: {e}"
+        except BaseException as e:
+            state, error = "failed", f"{type(e).__name__}: {e}"
+        finally:
+            with self._scan_lock:
+                self._scans.pop(job.scan_id, None)
+        self.adm.finish(job.scan_id, state, error=error, report=report_d)
+        self._finish_metrics(job, state,
+                             assembly_s=time.monotonic() - t0)
+        self.log(f"[serve] {job.scan_id}: {state.upper()} "
+                 f"({job.elapsed_s():.2f}s total)" +
+                 (f" — {error}" if error else ""))
+
+    def _finish_metrics(self, job, state: str, assembly_s: float = 0.0):
+        self.registry.inc("sl3d_serve_requests_total", tenant=job.tenant,
+                          state=state)
+        self.registry.observe("sl3d_serve_request_seconds",
+                              job.elapsed_s(), tenant=job.tenant)
+        if assembly_s:
+            self.registry.observe("sl3d_serve_assembly_seconds",
+                                  assembly_s, tenant=job.tenant)
+
+    # ---- metrics surface -------------------------------------------------
+
+    def metrics_text(self) -> str:
+        snap = self.adm.snapshot()
+        self.registry.set_gauge("sl3d_serve_scans_active", snap["active"])
+        self.registry.set_gauge("sl3d_serve_scans_queued", snap["queued"])
+        return tel.prometheus_text(self.registry.as_dict())
+
+
+# ---- HTTP gateway --------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Thin JSON shim over ScanService; one instance per request (stdlib
+    threading server), all state on ``self.server.service``."""
+
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def service(self) -> ScanService:
+        return self.server.service      # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):   # route through the service log
+        self.service.log("[serve.http] " + fmt % args)
+
+    def _json(self, code: int, body: dict) -> None:
+        data = (json.dumps(body) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _bytes(self, code: int, data: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self):
+        parsed = urllib.parse.urlparse(self.path)
+        if parsed.path != "/submit":
+            return self._json(404, {"error": f"no route {parsed.path!r}"})
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            return self._json(400, {"error": f"bad JSON body: {e}"})
+        ok, body = self.service.submit(payload)
+        if ok:
+            return self._json(200, body)
+        # quota/backpressure rejections are 429 (retryable); malformed
+        # submissions are 400
+        code = 429 if ("quota" in body.get("error", "")
+                       or "queue full" in body.get("error", "")) else 400
+        return self._json(code, body)
+
+    def do_GET(self):
+        parsed = urllib.parse.urlparse(self.path)
+        path = parsed.path
+        if path == "/healthz":
+            snap = self.service.snapshot()
+            return self._json(200, {"ok": True, "run_id": snap["run_id"],
+                                    "active": snap["active"],
+                                    "queued": snap["queued"]})
+        if path == "/metrics":
+            return self._bytes(200, self.service.metrics_text().encode(),
+                               "text/plain; version=0.0.4")
+        if path.startswith("/status/"):
+            d = self.service.status(path[len("/status/"):])
+            if d is None:
+                return self._json(404, {"error": "unknown scan_id"})
+            return self._json(200, d)
+        if path.startswith("/result/"):
+            scan_id = path[len("/result/"):]
+            q = urllib.parse.parse_qs(parsed.query)
+            artifact = (q.get("artifact") or ["ply"])[0]
+            fpath, err = self.service.result_path(scan_id, artifact)
+            if not fpath:
+                code = 409 if err.get("state") else 404
+                return self._json(code, err)
+            with open(fpath, "rb") as f:
+                return self._bytes(200, f.read(),
+                                   "application/octet-stream")
+        return self._json(404, {"error": f"no route {path!r}"})
+
+
+def start_gateway(root: str, cfg: Config | None = None, log=print,
+                  ready_file: str | None = None):
+    """Bind + start the service WITHOUT blocking: returns (httpd, svc).
+    The caller runs ``httpd.serve_forever`` (``serve`` does, on the main
+    thread; tests/bench push it to a daemon thread) and tears down with
+    ``httpd.shutdown(); httpd.server_close(); svc.close()``. Writes
+    ``<root>/serve.json`` (and optional ``ready_file``) with the bound
+    address — the discovery handshake for CI and the load generator."""
+    cfg = cfg or Config()
+    svc = ScanService(root, cfg=cfg, log=log)
+    httpd = ThreadingHTTPServer((cfg.serving.host, cfg.serving.port),
+                                _Handler)
+    httpd.service = svc                  # type: ignore[attr-defined]
+    httpd.daemon_threads = True
+    host, port = httpd.server_address[0], httpd.server_address[1]
+    svc.start()
+    info = {"host": host, "port": port, "pid": os.getpid(),
+            "run_id": svc.run_id, "root": svc.root}
+    with open(os.path.join(svc.root, "serve.json"), "w") as f:
+        json.dump(info, f)
+    if ready_file:
+        with open(ready_file, "w") as f:
+            json.dump(info, f)
+    log(f"[serve] listening on http://{host}:{port} "
+        f"(endpoints: /submit /status/<id> /result/<id> /metrics "
+        f"/healthz)")
+    return httpd, svc
+
+
+def serve(root: str, cfg: Config | None = None, log=print,
+          ready_file: str | None = None) -> int:
+    """Run the gateway until interrupted (the ``sl3d serve`` entry)."""
+    cfg = cfg or Config()
+    faults.configure_from(cfg.faults)
+    httpd, svc = start_gateway(root, cfg=cfg, log=log,
+                               ready_file=ready_file)
+    try:
+        httpd.serve_forever(poll_interval=0.2)
+    except KeyboardInterrupt:
+        log("[serve] interrupted; draining")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        svc.close()
+    return 0

@@ -1897,7 +1897,8 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
                  cfg: Config | None = None,
                  steps: tuple[str, ...] | list[str] = _CLEAN_STEPS,
                  merged_name: str = "merged.ply",
-                 stl_name: str = "model.stl", log=print) -> PipelineReport:
+                 stl_name: str = "model.stl", log=print,
+                 cache=None) -> PipelineReport:
     """The fused scan-to-print command: reconstruct -> per-view masked clean
     -> merge-360 -> mesh, end to end in ONE process with device-resident
     handoff — per-view clouds flow from the pipelined executor's clean lane
@@ -1978,7 +1979,7 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     try:
         report = _run_pipeline_impl(calib_path, target, out_dir, cfg,
                                     tuple(steps), merged_name, stl_name,
-                                    log, run_id)
+                                    log, run_id, cache=cache)
         if tracer is not None:
             g = tracer.registry.set_gauge
             g("sl3d_run_wall_seconds", report.elapsed_s)
@@ -2051,7 +2052,7 @@ def _initialized_device_count():
 def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
                        cfg: Config, steps: tuple[str, ...],
                        merged_name: str, stl_name: str, log,
-                       run_id: str) -> PipelineReport:
+                       run_id: str, cache=None) -> PipelineReport:
     from structured_light_for_3d_model_replication_tpu.models import (
         reconstruction as recon,
     )
@@ -2073,9 +2074,10 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
         if os.path.exists(p):
             os.remove(p)
     report = PipelineReport(run_id=run_id)
-    cache = StageCache(os.path.join(out_dir, ".slscan-cache"),
-                       enabled=cfg.pipeline.cache, log=log,
-                       verify=cfg.pipeline.verify_cache)
+    if cache is None:
+        cache = StageCache(os.path.join(out_dir, ".slscan-cache"),
+                           enabled=cfg.pipeline.cache, log=log,
+                           verify=cfg.pipeline.verify_cache)
 
     # ---- stage 1+2: per-view reconstruct + masked clean -----------------
     steps = tuple(steps)

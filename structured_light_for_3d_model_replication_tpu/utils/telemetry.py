@@ -127,8 +127,15 @@ def new_run_id() -> str:
 # metrics registry
 # ---------------------------------------------------------------------------
 
-def _labelkey(labels: dict) -> tuple:
-    return tuple(sorted((k, str(v)) for k, v in labels.items()
+def _labelkey(labels: dict, extra: dict | None = None) -> tuple:
+    """Canonical (sorted, stringified) label identity. ``extra`` is the
+    explicit ``labels={}`` dict — it merges OVER the kwargs form so call
+    sites can use label names that aren't valid Python identifiers
+    (e.g. dotted stage paths) without name-mangling."""
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    return tuple(sorted((k, str(v)) for k, v in merged.items()
                         if v is not None))
 
 
@@ -191,26 +198,29 @@ class MetricsRegistry:
         self._gauges: dict[tuple, float] = {}
         self._hists: dict[tuple, _Histogram] = {}
 
-    def inc(self, name: str, value: float = 1.0, **labels) -> None:
-        k = (name, _labelkey(labels))
+    def inc(self, name: str, value: float = 1.0, labels: dict | None = None,
+            **kwlabels) -> None:
+        k = (name, _labelkey(kwlabels, labels))
         with self._lock:
             self._counters[k] = self._counters.get(k, 0.0) + value
 
-    def set_gauge(self, name: str, value: float, **labels) -> None:
+    def set_gauge(self, name: str, value: float,
+                  labels: dict | None = None, **kwlabels) -> None:
         with self._lock:
-            self._gauges[(name, _labelkey(labels))] = float(value)
+            self._gauges[(name, _labelkey(kwlabels, labels))] = float(value)
 
     def observe(self, name: str, value: float, buckets=_SECONDS_BUCKETS,
-                **labels) -> None:
-        k = (name, _labelkey(labels))
+                labels: dict | None = None, **kwlabels) -> None:
+        k = (name, _labelkey(kwlabels, labels))
         with self._lock:
             h = self._hists.get(k)
             if h is None:
                 h = self._hists[k] = _Histogram(buckets)
             h.observe(value)
 
-    def counter_value(self, name: str, **labels) -> float:
-        return self._counters.get((name, _labelkey(labels)), 0.0)
+    def counter_value(self, name: str, labels: dict | None = None,
+                      **kwlabels) -> float:
+        return self._counters.get((name, _labelkey(kwlabels, labels)), 0.0)
 
     def as_dict(self) -> dict:
         def row(k, v):
@@ -240,13 +250,23 @@ class MetricsRegistry:
         return prometheus_text(self.as_dict())
 
 
+def _prom_escape(v) -> str:
+    """Label-value escaping per the Prometheus exposition format: backslash,
+    double quote, and newline are the only characters the format escapes.
+    Values without them pass through unchanged, so pre-existing call sites
+    render byte-identical text."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_labels(labels: dict, extra: dict | None = None) -> str:
     items = dict(labels)
     if extra:
         items.update(extra)
     if not items:
         return ""
-    body = ",".join(f'{k}="{v}"' for k, v in sorted(items.items()))
+    body = ",".join(f'{k}="{_prom_escape(v)}"'
+                    for k, v in sorted(items.items()))
     return "{" + body + "}"
 
 

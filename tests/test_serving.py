@@ -1,0 +1,267 @@
+"""``sl3d serve`` contract: multi-tenant byte parity with solo pipeline
+runs, per-request failure domains (one tenant's seeded fault degrades
+only that tenant), admission quotas, per-request SLO aborts, and the
+HTTP surface (submit/status/result/metrics/healthz).
+
+The full K-tenant end-to-end lives here marked ``slow`` (tier-1 budget);
+CI's SERVE_SMOKE arm runs the same contract every build.
+"""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io import images as imio
+from structured_light_for_3d_model_replication_tpu.io import matfile
+from structured_light_for_3d_model_replication_tpu.pipeline import serving
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import faults
+from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+
+CAM, PROJ = (160, 120), (128, 64)
+STEPS = ("statistical",)  # tiny clouds carry no dominant RANSAC plane
+TERMINAL = ("done", "degraded", "failed", "aborted")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.reset()
+
+
+def _render_scan(tgt: str, views: int, shift: float) -> None:
+    """EVERY view distinct across tenants: a satellite sphere offset by
+    ``shift`` breaks the symmetry even at 0 deg (where the turntable
+    transform is the identity, so a pivot shift alone leaves view 0
+    byte-identical across tenants — and identical bytes dedup to the
+    FIRST tenant's cache entry, which is its own test, not this one)."""
+    rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+    scene = syn.sphere_on_background()
+    obj, background = scene.objects
+    satellite = syn.Sphere(np.array([48.0 + shift, -92.0, 430.0]), 16.0)
+    step = 360.0 / views
+    pivot = np.array([0.0, 0.0, 420.0])
+    for i, (R, t) in enumerate(syn.turntable_poses(views, step, pivot)):
+        frames, _ = syn.render_scene(
+            rig, syn.Scene([obj.transformed(R, t),
+                            satellite.transformed(R, t), background]))
+        imio.save_stack(
+            os.path.join(tgt, f"scan_{int(round(i * step)):03d}deg_scan"),
+            frames)
+
+
+@pytest.fixture(scope="module")
+def calib(tmp_path_factory):
+    root = tmp_path_factory.mktemp("calib")
+    path = str(root / "calib.mat")
+    rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+    matfile.save_calibration(path, rig.calibration())
+    return path
+
+
+def _cfg() -> Config:
+    cfg = Config()
+    cfg.parallel.backend = "numpy"
+    cfg.decode.n_cols, cfg.decode.n_rows = PROJ
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 512
+    cfg.merge.icp_iters = 10
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    cfg.serving.clean_steps = "statistical"
+    cfg.serving.port = 0
+    return cfg
+
+
+def _wait(svc, sid, timeout=180.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        d = svc.status(sid)
+        if d["state"] in TERMINAL:
+            return d
+        time.sleep(0.1)
+    raise TimeoutError(f"{sid} still {d['state']} after {timeout}s")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: K tenants, byte parity, per-tenant failure domain
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_three_tenants_parity_and_fault_isolation(tmp_path, calib):
+    """ISSUE-12 acceptance: K=3 concurrent tenants produce byte-identical
+    PLY/STL vs solo ``run_pipeline``; a permanent compute fault seeded on
+    ONE tenant's views degrades only that tenant; /metrics carries
+    per-tenant labels."""
+    inputs = {}
+    for i, (t, views) in enumerate((("ta", 2), ("tb", 3), ("tc", 2))):
+        tgt = str(tmp_path / f"in_{t}")
+        os.makedirs(tgt)
+        _render_scan(tgt, views=views, shift=9.0 * i)
+        inputs[t] = tgt
+
+    # solo references for the clean tenants (no faults armed)
+    solo = {}
+    for t in ("ta", "tc"):
+        out = str(tmp_path / f"solo_{t}")
+        rep = stages.run_pipeline(calib, inputs[t], out, cfg=_cfg(),
+                                  steps=STEPS, log=lambda m: None)
+        assert rep.failed == []
+        solo[t] = out
+
+    # fault exactly ONE of tb's 3 views (path substring): 2 survivors stay
+    # at the min_views floor — the degraded-completion path, not the
+    # below-floor abort
+    cfg = _cfg()
+    cfg.faults.spec = "compute.view~in_tb/scan_000:permanent"
+    faults.configure_from(cfg.faults)
+    svc = serving.ScanService(str(tmp_path / "svc"), cfg=cfg,
+                              log=lambda m: None)
+    svc.start()
+    try:
+        sids = {}
+        for t, tgt in inputs.items():
+            ok, body = svc.submit({"tenant": t, "target": tgt,
+                                   "calib": calib})
+            assert ok, body
+            sids[t] = body["scan_id"]
+        states = {t: _wait(svc, sid) for t, sid in sids.items()}
+        assert states["ta"]["state"] == "done", states["ta"]
+        assert states["tc"]["state"] == "done", states["tc"]
+        assert states["tb"]["state"] == "degraded", states["tb"]
+        for t in ("ta", "tc"):
+            for art, name in (("ply", "merged.ply"), ("stl", "model.stl")):
+                path, err = svc.result_path(sids[t], art)
+                assert path, err
+                with open(path, "rb") as fa, \
+                        open(os.path.join(solo[t], name), "rb") as fb:
+                    assert fa.read() == fb.read(), f"{t}/{name} differs"
+        # degraded tenant still ships a result (2 surviving views)
+        path, err = svc.result_path(sids["tb"], "ply")
+        assert path, err
+        text = svc.metrics_text()
+        assert 'tenant="ta"' in text and 'tenant="tb"' in text
+        assert 'sl3d_serve_requests_total{state="degraded",tenant="tb"}' \
+            in text
+    finally:
+        svc.close()
+
+
+def test_budget_breach_aborts_only_that_request(tmp_path, calib):
+    """PR-7 run budget as per-request SLO: a hopeless budget aborts the
+    request with its own failures.json; the service keeps serving."""
+    tgt = str(tmp_path / "in_slo")
+    os.makedirs(tgt)
+    _render_scan(tgt, views=2, shift=0.0)
+    svc = serving.ScanService(str(tmp_path / "svc"), cfg=_cfg(),
+                              log=lambda m: None)
+    svc.start()
+    try:
+        ok, body = svc.submit({"tenant": "ta", "target": tgt,
+                               "calib": calib, "budget_s": 0.05})
+        assert ok, body
+        d = _wait(svc, body["scan_id"])
+        assert d["state"] == "aborted", d
+        out_dir = svc.adm.jobs[body["scan_id"]].out_dir
+        with open(os.path.join(out_dir, "failures.json")) as f:
+            assert json.load(f)["aborted"] is True
+        # service survives: a sane request right after completes
+        ok, body2 = svc.submit({"tenant": "ta", "target": tgt,
+                                "calib": calib})
+        assert ok, body2
+        assert _wait(svc, body2["scan_id"])["state"] == "done"
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# admission: validation, quotas, duplicate ids
+# ---------------------------------------------------------------------------
+
+def test_submit_validation_and_quotas(tmp_path, calib):
+    """submit() is pure admission (no engine needed): bad inputs reject
+    with a reason, per-tenant queue quotas bound one tenant's backlog,
+    and scan ids never collide."""
+    tgt = str(tmp_path / "in")
+    os.makedirs(os.path.join(tgt, "scan_000deg_scan"))
+    cfg = _cfg()
+    cfg.serving.tenant_queue_quota = 2
+    svc = serving.ScanService(str(tmp_path / "svc"), cfg=cfg,
+                              log=lambda m: None)  # never start()ed
+    ok, body = svc.submit({"tenant": "ta", "target": str(tmp_path / "no"),
+                           "calib": calib})
+    assert not ok and "target" in body["error"]
+    ok, body = svc.submit({"tenant": "ta", "target": tgt,
+                           "calib": str(tmp_path / "no.mat")})
+    assert not ok and "calib" in body["error"]
+
+    ok, _ = svc.submit({"tenant": "ta", "target": tgt, "calib": calib,
+                        "scan_id": "dup"})
+    assert ok
+    ok, body = svc.submit({"tenant": "ta", "target": tgt, "calib": calib,
+                           "scan_id": "dup"})
+    assert not ok and "exists" in body["error"]
+
+    ok, _ = svc.submit({"tenant": "ta", "target": tgt, "calib": calib})
+    assert ok  # second queued scan fills ta's quota of 2
+    ok, body = svc.submit({"tenant": "ta", "target": tgt, "calib": calib})
+    assert not ok and "quota" in body["error"]
+    # quota is per tenant, not global: another tenant still admits
+    ok, _ = svc.submit({"tenant": "tb", "target": tgt, "calib": calib})
+    assert ok
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+def test_gateway_http_surface(tmp_path):
+    """healthz/metrics/status/result over a real socket (port 0): the
+    status codes clients key on — 400 bad JSON, 404 unknown scan."""
+    httpd, svc = serving.start_gateway(str(tmp_path / "svc"), cfg=_cfg(),
+                                       log=lambda m: None)
+    import threading
+
+    th = threading.Thread(target=httpd.serve_forever,
+                          kwargs={"poll_interval": 0.05}, daemon=True)
+    th.start()
+    base = f"http://{httpd.server_address[0]}:{httpd.server_address[1]}"
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["ok"] is True
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+            assert "sl3d_serve_scans_active" in text
+        # serve.json handshake file for loadgen --root discovery
+        with open(os.path.join(str(tmp_path / "svc"), "serve.json")) as f:
+            info = json.load(f)
+        assert info["port"] == httpd.server_address[1]
+        for path, want in (("/status/nope", 404),
+                           ("/result/nope", 404)):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + path, timeout=10)
+            assert ei.value.code == want, path
+        req = urllib.request.Request(
+            base + "/submit", data=b"{not json",
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        svc.close()
+
+
+def test_safe_id_sanitizes():
+    assert serving._safe_id("a/b c!", "fb") == "a-b-c"
+    assert serving._safe_id("", "fb") == "fb"
+    assert serving._safe_id(None, "fb") == "fb"

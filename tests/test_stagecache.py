@@ -8,6 +8,7 @@ import pytest
 
 from structured_light_for_3d_model_replication_tpu.pipeline.stagecache import (
     StageCache,
+    TenantCache,
 )
 from structured_light_for_3d_model_replication_tpu.utils import faults
 
@@ -121,3 +122,81 @@ def test_keys_parallel_matches_serial_keys(tmp_path):
     assert c.keys_parallel("view", lists, config_json='{"a":1}',
                            io_workers=1) == serial
     assert len(set(serial)) == len(serial)  # distinct inputs, distinct keys
+
+
+# ---------------------------------------------------------------------------
+# TenantCache: cross-tenant dedup, namespace isolation, ref-counted GC
+# ---------------------------------------------------------------------------
+
+def test_tenant_dedup_same_bytes_one_store_entry(tmp_path):
+    """ISSUE-12: identical frame bytes from two tenants share ONE store
+    payload (keys are pure content, never identity), while each tenant's
+    namespace records its own ref."""
+    store = str(tmp_path / "store")
+    a = TenantCache(store, "ta")
+    b = TenantCache(store, "tb")
+    key = a.key("view", config_json="{}")
+    assert key == b.key("view", config_json="{}")
+    a.put("view", key, **_arrays())
+    assert b.get("view", key) is not None    # dedup hit, zero extra bytes
+    assert len([f for f in os.listdir(store) if f.endswith(".npz")]) == 1
+    assert a.refs() == b.refs() == [f"view-{key[:16]}"]
+
+
+def test_tenant_outputs_never_alias(tmp_path):
+    """A dedup hit hands every tenant its OWN arrays: mutating one
+    tenant's result can never bleed into another's next read."""
+    store = str(tmp_path / "store")
+    a = TenantCache(store, "ta")
+    b = TenantCache(store, "tb")
+    key = a.key("view", config_json="{}")
+    a.put("view", key, **_arrays())
+    out_a = a.get("view", key)
+    out_b = b.get("view", key)
+    assert out_a["points"] is not out_b["points"]
+    out_a["points"][:] = -1.0
+    np.testing.assert_array_equal(b.get("view", key)["points"],
+                                  _arrays()["points"])
+
+
+def test_evict_tenant_spares_shared_entries(tmp_path):
+    """Evicting tenant A drops A's refs and GCs only payloads no other
+    tenant references: B's entries survive A's eviction — including the
+    entry A WROTE and B merely read (the read-refs rule)."""
+    store = str(tmp_path / "store")
+    a = TenantCache(store, "ta")
+    b = TenantCache(store, "tb")
+    f = tmp_path / "frames.bin"
+    f.write_bytes(os.urandom(128))
+    shared = a.key("view", files=[str(f)], config_json="{}")
+    only_a = a.key("view", config_json='{"solo":"a"}')
+    a.put("view", shared, **_arrays())
+    a.put("view", only_a, **_arrays(1))
+    assert b.get("view", shared) is not None     # B reads -> B refs
+    stats = TenantCache.evict_tenant(store, "ta")
+    assert stats == {"refs_dropped": 2, "payloads_deleted": 1,
+                     "payloads_kept": 1}
+    assert TenantCache.tenants(a.ns_root) == ["tb"]
+    assert b.get("view", shared) is not None     # still warm for B
+    assert b.get("view", only_a) is None         # A's private entry is gone
+
+
+def test_evict_unknown_tenant_is_noop(tmp_path):
+    store = str(tmp_path / "store")
+    a = TenantCache(store, "ta")
+    key = a.key("view", config_json="{}")
+    a.put("view", key, **_arrays())
+    stats = TenantCache.evict_tenant(store, "ghost")
+    assert stats == {"refs_dropped": 0, "payloads_deleted": 0,
+                     "payloads_kept": 0}
+    assert a.get("view", key) is not None
+
+
+def test_tenant_id_sanitized_and_bounded(tmp_path):
+    store = str(tmp_path / "store")
+    c = TenantCache(store, "../evil tenant")
+    assert os.sep not in c.tenant and c.tenant[0] != "."
+    assert os.path.dirname(os.path.abspath(c.ns_dir)) == \
+        os.path.abspath(c.ns_root)
+    with pytest.raises(ValueError):
+        TenantCache(store, "...")

@@ -157,4 +157,19 @@ else
   echo "MULTIPROC_SMOKE=FAIL (rc=$mproc_rc; see tools/_ci/multiproc_smoke.log)"
   [ $rc -eq 0 ] && rc=1
 fi
+
+# ---- serve smoke: one gateway, two concurrent tenants, a seeded
+# permanent compute.view fault on ONE of the faulty tenant's views —
+# the faulty request must complete DEGRADED, the clean tenant's
+# downloaded PLY+STL must be byte-identical to a solo run_pipeline,
+# and /metrics must scrape with per-tenant labels (ISSUE 12) ----
+serve_rc=0
+serve=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/serve_smoke.py 2>&1) || serve_rc=$?
+echo "$serve" > tools/_ci/serve_smoke.log
+if [ $serve_rc -eq 0 ] && echo "$serve" | grep -q 'SERVE_SMOKE=ok'; then
+  echo "$serve" | grep 'SERVE_SMOKE=ok'
+else
+  echo "SERVE_SMOKE=FAIL (rc=$serve_rc; see tools/_ci/serve_smoke.log)"
+  [ $rc -eq 0 ] && rc=1
+fi
 exit $rc

@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Synthetic multi-tenant load generator for ``sl3d serve``.
+
+Drives a RUNNING gateway over plain HTTP (stdlib urllib — same no-deps
+discipline as the service itself): N tenants submit scans with Poisson
+inter-arrival times (seeded, reproducible), every request is polled to a
+terminal state, and the run is summarized the way a serving benchmark
+needs — scans/hour, p50/p99 request latency, per-state counts, and the
+gateway's launch-fill counters scraped from ``/metrics`` (mean
+views/launch — the cross-tenant batching number).
+
+Inputs come from a JSON manifest mapping tenants to (target, calib)
+pairs, so every tenant can submit DISTINCT scan data (identical bytes
+would dedup to zero engine work after the first tenant — correct for the
+service, useless for a load test):
+
+    {"tenants": {"ta": [{"target": "...", "calib": "..."}],
+                 "tb": [{"target": "...", "calib": "...", "weight": 2}]}}
+
+Each tenant cycles through its list for ``--scans`` submissions.
+
+Usage:
+    python tools/loadgen.py --root <serve root>   # reads serve.json
+    python tools/loadgen.py --url http://127.0.0.1:8089 \
+        --manifest inputs.json --scans 2 --rate 0.5 --seed 0 --out lg.json
+
+``--root`` discovers the gateway via the ``serve.json`` the service
+writes once listening (the ready handshake). Exit 0 when every request
+reached done/degraded (degraded IS a completed request — the per-request
+failure-domain contract), 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_TERMINAL = ("done", "degraded", "failed", "aborted")
+
+
+def _get(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _post_json(url: str, payload: dict, timeout: float = 10.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def discover(root: str, timeout_s: float = 30.0) -> str:
+    """Wait for ``<root>/serve.json`` and return the gateway base URL."""
+    path = os.path.join(root, "serve.json")
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    info = json.load(f)
+                return f"http://{info['host']}:{info['port']}"
+            except (json.JSONDecodeError, KeyError):
+                pass        # torn read; the writer is not atomic
+        time.sleep(0.1)
+    raise TimeoutError(f"no serve.json under {root!r} after {timeout_s}s")
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _scrape_counter(text: str, name: str) -> float:
+    """Sum a counter across its label sets in exposition text."""
+    total, seen = 0.0, False
+    for m in re.finditer(rf"^{re.escape(name)}(?:{{[^}}]*}})? (\S+)$",
+                         text, re.M):
+        total += float(m.group(1))
+        seen = True
+    return total if seen else 0.0
+
+
+class TenantDriver(threading.Thread):
+    """One tenant's arrival process: Poisson gaps, submit, poll to
+    terminal. Results append to the shared list (lock-guarded)."""
+
+    def __init__(self, base: str, tenant: str, inputs: list[dict],
+                 scans: int, rate: float, rng: random.Random,
+                 results: list, lock: threading.Lock,
+                 poll_s: float = 0.25, request_timeout_s: float = 600.0,
+                 budget_s: float = 0.0):
+        super().__init__(name=f"loadgen-{tenant}", daemon=True)
+        self.base = base
+        self.tenant = tenant
+        self.inputs = inputs
+        self.scans = scans
+        self.rate = rate
+        self.rng = rng
+        self.results = results
+        self.lock = lock
+        self.poll_s = poll_s
+        self.request_timeout_s = request_timeout_s
+        self.budget_s = budget_s
+
+    def _one(self, i: int) -> dict:
+        spec = self.inputs[i % len(self.inputs)]
+        payload = {"tenant": self.tenant, "target": spec["target"],
+                   "calib": spec["calib"]}
+        if "weight" in spec:
+            payload["weight"] = spec["weight"]
+        if self.budget_s:
+            payload["budget_s"] = self.budget_s
+        t0 = time.monotonic()
+        code, body = _post_json(self.base + "/submit", payload)
+        if code != 200:
+            return {"tenant": self.tenant, "state": "rejected",
+                    "http": code, "error": body.get("error", ""),
+                    "latency_s": time.monotonic() - t0}
+        sid = body["scan_id"]
+        while time.monotonic() - t0 < self.request_timeout_s:
+            _, raw = _get(self.base + f"/status/{sid}")
+            d = json.loads(raw)
+            if d["state"] in _TERMINAL:
+                return {"tenant": self.tenant, "scan_id": sid,
+                        "state": d["state"],
+                        "latency_s": time.monotonic() - t0}
+            time.sleep(self.poll_s)
+        return {"tenant": self.tenant, "scan_id": sid, "state": "timeout",
+                "latency_s": time.monotonic() - t0}
+
+    def run(self) -> None:
+        for i in range(self.scans):
+            if i > 0 and self.rate > 0:
+                time.sleep(self.rng.expovariate(self.rate))
+            res = self._one(i)
+            with self.lock:
+                self.results.append(res)
+
+
+def run_load(base: str, manifest: dict, scans: int, rate: float,
+             seed: int = 0, budget_s: float = 0.0,
+             request_timeout_s: float = 600.0, log=print) -> dict:
+    """Drive the gateway with every tenant in ``manifest`` and summarize.
+    Importable — ``bench.py``'s serve arm calls this directly."""
+    tenants = manifest["tenants"]
+    results: list[dict] = []
+    lock = threading.Lock()
+    t_wall = time.monotonic()
+    drivers = [
+        TenantDriver(base, tenant, inputs, scans, rate,
+                     random.Random(seed * 1000 + i), results, lock,
+                     request_timeout_s=request_timeout_s,
+                     budget_s=budget_s)
+        for i, (tenant, inputs) in enumerate(sorted(tenants.items()))
+    ]
+    for d in drivers:
+        d.start()
+    for d in drivers:
+        d.join()
+    wall = time.monotonic() - t_wall
+
+    states: dict[str, int] = {}
+    for r in results:
+        states[r["state"]] = states.get(r["state"], 0) + 1
+    completed = [r for r in results if r["state"] in ("done", "degraded")]
+    lats = sorted(r["latency_s"] for r in completed)
+    out = {
+        "tenants": len(drivers), "scans_per_tenant": scans,
+        "arrival_rate_hz": rate, "seed": seed,
+        "submitted": len(results), "states": states,
+        "wall_s": round(wall, 3),
+        "scans_per_hour": (round(len(completed) / wall * 3600.0, 1)
+                           if wall > 0 else None),
+        "p50_latency_s": (round(_percentile(lats, 0.50), 3)
+                          if lats else None),
+        "p99_latency_s": (round(_percentile(lats, 0.99), 3)
+                          if lats else None),
+        "results": results,
+    }
+    try:
+        _, raw = _get(base + "/metrics")
+        text = raw.decode()
+        launches = _scrape_counter(text, "sl3d_serve_launches_total")
+        views = _scrape_counter(text, "sl3d_serve_launch_views_total")
+        out["launches"] = launches
+        out["launch_views"] = views
+        out["mean_views_per_launch"] = (round(views / launches, 3)
+                                        if launches else None)
+        out["cross_scan_launches"] = _scrape_counter(
+            text, "sl3d_serve_cross_scan_launches_total")
+        out["cross_tenant_launches"] = _scrape_counter(
+            text, "sl3d_serve_cross_tenant_launches_total")
+    except (OSError, ValueError) as e:
+        log(f"[loadgen] metrics scrape failed: {e}")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="gateway base URL (http://host:port)")
+    ap.add_argument("--root", default=None,
+                    help="service root; discovers the URL via serve.json")
+    ap.add_argument("--manifest", required=True,
+                    help="JSON manifest: {'tenants': {name: [{target, "
+                         "calib[, weight]}...]}}")
+    ap.add_argument("--scans", type=int, default=1,
+                    help="submissions per tenant")
+    ap.add_argument("--rate", type=float, default=1.0,
+                    help="Poisson arrival rate per tenant (scans/sec; "
+                         "0 = back-to-back)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--budget-s", type=float, default=0.0,
+                    help="per-request SLO budget sent with every submit")
+    ap.add_argument("--request-timeout-s", type=float, default=600.0)
+    ap.add_argument("--out", default=None, help="write summary JSON here")
+    args = ap.parse_args(argv)
+    if not args.url and not args.root:
+        ap.error("one of --url / --root is required")
+    base = args.url or discover(args.root)
+    with open(args.manifest) as f:
+        manifest = json.load(f)
+    out = run_load(base, manifest, args.scans, args.rate, seed=args.seed,
+                   budget_s=args.budget_s,
+                   request_timeout_s=args.request_timeout_s)
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    ok = (out["submitted"] > 0
+          and all(r["state"] in ("done", "degraded")
+                  for r in out["results"]))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

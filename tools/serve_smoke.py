@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""CI smoke for ``sl3d serve`` (ISSUE 12): a real gateway, two concurrent
+tenants, one of them carrying a seeded permanent ``compute.view`` fault on
+one view.
+
+Asserts, end to end over HTTP (no thresholds — completion + identity):
+  * the clean tenant's request completes DONE and its downloaded
+    /result PLY + STL are byte-identical to a solo ``run_pipeline`` of
+    the same input (the PR-8 parity construction carried to serving);
+  * the faulty tenant completes DEGRADED (its faulted view quarantined,
+    survivors >= the min_views floor) — the per-request failure domain:
+    one tenant's fault never touches the other's request;
+  * /metrics scrapes as Prometheus exposition with per-tenant labels on
+    the request counters.
+
+Prints ``SERVE_SMOKE=ok`` and exits 0 on success. Numpy backend: this is
+the policy/parity smoke; the batched device lane has its own bench arm
+(``bench.py --serve-only``).
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io import images as imio
+from structured_light_for_3d_model_replication_tpu.io import matfile
+from structured_light_for_3d_model_replication_tpu.pipeline import serving
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import faults
+from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+
+CAM, PROJ = (160, 120), (128, 64)
+STEPS = ("statistical",)
+TERMINAL = ("done", "degraded", "failed", "aborted")
+
+
+def render_scan(tgt: str, views: int, shift: float) -> None:
+    """Per-tenant satellite offset: EVERY view's bytes distinct across
+    tenants (identical bytes would dedup to one tenant's cache entry and
+    the faulted view would be served from the other tenant's warm work)."""
+    rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+    scene = syn.sphere_on_background()
+    obj, background = scene.objects
+    satellite = syn.Sphere(np.array([48.0 + shift, -92.0, 430.0]), 16.0)
+    step = 360.0 / views
+    pivot = np.array([0.0, 0.0, 420.0])
+    for i, (R, t) in enumerate(syn.turntable_poses(views, step, pivot)):
+        frames, _ = syn.render_scene(
+            rig, syn.Scene([obj.transformed(R, t),
+                            satellite.transformed(R, t), background]))
+        imio.save_stack(
+            os.path.join(tgt, f"scan_{int(round(i * step)):03d}deg_scan"),
+            frames)
+
+
+def make_cfg() -> Config:
+    cfg = Config()
+    cfg.parallel.backend = "numpy"
+    cfg.decode.n_cols, cfg.decode.n_rows = PROJ
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 512
+    cfg.merge.icp_iters = 10
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    cfg.serving.clean_steps = "statistical"
+    cfg.serving.host = "127.0.0.1"
+    cfg.serving.port = 0
+    return cfg
+
+
+def post_json(url: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200, r.status
+        return json.loads(r.read())
+
+
+def get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=30) as r:
+        assert r.status == 200, (url, r.status)
+        return r.read()
+
+
+def wait_terminal(base: str, sid: str, timeout_s: float = 300.0) -> dict:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        d = json.loads(get(f"{base}/status/{sid}"))
+        if d["state"] in TERMINAL:
+            return d
+        time.sleep(0.25)
+    raise TimeoutError(f"{sid} still {d['state']} after {timeout_s}s")
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="sl3d_serve_smoke_")
+    try:
+        rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+        calib = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(calib, rig.calibration())
+        # clean tenant: 2 views; faulty tenant: 3 views, so ONE faulted
+        # view still leaves it at the min_views floor -> DEGRADED, not
+        # the below-floor abort
+        tgt_clean = os.path.join(tmp, "in_tclean")
+        tgt_fault = os.path.join(tmp, "in_tfault")
+        os.makedirs(tgt_clean)
+        os.makedirs(tgt_fault)
+        render_scan(tgt_clean, views=2, shift=0.0)
+        render_scan(tgt_fault, views=3, shift=9.0)
+
+        # solo reference for the clean tenant (no faults armed)
+        solo = os.path.join(tmp, "solo")
+        rep = stages.run_pipeline(calib, tgt_clean, solo, cfg=make_cfg(),
+                                  steps=STEPS, log=lambda m: None)
+        assert rep.failed == [], rep.failed
+        print("[serve_smoke] solo reference done "
+              f"({rep.merged_points:,} points)")
+
+        cfg = make_cfg()
+        cfg.faults.spec = "compute.view~in_tfault/scan_000:permanent"
+        faults.configure_from(cfg.faults)
+        httpd, svc = serving.start_gateway(os.path.join(tmp, "svc"),
+                                           cfg=cfg, log=lambda m: None)
+        th = threading.Thread(target=httpd.serve_forever,
+                              kwargs={"poll_interval": 0.1}, daemon=True)
+        th.start()
+        base = (f"http://{httpd.server_address[0]}:"
+                f"{httpd.server_address[1]}")
+        print(f"[serve_smoke] gateway up at {base}")
+        try:
+            sid_c = post_json(f"{base}/submit",
+                              {"tenant": "tclean", "target": tgt_clean,
+                               "calib": calib})["scan_id"]
+            sid_f = post_json(f"{base}/submit",
+                              {"tenant": "tfault", "target": tgt_fault,
+                               "calib": calib})["scan_id"]
+            st_c = wait_terminal(base, sid_c)
+            st_f = wait_terminal(base, sid_f)
+            print(f"[serve_smoke] {sid_c}: {st_c['state']}; "
+                  f"{sid_f}: {st_f['state']}")
+            assert st_c["state"] == "done", st_c
+            assert st_f["state"] == "degraded", st_f
+
+            ply = get(f"{base}/result/{sid_c}?artifact=ply")
+            stl = get(f"{base}/result/{sid_c}?artifact=stl")
+            with open(os.path.join(solo, "merged.ply"), "rb") as f:
+                ply_ok = f.read() == ply
+            with open(os.path.join(solo, "model.stl"), "rb") as f:
+                stl_ok = f.read() == stl
+            print(f"[serve_smoke] clean-tenant parity: ply={ply_ok} "
+                  f"stl={stl_ok}")
+            assert ply_ok and stl_ok, "clean tenant diverged from solo run"
+            # the degraded tenant still ships a (reduced) result
+            assert get(f"{base}/result/{sid_f}?artifact=ply")
+
+            text = get(f"{base}/metrics").decode()
+            for needle in (
+                    'sl3d_serve_requests_total{state="done",'
+                    'tenant="tclean"} 1',
+                    'sl3d_serve_requests_total{state="degraded",'
+                    'tenant="tfault"} 1',
+                    'sl3d_serve_views_warmed_total{tenant="tclean"}',
+                    'sl3d_serve_view_failures_total{tenant="tfault"}',
+            ):
+                assert needle in text, f"metrics missing: {needle}"
+            print("[serve_smoke] /metrics exposes per-tenant counters")
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            svc.close()
+            faults.reset()
+        print("SERVE_SMOKE=ok")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
