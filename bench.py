@@ -1415,6 +1415,12 @@ def bench_serve(tenants: int = 3, scans_per_tenant: int = 2,
     against a solo ``run_pipeline`` of the same input (the PR-8
     construction carried to serving).
 
+    Durability A/B (ISSUE 13): the cross arm (``serving.durable`` on —
+    every accepted submit fsyncs a request record before its 202) is
+    re-run with durability off; ``durability_overhead_x`` is the on/off
+    wall ratio and the contract is <= 1.02x. The off arm runs last, so
+    compile-cache warmth can only inflate the ratio (conservative).
+
     REQUIRES jax (the batched lane needs a device scanner) — runs under
     ``--serve-only`` (CPU-pinned unless the caller chose a platform) or
     the ``_run_serve_child`` subprocess from ``--pipeline-only``. The
@@ -1489,7 +1495,7 @@ def bench_serve(tenants: int = 3, scans_per_tenant: int = 2,
                 entries.append({"target": tgt, "calib": calib_path})
             manifest["tenants"][name] = entries
 
-        def mkcfg(max_active: int) -> Config:
+        def mkcfg(max_active: int, durable: bool = True) -> Config:
             c = Config()
             c.decode.n_cols, c.decode.n_rows = PIPE_PROJ
             c.decode.thresh_mode = "manual"
@@ -1503,12 +1509,15 @@ def bench_serve(tenants: int = 3, scans_per_tenant: int = 2,
             c.serving.host = "127.0.0.1"
             c.serving.port = 0
             c.serving.max_active_scans = max_active
+            c.serving.durable = durable
             return c
 
-        def run_arm(tag: str, max_active: int) -> tuple[dict, dict]:
+        def run_arm(tag: str, max_active: int,
+                    durable: bool = True) -> tuple[dict, dict]:
             root = os.path.join(tmp, f"svc_{tag}")
             httpd, svc = serving.start_gateway(
-                root, cfg=mkcfg(max_active), log=lambda m: None)
+                root, cfg=mkcfg(max_active, durable=durable),
+                log=lambda m: None)
             th = threading.Thread(target=httpd.serve_forever,
                                   kwargs={"poll_interval": 0.1},
                                   daemon=True)
@@ -1532,6 +1541,23 @@ def bench_serve(tenants: int = 3, scans_per_tenant: int = 2,
 
         out["single"], _ = run_arm("single", max_active=1)
         out["cross"], jobs = run_arm("cross", max_active=tenants)
+
+        # ---- durability overhead A/B (ISSUE 13): the cross arm IS the
+        # durable-on sample (serving.durable defaults on); re-run the
+        # same load with request records disabled. The off arm runs LAST,
+        # inheriting the warmest compile cache — any bias makes the
+        # on/off ratio LARGER, so the <= 1.02x contract is conservative.
+        out["durable_off"], _ = run_arm("doff", max_active=tenants,
+                                        durable=False)
+        wall_on = out["cross"].get("wall_s")
+        wall_off = out["durable_off"].get("wall_s")
+        if wall_on and wall_off:
+            out["durability_overhead_x"] = round(wall_on / wall_off, 3)
+            out["durability_overhead_ok"] = (
+                out["durability_overhead_x"] <= 1.02)
+        else:
+            out["durability_overhead_x"] = None
+            out["durability_overhead_ok"] = None
 
         fill_c = out["cross"].get("mean_views_per_launch")
         fill_s = out["single"].get("mean_views_per_launch")
@@ -2495,7 +2521,7 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--serve-only" in sys.argv[1:]:
         # standalone record of the multi-tenant serving A/B: one JSON line
-        # on stdout, plus BENCH_SERVE_r01.json in the repo root (skipped
+        # on stdout, plus BENCH_SERVE_r02.json in the repo root (skipped
         # with --no-record, which the --pipeline-only child passes). This
         # arm REQUIRES jax (the cross-tenant fill contract lives in the
         # batched engine lane); pins itself to CPU unless the caller
@@ -2533,7 +2559,7 @@ if __name__ == "__main__":
             )
 
             line.setdefault("run_id", _tel.new_run_id())
-            with open(os.path.join(ROOT, "BENCH_SERVE_r01.json"),
+            with open(os.path.join(ROOT, "BENCH_SERVE_r02.json"),
                       "w") as f:
                 json.dump(line, f, indent=2, sort_keys=True)
                 f.write("\n")

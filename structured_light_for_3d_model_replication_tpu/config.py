@@ -451,6 +451,28 @@ class ServingConfig:
     clean_steps: str = "background,cluster,radius,statistical"
     # gateway idle poll cadence for the admit/sweep loop (sec)
     poll_s: float = 0.05
+    # durable requests: every accepted /submit is persisted as a crash-
+    # safe request record (atomic write + fsync BEFORE the response) and
+    # replayed together with ledger.jsonl on start() — a restarted
+    # service resumes every non-terminal scan with zero recompute of
+    # ledger-credited views. False = PR-12 in-memory behaviour
+    durable: bool = True
+    # graceful-stop budget (sec): on SIGTERM/SIGINT the service drains —
+    # new submits get 503 + Retry-After, active scans get this long to
+    # finish; past it, in-flight assemblies are aborted mid-stage and
+    # CHECKPOINTED (non-terminal, resumed by the next start)
+    drain_budget_s: float = 30.0
+    # overload shedding: a queued scan whose wait exceeds this is shed
+    # (503 + ``shed`` ledger event) BEFORE it burns engine time it can
+    # no longer use; 0 = off. Scans with a per-request budget_s are
+    # additionally shed once that budget is already exhausted in queue
+    max_queue_wait_s: float = 0.0
+    # per-tenant circuit breaker: this many CONSECUTIVE failed/aborted
+    # scans opens the breaker (submits fast-fail 503 + Retry-After);
+    # after breaker_cooldown_s one half-open probe scan is admitted and
+    # its outcome closes or re-opens the breaker. 0 = disabled
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 30.0
 
 
 @dataclass

@@ -25,6 +25,16 @@ admission and item grants pick the eligible tenant with the lowest
 virtual time — a weight-3 tenant gets 3x the grant rate of a weight-1
 tenant under contention and exactly its demand otherwise.
 
+Durability policy (ISSUE 13) also lives here: overload shedding (a
+queued scan whose wait already blew its SLO — or the service-wide
+``max_queue_wait_s`` — is shed with a ``shed`` ledger event BEFORE it
+wastes engine time), a per-tenant circuit breaker (N consecutive
+failed/aborted scans open it; submits fast-fail with a retry hint until
+a half-open probe closes it), and ``replay_serving`` — the serving-level
+ledger fold that a restarted gateway resumes from: per-scan last state,
+the union of completed item ids, and each tenant's consecutive-failure
+streak so breakers survive restarts too.
+
 No HTTP, no device code, no stages import — policy stays unit-testable
 with fake items, the way ``lease.py`` keeps expiry testable with a fake
 clock.
@@ -32,21 +42,29 @@ clock.
 from __future__ import annotations
 
 import itertools
+import json
+import os
 import threading
 import time
 
 from structured_light_for_3d_model_replication_tpu.parallel.coordinator import (
+    LEDGER_SCHEMA,
     Ledger,
 )
 from structured_light_for_3d_model_replication_tpu.parallel.lease import (
     LeaseTable,
 )
 
-__all__ = ["ScanJob", "AdmissionController"]
+__all__ = ["ScanJob", "AdmissionController", "replay_serving", "TERMINAL"]
 
 # scan lifecycle (the request's /status surface):
 #   queued -> admitted -> warmed -> assembling -> done|degraded|failed|aborted
-_TERMINAL = ("done", "degraded", "failed", "aborted", "rejected")
+# plus two durability states: ``shed`` (terminal — dropped from the queue
+# before starting, it could no longer meet its SLO) and ``checkpointed``
+# (NON-terminal — parked by a drain-budget breach; the next start()
+# replays it back to queued with its warmed views already cached)
+_TERMINAL = ("done", "degraded", "failed", "aborted", "rejected", "shed")
+TERMINAL = _TERMINAL
 
 
 class ScanJob:
@@ -105,12 +123,27 @@ class _Item:
         self.state = "pending"      # pending -> granted -> done|failed
 
 
+class _Breaker:
+    """One tenant's circuit-breaker state. closed: ``opened_at is None``;
+    open: set to the monotonic open time; half-open: open past cooldown
+    with ``probe`` holding the single in-flight probe scan_id."""
+
+    __slots__ = ("fails", "opened_at", "probe")
+
+    def __init__(self):
+        self.fails = 0          # consecutive failed/aborted finishes
+        self.opened_at: float | None = None
+        self.probe: str | None = None
+
+
 class AdmissionController:
     """Quotas + weighted-fair scheduling over the multi-scan ledger."""
 
     def __init__(self, ledger_path: str, run_id: str, lease_s: float = 30.0,
                  max_active_scans: int = 4, tenant_active_quota: int = 2,
                  tenant_queue_quota: int = 8, queue_depth: int = 64,
+                 max_queue_wait_s: float = 0.0, breaker_threshold: int = 0,
+                 breaker_cooldown_s: float = 30.0, clock=time.monotonic,
                  log=print):
         self.lock = threading.RLock()
         self.log = log
@@ -118,6 +151,10 @@ class AdmissionController:
         self.tenant_active_quota = int(tenant_active_quota)
         self.tenant_queue_quota = int(tenant_queue_quota)
         self.queue_depth = int(queue_depth)
+        self.max_queue_wait_s = float(max_queue_wait_s)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self._clock = clock                      # injectable for tests
         self.leases = LeaseTable(lease_s)
         self.ledger = Ledger(ledger_path, run_id, meta={"mode": "serving"})
         self.jobs: dict[str, ScanJob] = {}       # scan_id -> job
@@ -125,30 +162,121 @@ class AdmissionController:
         self.items: dict[str, _Item] = {}        # item id -> item
         self._scan_items: dict[str, list[str]] = {}
         self._vtime: dict[str, float] = {}       # tenant -> virtual time
+        self._breakers: dict[str, _Breaker] = {}
         self._seq = itertools.count(1)
 
     # ---- submit / quotas -------------------------------------------------
 
-    def submit(self, job: ScanJob) -> tuple[bool, str]:
+    def submit(self, job: ScanJob, persist=None) -> tuple[bool, dict]:
         """Admit-or-reject at the door. Over-quota submissions are refused
-        with a reason (the gateway's 429), never silently queued — a
-        rejected request costs the service nothing."""
+        with a machine-readable ``reason`` (the gateway's 429/503), never
+        silently queued — a rejected request costs the service nothing.
+        ``persist``, when given, runs AFTER every check passes and BEFORE
+        the scan is journaled or queued (the durable-record write: if it
+        raises, nothing was admitted and the caller can 503-retry)."""
         with self.lock:
+            allowed, info, is_probe = self._breaker_check(job.tenant)
+            if not allowed:
+                return False, info
             queued = [j for j in self.jobs.values() if j.state == "queued"]
             if len(queued) >= self.queue_depth:
-                return False, (f"service queue full "
-                               f"({self.queue_depth} queued)")
+                return False, {"reason": "queue-full",
+                               "error": (f"service queue full "
+                                         f"({self.queue_depth} queued)")}
             t_queued = sum(1 for j in queued if j.tenant == job.tenant)
             if t_queued >= self.tenant_queue_quota:
-                return False, (f"tenant {job.tenant!r} queue quota reached "
-                               f"({self.tenant_queue_quota})")
+                return False, {"reason": "tenant-queue-quota",
+                               "error": (f"tenant {job.tenant!r} queue "
+                                         f"quota reached "
+                                         f"({self.tenant_queue_quota})")}
+            if persist is not None:
+                persist(job)
+            # journal BEFORE any in-memory mutation: a failed append
+            # (full disk, injected transient) leaves nothing admitted, so
+            # the caller's "retry" answer is actually true
+            self.ledger.event("submit", scan=job.scan_id, tenant=job.tenant,
+                              target=job.target, calib=job.calib,
+                              out_dir=job.out_dir, weight=job.weight,
+                              budget_s=job.budget_s)
             self.jobs[job.scan_id] = job
             self.queue.append(job.scan_id)
             self._vtime.setdefault(job.tenant, self._min_vtime())
-            self.ledger.event("submit", scan=job.scan_id, tenant=job.tenant,
-                              target=job.target, weight=job.weight,
-                              budget_s=job.budget_s)
-        return True, "queued"
+            if is_probe:
+                self._breakers[job.tenant].probe = job.scan_id
+                self.ledger.event("breaker-probe", scan=job.scan_id,
+                                  tenant=job.tenant)
+        return True, {"reason": "queued"}
+
+    # ---- circuit breaker -------------------------------------------------
+
+    def _breaker_check(self, tenant: str) -> tuple[bool, dict, bool]:
+        """(allowed, rejection-info, is_half_open_probe). Caller holds the
+        lock. An open breaker fast-fails submits with the cooldown
+        remainder as the retry hint; once cooled down, exactly ONE probe
+        scan is let through and its outcome closes or re-opens."""
+        if self.breaker_threshold <= 0:
+            return True, {}, False
+        b = self._breakers.get(tenant)
+        if b is None or b.opened_at is None:
+            return True, {}, False
+        waited = self._clock() - b.opened_at
+        if waited < self.breaker_cooldown_s:
+            rem = self.breaker_cooldown_s - waited
+            return False, {"reason": "circuit-open",
+                           "retry_after_s": round(max(0.001, rem), 3),
+                           "error": (f"tenant {tenant!r} circuit open "
+                                     f"({b.fails} consecutive failures); "
+                                     f"retry in {rem:.1f}s")}, False
+        if b.probe is not None:
+            return False, {"reason": "circuit-open",
+                           "retry_after_s": round(self.breaker_cooldown_s,
+                                                  3),
+                           "error": (f"tenant {tenant!r} circuit half-open"
+                                     f"; probe {b.probe!r} in flight")}, \
+                False
+        return True, {}, True
+
+    def _breaker_record(self, job: ScanJob, state: str) -> None:
+        """Fold one terminal outcome into the tenant's breaker. Caller
+        holds the lock. Shed/checkpointed scans never count — they carry
+        no evidence about the tenant's inputs."""
+        if self.breaker_threshold <= 0:
+            return
+        b = self._breakers.setdefault(job.tenant, _Breaker())
+        if state in ("done", "degraded"):
+            b.fails = 0
+            if b.opened_at is not None:
+                b.opened_at = None
+                b.probe = None
+                self.ledger.event("breaker-close", tenant=job.tenant,
+                                  scan=job.scan_id)
+        elif state in ("failed", "aborted"):
+            if b.opened_at is not None and b.probe == job.scan_id:
+                b.probe = None
+                b.opened_at = self._clock()
+                self.ledger.event("breaker-open", tenant=job.tenant,
+                                  scan=job.scan_id, reason="probe-failed",
+                                  fails=b.fails)
+            else:
+                b.fails += 1
+                if (b.opened_at is None
+                        and b.fails >= self.breaker_threshold):
+                    b.opened_at = self._clock()
+                    self.ledger.event("breaker-open", tenant=job.tenant,
+                                      scan=job.scan_id, fails=b.fails)
+
+    def restore_breaker(self, tenant: str, fails: int) -> None:
+        """Re-arm a tenant's breaker from a replayed failure streak (a
+        restart must not grant a broken tenant a fresh threshold)."""
+        if self.breaker_threshold <= 0 or fails <= 0:
+            return
+        with self.lock:
+            b = self._breakers.setdefault(tenant, _Breaker())
+            b.fails = int(fails)
+            if b.fails >= self.breaker_threshold and b.opened_at is None:
+                b.opened_at = self._clock()
+                self.ledger.event("breaker-open", tenant=tenant,
+                                  fails=b.fails, reason="restored")
 
     def _min_vtime(self) -> float:
         """New tenants join at the floor of current virtual time so they
@@ -323,9 +451,86 @@ class AdmissionController:
                 job.report = report
             for iid in self._scan_items.pop(scan_id, []):
                 self.items.pop(iid, None)
+            # the report summary rides the finish event so a restarted
+            # service can serve /status for already-terminal scans
+            # straight from the replayed ledger
             self.ledger.event("finish", scan=scan_id, tenant=job.tenant,
                               state=state, error=str(error)[:500],
-                              elapsed_s=round(job.elapsed_s(), 3))
+                              elapsed_s=round(job.elapsed_s(), 3),
+                              report=job.report or {})
+            self._breaker_record(job, state)
+
+    def checkpoint(self, scan_id: str, reason: str = "drain") -> bool:
+        """Park a non-terminal scan at drain time: its items are dropped
+        (warmed views live on in the stage cache — that work is kept),
+        the state goes CHECKPOINTED, and the journaled event tells the
+        next start() to replay it back into the queue."""
+        with self.lock:
+            job = self.jobs.get(scan_id)
+            if job is None or job.state in _TERMINAL:
+                return False
+            if scan_id in self.queue:
+                self.queue.remove(scan_id)
+            job.state = "checkpointed"
+            job.error = reason
+            for iid in self._scan_items.pop(scan_id, []):
+                self.items.pop(iid, None)
+            self.ledger.event("checkpoint", scan=scan_id,
+                              tenant=job.tenant, reason=reason)
+            return True
+
+    def shed_expired(self) -> list[ScanJob]:
+        """Drop queued scans that can no longer start usefully: their SLO
+        budget is already gone, or they out-waited ``max_queue_wait_s``.
+        Shedding at the queue head is the overload valve — a scan that
+        would only burn engine time to abort later is refused work NOW,
+        while the client can still retry elsewhere."""
+        out: list[ScanJob] = []
+        with self.lock:
+            for sid in list(self.queue):
+                job = self.jobs[sid]
+                wait = job.elapsed_s()
+                rem = job.budget_remaining()
+                if rem is not None and rem <= 0:
+                    reason = (f"queue wait {wait:.1f}s consumed the "
+                              f"{job.budget_s:g}s SLO budget")
+                elif 0 < self.max_queue_wait_s < wait:
+                    reason = (f"queue wait {wait:.1f}s exceeded "
+                              f"max_queue_wait_s="
+                              f"{self.max_queue_wait_s:g}")
+                else:
+                    continue
+                self.queue.remove(sid)
+                job.state = "shed"
+                job.error = reason
+                job.finished_mono = time.monotonic()
+                self.ledger.event("shed", scan=sid, tenant=job.tenant,
+                                  reason=reason, wait_s=round(wait, 3))
+                out.append(job)
+        return out
+
+    # ---- restart-resume --------------------------------------------------
+
+    def restore(self, job: ScanJob) -> None:
+        """Re-enqueue a replayed non-terminal scan, bypassing the door
+        quotas — a previous incarnation of the service already accepted
+        (and journaled) it; refusing it now would break the 202 the
+        client holds. Journals a ``resume`` event so the ledger reads as
+        the scan's full history across process generations."""
+        with self.lock:
+            job.state = "queued"
+            self.jobs[job.scan_id] = job
+            self.queue.append(job.scan_id)
+            self._vtime.setdefault(job.tenant, self._min_vtime())
+            self.ledger.event("resume", scan=job.scan_id,
+                              tenant=job.tenant)
+
+    def restore_terminal(self, job: ScanJob) -> None:
+        """Re-register an already-terminal scan (state set by the caller
+        from the replayed ledger) so /status and /result keep answering
+        across restarts. Nothing to journal — nothing changed."""
+        with self.lock:
+            self.jobs[job.scan_id] = job
 
     def snapshot(self) -> dict:
         with self.lock:
@@ -341,3 +546,101 @@ class AdmissionController:
 
     def close(self) -> None:
         self.ledger.close()
+
+
+def replay_serving(path: str) -> dict:
+    """Fold a serving ledger into restart-resume state. Torn-tail
+    tolerant like :meth:`Ledger.replay` (a crash mid-append loses at most
+    the line being written), and a superset of it: besides the union of
+    completed item ids this folds each scan's LAST journaled state —
+    submit → queued, admit → admitted, warmed, finish → its terminal
+    state (with error/report), shed, checkpoint → checkpointed, resume →
+    queued again — plus each tenant's consecutive failed/aborted streak,
+    so circuit breakers survive restarts. Returns::
+
+        {"scans": {scan_id: {"tenant", "state", "target", "calib",
+                             "out_dir", "weight", "budget_s",
+                             "submitted_unix", "error", "report",
+                             "elapsed_s"}},
+         "completed": set[item_id], "tenant_fails": {tenant: int},
+         "segments": int, "events": int}
+    """
+    scans: dict[str, dict] = {}
+    completed: set[str] = set()
+    tenant_fails: dict[str, int] = {}
+    segments = events = 0
+    if not os.path.exists(path):
+        return {"scans": scans, "completed": completed,
+                "tenant_fails": tenant_fails, "segments": 0, "events": 0}
+
+    def rec_for(rec: dict) -> dict:
+        sid = rec["scan"]
+        r = scans.get(sid)
+        if r is None:
+            r = scans[sid] = {"tenant": rec.get("tenant", ""),
+                              "state": "queued", "target": "",
+                              "calib": "", "out_dir": "", "weight": 1.0,
+                              "budget_s": 0.0, "submitted_unix": 0.0,
+                              "error": "", "report": {},
+                              "elapsed_s": 0.0}
+        return r
+
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue        # torn tail from a crash mid-append
+            t = ev.get("type")
+            if t == "meta":
+                if ev.get("schema") != LEDGER_SCHEMA:
+                    raise ValueError(
+                        f"ledger {path}: unknown schema "
+                        f"{ev.get('schema')!r} (want {LEDGER_SCHEMA})")
+                segments += 1
+                continue
+            events += 1
+            if t == "complete":
+                completed.add(ev["item"])
+                continue
+            if "scan" not in ev:
+                continue
+            if t == "submit":
+                r = rec_for(ev)
+                r.update(state="queued",
+                         target=ev.get("target", ""),
+                         calib=ev.get("calib", ""),
+                         out_dir=ev.get("out_dir", ""),
+                         weight=float(ev.get("weight", 1.0)),
+                         budget_s=float(ev.get("budget_s", 0.0)),
+                         submitted_unix=float(ev.get("t", 0.0)))
+            elif t == "admit":
+                rec_for(ev)["state"] = "admitted"
+            elif t == "warmed":
+                rec_for(ev)["state"] = "warmed"
+            elif t == "finish":
+                r = rec_for(ev)
+                r.update(state=ev.get("state", "failed"),
+                         error=ev.get("error", ""),
+                         report=ev.get("report") or {},
+                         elapsed_s=float(ev.get("elapsed_s", 0.0)))
+            elif t == "shed":
+                r = rec_for(ev)
+                r.update(state="shed", error=ev.get("reason", ""))
+            elif t == "checkpoint":
+                rec_for(ev)["state"] = "checkpointed"
+            elif t == "resume":
+                rec_for(ev)["state"] = "queued"
+            if t in ("finish", "shed"):
+                tenant = ev.get("tenant", "")
+                st = ev.get("state", "shed" if t == "shed" else "")
+                if st in ("failed", "aborted"):
+                    tenant_fails[tenant] = tenant_fails.get(tenant, 0) + 1
+                elif st in ("done", "degraded"):
+                    tenant_fails[tenant] = 0
+    return {"scans": scans, "completed": completed,
+            "tenant_fails": tenant_fails, "segments": segments,
+            "events": events}

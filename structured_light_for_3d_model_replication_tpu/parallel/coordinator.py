@@ -87,6 +87,10 @@ class Ledger:
             os.fsync(self._f.fileno())
 
     def event(self, type_: str, **fields) -> None:
+        # chaos hook BEFORE the append: a crash here loses the event (the
+        # torn-tail / lost-line case replay must tolerate), a transient
+        # here surfaces to the caller exactly like a full-disk write
+        faults.fire("ledger.append", item=type_)
         rec = {"type": type_, "t": round(time.time(), 6)}
         rec.update(fields)
         self._append(rec)

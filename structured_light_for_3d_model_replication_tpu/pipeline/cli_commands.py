@@ -342,9 +342,12 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
         help="persistent multi-tenant scan service: POST /submit scan "
              "requests, cross-tenant batched warming on one device mesh, "
              "per-request SLOs, per-tenant quotas, Prometheus /metrics; "
-             "every result byte-identical to a solo `sl3d pipeline` run")
+             "every result byte-identical to a solo `sl3d pipeline` run. "
+             "Durable: accepted requests survive kill -9 (restart over "
+             "the same root resumes them); SIGTERM/SIGINT drain")
     p.add_argument("root", help="service state directory (scans/, shared "
-                                "stage cache, ledger.jsonl, serve.json)")
+                                "stage cache, ledger.jsonl, requests/, "
+                                "serve.json)")
     p.add_argument("--host", default=None,
                    help="bind address (default: serving.host)")
     p.add_argument("--port", type=int, default=None,
@@ -352,6 +355,10 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("--max-active-scans", type=int, default=None,
                    help="scans admitted to the engine at once "
                         "(default: serving.max_active_scans)")
+    p.add_argument("--drain-budget", type=float, default=None,
+                   help="seconds active scans get to finish after "
+                        "SIGTERM before being checkpointed for the next "
+                        "start (default: serving.drain_budget_s)")
     p.add_argument("--ready-file", default=None,
                    help="also write the bound-address JSON here once "
                         "listening (CI/loadgen discovery handshake)")
@@ -800,6 +807,8 @@ def _cmd_serve(args) -> int:
         cfg.serving.port = args.port
     if args.max_active_scans is not None:
         cfg.serving.max_active_scans = args.max_active_scans
+    if args.drain_budget is not None:
+        cfg.serving.drain_budget_s = args.drain_budget
     return serving.serve(args.root, cfg=cfg,
                          ready_file=args.ready_file)
 

@@ -41,6 +41,42 @@ while ``TenantCache`` ref-marker namespaces keep eviction and listing
 per-tenant — evicting tenant A never deletes a payload tenant B still
 references, and outputs never alias because every request owns its
 ``out_dir``.
+
+Durability (ISSUE 13) — the service state outlives the process:
+
+  records   every accepted ``/submit`` is persisted FIRST as a request
+            record (``<root>/requests/<scan_id>.json``, schema
+            ``sl3d-request-v1``, atomic write + fsync) and only then
+            journaled/queued/202'd — a crash at any point leaves either
+            no trace (client retries) or a resumable record.
+  resume    ``start()`` sweeps torn ``.tmp`` records, folds
+            ``ledger.jsonl`` through ``replay_serving``, re-registers
+            terminal scans (so /status and /result keep answering) and
+            re-queues every non-terminal one. Ledger-credited views are
+            already bytes in the content-addressed cache, so a restarted
+            service re-plans them as WARM: zero recompute, and the
+            served PLY/STL stays byte-identical to an uninterrupted run
+            (the PR-8 parity construction carried across process death).
+            Client-supplied scan_ids are durably idempotent: the same
+            (tenant, target, calib) re-submitted after a crash returns
+            the existing request, a different one is a 409 conflict.
+  lifecycle ``phase``: ready → draining → stopped. SIGTERM/SIGINT (and
+            ``stop()``) drain: new submits 503 with Retry-After, active
+            scans get ``serving.drain_budget_s`` to finish; past the
+            budget the in-flight assembly is aborted through the PR-7
+            run-budget lever (``RunContext.abort`` → failures.json) and
+            the scan is CHECKPOINTED — non-terminal, re-queued by the
+            next start with its warmed views still cached.
+  overload  ``shed_expired`` drops queued scans that already blew their
+            SLO (or ``serving.max_queue_wait_s``) with a ``shed`` ledger
+            event before they waste engine time; a per-tenant circuit
+            breaker fast-fails a tenant whose scans keep failing until a
+            half-open probe proves recovery.
+  chaos     ``serve.crash`` fires at the grant / complete / assembly
+            boundaries, ``ledger.append`` on every journal line,
+            ``http.submit`` in the gateway — the kill→restart matrix in
+            ``tools/soak.py`` and the SERVE_CHAOS_SMOKE CI arm drive
+            them end to end.
 """
 from __future__ import annotations
 
@@ -48,6 +84,8 @@ import copy
 import json
 import os
 import re
+import signal
+import sys
 import threading
 import time
 import urllib.parse
@@ -56,9 +94,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io.atomic import (
+    atomic_write,
+    sweep_tmp,
+)
 from structured_light_for_3d_model_replication_tpu.parallel.admission import (
     AdmissionController,
     ScanJob,
+    replay_serving,
+)
+from structured_light_for_3d_model_replication_tpu.parallel.admission import (
+    TERMINAL as _TERMINAL,
 )
 from structured_light_for_3d_model_replication_tpu.pipeline.stagecache import (
     TenantCache,
@@ -71,9 +117,21 @@ from structured_light_for_3d_model_replication_tpu.utils import (
     telemetry as tel,
 )
 
-__all__ = ["ScanService", "serve", "start_gateway"]
+__all__ = ["ScanService", "serve", "start_gateway", "REQUEST_SCHEMA"]
 
 _ID_RE = re.compile(r"[^A-Za-z0-9._-]+")
+_AUTO_ID_RE = re.compile(r"-s(\d{4,})$")
+
+REQUEST_SCHEMA = "sl3d-request-v1"
+
+# machine-readable /submit rejection reasons -> HTTP status. 429 =
+# per-tenant/backlog quota (client backs off and retries), 503 =
+# service-side refusal (draining, open breaker, injected transient —
+# retry after Retry-After), 409 = durable-id conflict, 400 = malformed
+_REASON_HTTP = {"tenant-queue-quota": 429, "queue-full": 429,
+                "draining": 503, "stopped": 503, "crashed": 503,
+                "circuit-open": 503, "transient": 503,
+                "scan-id-conflict": 409, "bad-request": 400}
 
 
 def _safe_id(s: str, fallback: str) -> str:
@@ -117,8 +175,10 @@ class ScanService:
         self.scans_dir = os.path.join(self.root, "scans")
         self.store_root = os.path.join(self.root, "cache")
         self.ns_root = os.path.join(self.root, "cache-ns")
+        self.requests_dir = os.path.join(self.root, "requests")
         os.makedirs(self.scans_dir, exist_ok=True)
         os.makedirs(self.store_root, exist_ok=True)
+        os.makedirs(self.requests_dir, exist_ok=True)
         self.run_id = tel.new_run_id()
         self.registry = tel.MetricsRegistry()
         scfg = self.cfg.serving
@@ -127,7 +187,18 @@ class ScanService:
             lease_s=scfg.lease_s, max_active_scans=scfg.max_active_scans,
             tenant_active_quota=scfg.tenant_active_quota,
             tenant_queue_quota=scfg.tenant_queue_quota,
-            queue_depth=scfg.queue_depth, log=log)
+            queue_depth=scfg.queue_depth,
+            max_queue_wait_s=scfg.max_queue_wait_s,
+            breaker_threshold=scfg.breaker_threshold,
+            breaker_cooldown_s=scfg.breaker_cooldown_s, log=log)
+        # lifecycle phase: ready -> draining -> stopped (crashed when an
+        # injected crash felled the in-process service). A bare
+        # ScanService accepts submits from construction (tests drive it
+        # without start()); only drain/stop flips the gate
+        self.phase = "ready"
+        self._draining = threading.Event()   # admit_next gate
+        self._drain_breach = threading.Event()
+        self.exit_on_crash = False           # serve() sets True: real exit
         self._stages = stages
         self._policy = stages._retry_policy(self.cfg)
         self._fwd_kw = dict(thresh_mode=self.cfg.decode.thresh_mode,
@@ -147,6 +218,8 @@ class ScanService:
 
     def start(self) -> None:
         scfg = self.cfg.serving
+        if scfg.durable:
+            self._resume()
         for i in range(max(1, scfg.engine_lanes)):
             t = threading.Thread(target=self._engine_loop,
                                  args=(f"lane{i}",),
@@ -159,6 +232,137 @@ class ScanService:
         self._threads.append(t)
         self.log(f"[serve] service up (run {self.run_id}) root={self.root}")
 
+    def _resume(self) -> None:
+        """Restart-resume: request records + ledger replay → the queue a
+        previous incarnation left behind. Terminal scans come back as
+        /status-able history; everything else re-queues. The warmed views
+        of a resumed scan are already bytes in the content-addressed
+        cache, so ``_plan`` sees them as cache hits — zero recompute of
+        ledger-credited work, byte parity by the PR-8 construction."""
+        swept = sweep_tmp(self.requests_dir)
+        if swept:
+            self.log(f"[serve] swept {len(swept)} torn request record(s)")
+        rs = replay_serving(self.adm.ledger.path)
+        records: list[dict] = []
+        torn = 0
+        for fn in sorted(os.listdir(self.requests_dir)):
+            if not fn.endswith(".json"):
+                continue
+            path = os.path.join(self.requests_dir, fn)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    rec = json.load(f)
+                if (rec.get("schema") != REQUEST_SCHEMA
+                        or not rec.get("scan_id") or not rec.get("calib")):
+                    raise ValueError("missing fields")
+            except (ValueError, OSError) as e:
+                # torn/garbled record: tolerated, never resumed — the
+                # fsync-before-202 ordering means its client never got
+                # an accept to hold us to
+                torn += 1
+                self.log(f"[serve] skipping unreadable request record "
+                         f"{fn}: {e}")
+                continue
+            records.append(rec)
+        records.sort(key=lambda r: (r.get("submitted_unix", 0.0),
+                                    r["scan_id"]))
+        now_mono, now_unix = time.monotonic(), time.time()
+        n_term = n_res = 0
+        for rec in records:
+            sid = rec["scan_id"]
+            job = ScanJob(sid, rec.get("tenant", "anon"), rec["target"],
+                          rec["calib"],
+                          rec.get("out_dir",
+                                  os.path.join(self.scans_dir, sid)),
+                          weight=rec.get("weight", 1.0),
+                          budget_s=rec.get("budget_s", 0.0))
+            # re-base the SLO clock to true wall time since the original
+            # submit: a crash does not stop a client's deadline
+            job.submitted_unix = rec.get("submitted_unix", now_unix)
+            job.submitted_mono = now_mono - max(
+                0.0, now_unix - job.submitted_unix)
+            m = _AUTO_ID_RE.search(sid)
+            if m:        # keep auto scan ids collision-free across runs
+                with self._seq_lock:
+                    self._seq = max(self._seq, int(m.group(1)))
+            led = rs["scans"].get(sid)
+            if led is not None and led["state"] in _TERMINAL:
+                job.state = led["state"]
+                job.error = led["error"]
+                job.report = led["report"]
+                job.finished_mono = job.submitted_mono + led["elapsed_s"]
+                self.adm.restore_terminal(job)
+                n_term += 1
+            else:
+                self.adm.restore(job)
+                n_res += 1
+        for tenant, fails in rs["tenant_fails"].items():
+            self.adm.restore_breaker(tenant, fails)
+        self.registry.inc("sl3d_serve_resumed_total", n_res)
+        if records or torn:
+            self.log(f"[serve] resume: {n_res} scan(s) re-queued, "
+                     f"{n_term} terminal restored, {torn} torn record(s) "
+                     f"skipped ({rs['segments']} ledger segment(s), "
+                     f"{len(rs['completed'])} credited item(s))")
+
+    def drain(self, budget_s: float | None = None) -> dict:
+        """Graceful drain: stop admitting, let active scans finish within
+        the budget, then abort-and-checkpoint whatever is still running
+        (the PR-7 ``RunContext.abort`` lever — the in-flight assembly
+        exits through its normal DeadlineExceeded path, failures.json
+        included, and the scan parks as CHECKPOINTED for the next
+        start). Returns {"finished": n, "checkpointed": [scan_ids]}."""
+        scfg = self.cfg.serving
+        budget = scfg.drain_budget_s if budget_s is None else budget_s
+        self.phase = "draining"
+        self._draining.set()
+        try:
+            self.adm.ledger.event("drain", budget_s=budget)
+        except Exception:
+            pass
+        t_end = time.monotonic() + max(0.0, budget)
+
+        def active():
+            with self.adm.lock:
+                return [j for j in self.adm.jobs.values()
+                        if j.state in ("admitted", "warmed", "assembling")]
+
+        while active() and time.monotonic() < t_end:
+            time.sleep(0.05)
+        left = active()
+        checkpointed: list[str] = []
+        if left:
+            self._drain_breach.set()
+            ctx = dl.current()
+            if ctx is not None:
+                ctx.abort("drain budget exceeded")
+            # the aborted assembly settles through _assemble (which sees
+            # _drain_breach and checkpoints); give it a bounded window
+            t_stop = time.monotonic() + 15.0
+            while (time.monotonic() < t_stop
+                   and any(j.state == "assembling" for j in active())):
+                time.sleep(0.05)
+            # an aborted assembly checkpoints ITSELF (in _assemble);
+            # everything else still admitted/warmed is parked here
+            for j in left:
+                if (j.state == "checkpointed"
+                        or self.adm.checkpoint(
+                            j.scan_id, reason=f"drain budget {budget:g}s "
+                                              f"exceeded")):
+                    checkpointed.append(j.scan_id)
+        n_fin = sum(1 for j in self.adm.jobs.values()
+                    if j.state in ("done", "degraded"))
+        self.log(f"[serve] drained: {n_fin} finished, "
+                 f"{len(checkpointed)} checkpointed")
+        return {"finished": n_fin, "checkpointed": checkpointed}
+
+    def stop(self, drain_budget_s: float | None = None) -> dict:
+        """Drain then close — the SIGTERM path. A later ScanService over
+        the same root resumes anything queued or checkpointed."""
+        res = self.drain(drain_budget_s)
+        self.close()
+        return res
+
     def close(self) -> None:
         self._stop.set()
         with self._assembly_cv:
@@ -166,43 +370,119 @@ class ScanService:
         for t in self._threads:
             t.join(timeout=10.0)
         self.adm.close()
+        if self.phase != "crashed":
+            self.phase = "stopped"
+
+    def _crash(self, where: str, exc: BaseException) -> None:
+        """An injected ``serve.crash`` fired: die like the real thing.
+        Under ``serve()`` (exit_on_crash) the PROCESS exits 137 with the
+        ledger fd left dangling mid-line — exactly a kill -9. In-process
+        (tests/smokes) the service wedges into phase=crashed without
+        journaling a finish or closing the ledger; a new ScanService
+        over the same root is the restart."""
+        self.log(f"[serve] CRASH at {where}: {exc}")
+        self.phase = "crashed"
+        self._stop.set()
+        with self._assembly_cv:
+            self._assembly_cv.notify_all()
+        if self.exit_on_crash:
+            os._exit(137)
 
     # ---- submit ----------------------------------------------------------
 
     def submit(self, payload: dict) -> tuple[bool, dict]:
-        """One scan submission: validate, quota-check, queue. Returns
-        (accepted, body) where body is the /submit response JSON."""
+        """One scan submission: validate, quota-check, persist, queue.
+        Returns (accepted, body) where body is the /submit response JSON;
+        rejections carry a machine-readable ``reason`` (and
+        ``retry_after_s`` when the client should come back). A re-submit
+        of an existing client scan_id with the SAME (tenant, target,
+        calib) is idempotent — it returns the existing request — because
+        after a gateway crash the client cannot know whether its first
+        202 committed."""
+        scfg = self.cfg.serving
+        if self.phase != "ready":
+            self.registry.inc("sl3d_serve_rejected_total",
+                              tenant=_safe_id(payload.get("tenant"),
+                                              "anon"))
+            return False, {"error": f"service is {self.phase}",
+                           "reason": ("draining"
+                                      if self.phase == "draining"
+                                      else self.phase),
+                           "retry_after_s": max(1.0, scfg.drain_budget_s)}
         tenant = _safe_id(payload.get("tenant"), "anon")
         target = str(payload.get("target") or "")
         calib = str(payload.get("calib") or "")
         if not target or not os.path.isdir(target):
-            return False, {"error": f"target is not a directory: {target!r}"}
+            return False, {"error": f"target is not a directory: "
+                                    f"{target!r}", "reason": "bad-request"}
         if not calib or not os.path.isfile(calib):
-            return False, {"error": f"calib is not a file: {calib!r}"}
-        with self._seq_lock:
-            self._seq += 1
-            seq = self._seq
-        scan_id = _safe_id(payload.get("scan_id"),
-                           f"s{seq:04d}") or f"s{seq:04d}"
-        scan_id = f"{tenant}-{scan_id}"
+            return False, {"error": f"calib is not a file: {calib!r}",
+                           "reason": "bad-request"}
+        client_id = _safe_id(payload.get("scan_id"), "")
+        if client_id:
+            scan_id = f"{tenant}-{client_id}"
+        else:
+            with self._seq_lock:
+                self._seq += 1
+                scan_id = f"{tenant}-s{self._seq:04d}"
         out_dir = os.path.join(self.scans_dir, scan_id)
-        scfg = self.cfg.serving
         budget = payload.get("budget_s", scfg.default_budget_s)
         job = ScanJob(scan_id, tenant, os.path.abspath(target),
                       os.path.abspath(calib), out_dir,
                       weight=float(payload.get("weight",
                                                scfg.default_weight)),
                       budget_s=float(budget or 0.0))
-        with self.adm.lock:
-            if scan_id in self.adm.jobs:
-                return False, {"error": f"scan_id {scan_id!r} already exists"}
-            ok, reason = self.adm.submit(job)
+        persist = self._write_record if scfg.durable else None
+        try:
+            with self.adm.lock:
+                prior = self.adm.jobs.get(scan_id)
+                if prior is not None:
+                    if (prior.tenant, prior.target, prior.calib) == \
+                            (job.tenant, job.target, job.calib):
+                        return True, {"scan_id": scan_id, "tenant": tenant,
+                                      "state": prior.state,
+                                      "duplicate": True}
+                    return False, {"error": f"scan_id {scan_id!r} already "
+                                            "exists with different "
+                                            "inputs",
+                                   "reason": "scan-id-conflict"}
+                ok, info = self.adm.submit(job, persist=persist)
+        except faults.InjectedCrash:
+            raise
+        except BaseException as e:
+            # durable-record or journal write failed: nothing admitted,
+            # the client can safely retry the same scan_id
+            self.registry.inc("sl3d_serve_rejected_total", tenant=tenant)
+            return False, {"error": f"submit not durable: {e}",
+                           "reason": "transient", "retry_after_s": 1.0}
         if not ok:
             self.registry.inc("sl3d_serve_rejected_total", tenant=tenant)
-            return False, {"error": reason, "tenant": tenant}
+            body = {"error": info.get("error", "rejected"),
+                    "reason": info.get("reason", "bad-request"),
+                    "tenant": tenant}
+            if "retry_after_s" in info:
+                body["retry_after_s"] = info["retry_after_s"]
+            return False, body
         self.registry.inc("sl3d_serve_submitted_total", tenant=tenant)
         return True, {"scan_id": scan_id, "tenant": tenant,
                       "state": "queued"}
+
+    def _write_record(self, job) -> None:
+        """The durability point: the request record is bytes-on-disk
+        (fsync'd) BEFORE the scan is journaled, queued, or 202'd — so an
+        accepted request can always be replayed, and anything the crash
+        interrupted earlier left no accept for the client to hold."""
+        rec = {"schema": REQUEST_SCHEMA, "scan_id": job.scan_id,
+               "tenant": job.tenant, "target": job.target,
+               "calib": job.calib, "out_dir": job.out_dir,
+               "weight": job.weight, "budget_s": job.budget_s,
+               "submitted_unix": job.submitted_unix}
+        path = os.path.join(self.requests_dir, f"{job.scan_id}.json")
+        with atomic_write(path) as tmp:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(rec, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
 
     def status(self, scan_id: str) -> dict | None:
         with self.adm.lock:
@@ -317,25 +597,35 @@ class ScanService:
         while not self._stop.is_set():
             try:
                 self.adm.sweep_expired()
-                for job in self.adm.admit_next():
-                    try:
-                        self._plan(job)
-                    except Exception as e:
-                        self.adm.finish(job.scan_id, "failed",
-                                        error=f"plan: {e}")
-                        self._finish_metrics(job, "failed")
-                        self.log(f"[serve] {job.scan_id}: plan FAILED "
-                                 f"({type(e).__name__}: {e})")
+                for job in self.adm.shed_expired():
+                    self._finish_metrics(job, "shed")
+                    self.log(f"[serve] {job.scan_id}: SHED ({job.error})")
+                if not self._draining.is_set():
+                    for job in self.adm.admit_next():
+                        try:
+                            self._plan(job)
+                        except Exception as e:
+                            self.adm.finish(job.scan_id, "failed",
+                                            error=f"plan: {e}")
+                            self._finish_metrics(job, "failed")
+                            self.log(f"[serve] {job.scan_id}: plan FAILED "
+                                     f"({type(e).__name__}: {e})")
                 self._queue_settled()
                 grants = self.adm.next_views(lane, batch_n)
                 if not grants:
                     self._stop.wait(poll)
                     continue
                 self._run_grants(lane, grants)
+            except faults.InjectedCrash as e:
+                # an injected crash is the one thing the engine must NOT
+                # survive: it simulates process death (restart-resume is
+                # the recovery path, not this loop)
+                self._crash(f"engine {lane}", e)
+                return
             except BaseException as e:
-                # the engine must survive anything an item throws at it
-                # (incl. an injected crash — the service IS the process
-                # that must not die); affected leases age into steals
+                # the engine must survive anything else an item throws at
+                # it (the service IS the process that must not die);
+                # affected leases age into steals
                 self.log(f"[serve] engine {lane}: {type(e).__name__}: {e}")
                 self._stop.wait(poll)
 
@@ -347,6 +637,9 @@ class ScanService:
         st = self._stages
         loaded: dict[tuple | None, list] = {}
         for iid, gen, spec in grants:
+            # crash boundary: the grant is journaled but no work happened
+            # — restart re-plans the view as a cache miss
+            faults.fire("serve.crash", item=f"grant:{iid}")
             with self._scan_lock:
                 ctx = self._scans.get(spec["scan"])
             if ctx is None:            # scan finished/failed underneath us
@@ -357,6 +650,8 @@ class ScanService:
                     "load",
                     lambda s=spec["src"]: st._load_fired(s, self.cfg),
                     self._policy)
+            except faults.InjectedCrash:
+                raise
             except BaseException as e:
                 self.adm.failed(iid, lane, gen, f"load: {e}")
                 self.registry.inc("sl3d_serve_view_failures_total",
@@ -380,6 +675,10 @@ class ScanService:
         st = self._stages
         pts, cols, _ = st._clean_arrays(pts, cols, self.cfg, ctx.steps)
         ctx.cache.put("view", spec["key"], points=pts, colors=cols)
+        # crash boundary: the bytes are cached but the complete event is
+        # NOT journaled — restart still re-plans this view WARM (the
+        # cache, not the ledger, is the source of truth for bytes)
+        faults.fire("serve.crash", item=f"complete:{iid}")
         self.adm.complete(iid, lane, gen)
         self.registry.inc("sl3d_serve_views_warmed_total",
                           tenant=ctx.job.tenant)
@@ -406,6 +705,8 @@ class ScanService:
                     spec["src"])),
                 self._policy)
             self._finish_item(lane, iid, gen, spec, ctx, pts, cols)
+        except faults.InjectedCrash:
+            raise
         except BaseException as e:
             self.adm.failed(iid, lane, gen, f"compute: {e}")
             self.registry.inc("sl3d_serve_view_failures_total",
@@ -428,6 +729,8 @@ class ScanService:
         for iid, gen, spec, ctx, _f, _t in items:
             try:
                 faults.fire("compute.view", item=spec["src"])
+            except faults.InjectedCrash:
+                raise
             except BaseException as e:
                 poisoned = e
                 break
@@ -463,11 +766,15 @@ class ScanService:
                             tri.CloudResult(pts_v[j], cols_v[j], val_v[j]))
                         self._finish_item(lane, iid, gen, spec, ctx, pts,
                                           cols)
+                    except faults.InjectedCrash:
+                        raise
                     except BaseException as e:
                         self.adm.failed(iid, lane, gen, f"drain: {e}")
                         self.registry.inc("sl3d_serve_view_failures_total",
                                           tenant=ctx.job.tenant)
                 return
+            except faults.InjectedCrash:
+                raise
             except BaseException as e:
                 poisoned = e
         self.log(f"[serve] batch of {len(items)} view(s) degraded to "
@@ -508,8 +815,16 @@ class ScanService:
                 sid = self._assembly_q.pop(0)
             with self.adm.lock:
                 job = self.adm.jobs.get(sid)
-            if job is not None:
+            if job is None or job.state != "warmed":
+                continue        # checkpointed/finished underneath us
+            try:
                 self._assemble(job)
+            except faults.InjectedCrash as e:
+                # simulated process death mid-assembly: no finish event
+                # journaled, scan left "assembling" — restart re-queues
+                # it and re-assembles over the warm cache
+                self._crash(f"assembly {sid}", e)
+                return
 
     def _job_log(self, job):
         def _log(msg):
@@ -528,6 +843,9 @@ class ScanService:
             ctx = self._scans.get(job.scan_id)
         with self.adm.lock:
             job.state = "assembling"
+        # crash boundary: warmed + journaled, assembly never started —
+        # restart finds every view cached and re-assembles for free
+        faults.fire("serve.crash", item=f"assembly:{job.scan_id}")
         rcfg = copy.deepcopy(self.cfg)
         rcfg.coordinator.workers = 0
         rem = job.budget_remaining()
@@ -557,15 +875,29 @@ class ScanService:
                         "stl_path": report.stl_path,
                         "assembly_s": round(report.elapsed_s, 3)}
         except dl.DeadlineExceeded as e:
-            state, error = "aborted", f"SLO budget exceeded: {e}"
+            if self._drain_breach.is_set():
+                # not an SLO verdict — the SERVICE ran out of drain
+                # budget. Park the scan (failures.json already written by
+                # the abort path); the next start() re-queues it
+                state, error = "checkpointed", f"drain checkpoint: {e}"
+            else:
+                state, error = "aborted", f"SLO budget exceeded: {e}"
+        except faults.InjectedCrash:
+            raise
         except BaseException as e:
             state, error = "failed", f"{type(e).__name__}: {e}"
         finally:
             with self._scan_lock:
                 self._scans.pop(job.scan_id, None)
-        self.adm.finish(job.scan_id, state, error=error, report=report_d)
-        self._finish_metrics(job, state,
-                             assembly_s=time.monotonic() - t0)
+        if state == "checkpointed":
+            self.adm.checkpoint(job.scan_id, reason=error)
+            self.registry.inc("sl3d_serve_checkpointed_total",
+                              tenant=job.tenant)
+        else:
+            self.adm.finish(job.scan_id, state, error=error,
+                            report=report_d)
+            self._finish_metrics(job, state,
+                                 assembly_s=time.monotonic() - t0)
         self.log(f"[serve] {job.scan_id}: {state.upper()} "
                  f"({job.elapsed_s():.2f}s total)" +
                  (f" — {error}" if error else ""))
@@ -585,6 +917,8 @@ class ScanService:
         snap = self.adm.snapshot()
         self.registry.set_gauge("sl3d_serve_scans_active", snap["active"])
         self.registry.set_gauge("sl3d_serve_scans_queued", snap["queued"])
+        self.registry.set_gauge("sl3d_serve_ready",
+                                1.0 if self.phase == "ready" else 0.0)
         return tel.prometheus_text(self.registry.as_dict())
 
 
@@ -604,11 +938,15 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):   # route through the service log
         self.service.log("[serve.http] " + fmt % args)
 
-    def _json(self, code: int, body: dict) -> None:
+    def _json(self, code: int, body: dict,
+              retry_after: float | None = None) -> None:
         data = (json.dumps(body) + "\n").encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        if retry_after is not None:
+            self.send_header("Retry-After",
+                             str(max(1, int(round(retry_after)))))
         self.end_headers()
         self.wfile.write(data)
 
@@ -627,22 +965,37 @@ class _Handler(BaseHTTPRequestHandler):
             n = int(self.headers.get("Content-Length") or 0)
             payload = json.loads(self.rfile.read(n) or b"{}")
         except (ValueError, json.JSONDecodeError) as e:
-            return self._json(400, {"error": f"bad JSON body: {e}"})
+            return self._json(400, {"error": f"bad JSON body: {e}",
+                                    "reason": "bad-request"})
+        try:
+            faults.fire("http.submit",
+                        item=str(payload.get("tenant") or ""))
+        except faults.InjectedCrash as e:
+            self.service._crash("http.submit", e)
+            raise
+        except BaseException as e:
+            return self._json(503, {"error": f"injected: {e}",
+                                    "reason": "transient",
+                                    "retry_after_s": 1.0}, retry_after=1.0)
         ok, body = self.service.submit(payload)
         if ok:
             return self._json(200, body)
-        # quota/backpressure rejections are 429 (retryable); malformed
-        # submissions are 400
-        code = 429 if ("quota" in body.get("error", "")
-                       or "queue full" in body.get("error", "")) else 400
-        return self._json(code, body)
+        # the machine-readable ``reason`` picks the status; retryable
+        # rejections (429 backpressure, 503 service-side) carry
+        # Retry-After so clients back off instead of hammering
+        code = _REASON_HTTP.get(body.get("reason", "bad-request"), 400)
+        ra = body.get("retry_after_s", 1.0) if code in (429, 503) else None
+        return self._json(code, body, retry_after=ra)
 
     def do_GET(self):
         parsed = urllib.parse.urlparse(self.path)
         path = parsed.path
         if path == "/healthz":
             snap = self.service.snapshot()
-            return self._json(200, {"ok": True, "run_id": snap["run_id"],
+            phase = self.service.phase
+            return self._json(200, {"ok": phase == "ready",
+                                    "phase": phase,
+                                    "run_id": snap["run_id"],
                                     "active": snap["active"],
                                     "queued": snap["queued"]})
         if path == "/metrics":
@@ -684,7 +1037,8 @@ def start_gateway(root: str, cfg: Config | None = None, log=print,
     host, port = httpd.server_address[0], httpd.server_address[1]
     svc.start()
     info = {"host": host, "port": port, "pid": os.getpid(),
-            "run_id": svc.run_id, "root": svc.root}
+            "run_id": svc.run_id, "root": svc.root,
+            "argv": list(sys.argv)}   # loadgen --restart relaunch recipe
     with open(os.path.join(svc.root, "serve.json"), "w") as f:
         json.dump(info, f)
     if ready_file:
@@ -698,17 +1052,43 @@ def start_gateway(root: str, cfg: Config | None = None, log=print,
 
 def serve(root: str, cfg: Config | None = None, log=print,
           ready_file: str | None = None) -> int:
-    """Run the gateway until interrupted (the ``sl3d serve`` entry)."""
+    """Run the gateway until interrupted (the ``sl3d serve`` entry).
+
+    SIGTERM and SIGINT both DRAIN: new submits 503 with Retry-After,
+    active scans get ``serving.drain_budget_s`` to finish or checkpoint,
+    then the process exits cleanly — a container stop is a resume point,
+    not a data loss. An injected ``serve.crash`` under this entry exits
+    the process 137 (the kill -9 twin the chaos smokes restart from)."""
     cfg = cfg or Config()
     faults.configure_from(cfg.faults)
     httpd, svc = start_gateway(root, cfg=cfg, log=log,
                                ready_file=ready_file)
+    svc.exit_on_crash = True
+
+    def _on_signal(signum, frame):
+        log(f"[serve] signal {signum}; draining")
+        # serve_forever must NOT be shut down from inside its own
+        # signal frame (deadlock); a helper thread breaks the loop
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    prev = {}
+    for s in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[s] = signal.signal(s, _on_signal)
+        except ValueError:
+            pass        # not the main thread (tests drive serve() there)
     try:
         httpd.serve_forever(poll_interval=0.2)
     except KeyboardInterrupt:
         log("[serve] interrupted; draining")
     finally:
-        httpd.shutdown()
+        for s, h in prev.items():
+            try:
+                signal.signal(s, h)
+            except ValueError:
+                pass
         httpd.server_close()
-        svc.close()
+        svc.stop()
+        log("[serve] stopped cleanly; restart resumes from "
+            f"{svc.root}")
     return 0

@@ -440,6 +440,18 @@ class RunContext:
         if self.run_deadline is not None:
             self.run_deadline.check(what)
 
+    def abort(self, reason: str = "externally aborted") -> None:
+        """Externally abort the run THIS context governs (the serving
+        drain lever): replace the run deadline with one already expired
+        and raise the cancel level, so the next stage boundary /
+        cancellable wait exits through the normal DeadlineExceeded abort
+        path — failures.json manifest included — instead of being
+        hard-killed mid-write."""
+        d = Deadline(0.0, reason)
+        d.t_end = float("-inf")
+        self.run_deadline = d
+        self.token.cancel(reason)
+
 
 _CTX: RunContext | None = None
 

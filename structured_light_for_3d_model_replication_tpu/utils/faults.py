@@ -31,6 +31,16 @@ supplies the two halves of making that chain resilient:
    ``coord.grant``       coordinator lease grant (item is
                          ``"<worker_id>:<item_id>"``; the coordinator-crash
                          site for resume tests; parallel/coordinator.py)
+   ``serve.crash``       serving-gateway crash boundaries (item is
+                         ``"grant:<item_id>"``, ``"complete:<item_id>"``
+                         or ``"assembly:<scan_id>"``; the restart-resume
+                         site for durable serving; pipeline/serving.py)
+   ``ledger.append``     every work-ledger event append (item is the
+                         event type; a crash here loses the line replay
+                         must tolerate; parallel/coordinator.py)
+   ``http.submit``       gateway /submit handling before admission (the
+                         client-visible 503 + Retry-After path;
+                         pipeline/serving.py)
    ====================  ====================================================
 
 2. **Retry/quarantine toolkit** — the exception classifier

@@ -25,7 +25,7 @@ from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
 
 CAM, PROJ = (160, 120), (128, 64)
 STEPS = ("statistical",)  # tiny clouds carry no dominant RANSAC plane
-TERMINAL = ("done", "degraded", "failed", "aborted")
+TERMINAL = ("done", "degraded", "failed", "aborted", "shed")
 
 
 @pytest.fixture(autouse=True)
@@ -163,8 +163,11 @@ def test_budget_breach_aborts_only_that_request(tmp_path, calib):
                               log=lambda m: None)
     svc.start()
     try:
+        # the budget must survive the queue (or the shed valve drops the
+        # scan before it starts — that path has its own test) yet breach
+        # long before warming+assembly can finish
         ok, body = svc.submit({"tenant": "ta", "target": tgt,
-                               "calib": calib, "budget_s": 0.05})
+                               "calib": calib, "budget_s": 0.5})
         assert ok, body
         d = _wait(svc, body["scan_id"])
         assert d["state"] == "aborted", d
@@ -204,9 +207,16 @@ def test_submit_validation_and_quotas(tmp_path, calib):
     ok, _ = svc.submit({"tenant": "ta", "target": tgt, "calib": calib,
                         "scan_id": "dup"})
     assert ok
+    # same id + same inputs = idempotent (returns the existing request);
+    # same id + different inputs = conflict
     ok, body = svc.submit({"tenant": "ta", "target": tgt, "calib": calib,
                            "scan_id": "dup"})
-    assert not ok and "exists" in body["error"]
+    assert ok and body["duplicate"] is True, body
+    tgt2 = str(tmp_path / "in2")
+    os.makedirs(os.path.join(tgt2, "scan_000deg_scan"))
+    ok, body = svc.submit({"tenant": "ta", "target": tgt2, "calib": calib,
+                           "scan_id": "dup"})
+    assert not ok and body["reason"] == "scan-id-conflict", body
 
     ok, _ = svc.submit({"tenant": "ta", "target": tgt, "calib": calib})
     assert ok  # second queued scan fills ta's quota of 2

@@ -1,0 +1,472 @@
+"""ISSUE-13 durability contract: restart-resume with byte parity and
+zero recompute (clean stop AND injected mid-assembly crash), durable
+scan_id idempotency across restarts, graceful drain with checkpoint on
+budget breach, overload shedding, per-tenant circuit breakers, torn
+request-record tolerance, and the HTTP Retry-After/reason surface.
+
+The heavyweight kill -9 of a REAL ``sl3d serve`` process lives in
+``tools/serve_chaos_smoke.py`` (the SERVE_CHAOS_SMOKE CI arm); these
+tests drive the same machinery in-process where a "crash" is
+``phase=crashed`` without a journaled finish and a "restart" is a new
+``ScanService`` over the same root.
+"""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io import images as imio
+from structured_light_for_3d_model_replication_tpu.io import matfile
+from structured_light_for_3d_model_replication_tpu.parallel.admission import (
+    AdmissionController,
+    ScanJob,
+    replay_serving,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline import serving
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import deadline as dl
+from structured_light_for_3d_model_replication_tpu.utils import faults
+from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+
+CAM, PROJ = (160, 120), (128, 64)
+STEPS = ("statistical",)
+TERMINAL = ("done", "degraded", "failed", "aborted", "shed")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.reset()
+
+
+def _render_scan(tgt: str, views: int = 2, shift: float = 0.0) -> None:
+    rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+    scene = syn.sphere_on_background()
+    obj, background = scene.objects
+    satellite = syn.Sphere(np.array([48.0 + shift, -92.0, 430.0]), 16.0)
+    step = 360.0 / views
+    pivot = np.array([0.0, 0.0, 420.0])
+    for i, (R, t) in enumerate(syn.turntable_poses(views, step, pivot)):
+        frames, _ = syn.render_scene(
+            rig, syn.Scene([obj.transformed(R, t),
+                            satellite.transformed(R, t), background]))
+        imio.save_stack(
+            os.path.join(tgt, f"scan_{int(round(i * step)):03d}deg_scan"),
+            frames)
+
+
+@pytest.fixture(scope="module")
+def calib(tmp_path_factory):
+    root = tmp_path_factory.mktemp("calib")
+    path = str(root / "calib.mat")
+    rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+    matfile.save_calibration(path, rig.calibration())
+    return path
+
+
+def _cfg() -> Config:
+    cfg = Config()
+    cfg.parallel.backend = "numpy"
+    cfg.decode.n_cols, cfg.decode.n_rows = PROJ
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 512
+    cfg.merge.icp_iters = 10
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    cfg.serving.clean_steps = "statistical"
+    cfg.serving.port = 0
+    return cfg
+
+
+def _wait(svc, sid, timeout=180.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        d = svc.status(sid)
+        if d["state"] in TERMINAL:
+            return d
+        time.sleep(0.1)
+    raise TimeoutError(f"{sid} still {d['state']} after {timeout}s")
+
+
+def _read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+# ---------------------------------------------------------------------------
+# restart-resume: clean stop
+# ---------------------------------------------------------------------------
+
+def test_clean_stop_restart_preserves_history_and_idempotency(tmp_path,
+                                                              calib):
+    """A stopped service's successor answers /status and /result for
+    every scan the predecessor finished, and a client's durable scan_id
+    stays idempotent across the restart (same inputs -> the existing
+    request; different inputs -> conflict)."""
+    tgt = str(tmp_path / "in")
+    os.makedirs(tgt)
+    _render_scan(tgt)
+    root = str(tmp_path / "svc")
+    payload = {"tenant": "ta", "target": tgt, "calib": calib,
+               "scan_id": "job1"}
+    svc = serving.ScanService(root, cfg=_cfg(), log=lambda m: None)
+    svc.start()
+    try:
+        ok, body = svc.submit(payload)
+        assert ok, body
+        sid = body["scan_id"]
+        assert sid == "ta-job1"
+        d = _wait(svc, sid)
+        assert d["state"] == "done", d
+        ply = _read(svc.result_path(sid, "ply")[0])
+        # the durability point: the accepted request is bytes on disk
+        rec_path = os.path.join(root, "requests", f"{sid}.json")
+        with open(rec_path) as f:
+            rec = json.load(f)
+        assert rec["schema"] == serving.REQUEST_SCHEMA
+        assert rec["tenant"] == "ta" and rec["scan_id"] == sid
+    finally:
+        svc.stop(drain_budget_s=5.0)
+    assert svc.phase == "stopped"
+
+    svc2 = serving.ScanService(root, cfg=_cfg(), log=lambda m: None)
+    svc2.start()
+    try:
+        d = svc2.status(sid)
+        assert d is not None and d["state"] == "done", d
+        assert d["report"]["merged_points"] > 0
+        path, err = svc2.result_path(sid, "ply")
+        assert path, err
+        assert _read(path) == ply
+        # durable idempotency: the SAME submit is the same request ...
+        ok, body = svc2.submit(payload)
+        assert ok and body["duplicate"] is True, body
+        assert body["state"] == "done"
+        # ... and the same id with different inputs is a conflict
+        tgt2 = str(tmp_path / "in2")
+        os.makedirs(os.path.join(tgt2, "scan_000deg_scan"))
+        ok, body = svc2.submit(dict(payload, target=tgt2))
+        assert not ok and body["reason"] == "scan-id-conflict", body
+    finally:
+        svc2.close()
+
+
+# ---------------------------------------------------------------------------
+# restart-resume: mid-assembly crash -> byte parity, zero recompute
+# ---------------------------------------------------------------------------
+
+def test_crash_mid_assembly_restart_resumes_with_zero_recompute(tmp_path,
+                                                                calib):
+    """ISSUE-13 acceptance: an injected ``serve.crash`` at the assembly
+    boundary fells the service with every view warmed but NO finish
+    journaled; a new service over the same root re-queues the scan,
+    re-plans every view as a cache hit (views_computed == 0) and serves
+    PLY/STL byte-identical to an uninterrupted solo run."""
+    tgt = str(tmp_path / "in")
+    os.makedirs(tgt)
+    _render_scan(tgt)
+    solo = str(tmp_path / "solo")
+    rep = stages.run_pipeline(calib, tgt, solo, cfg=_cfg(), steps=STEPS,
+                              log=lambda m: None)
+    assert rep.failed == []
+
+    root = str(tmp_path / "svc")
+    cfg = _cfg()
+    cfg.faults.spec = "serve.crash~assembly:crash"
+    faults.configure_from(cfg.faults)
+    svc = serving.ScanService(root, cfg=cfg, log=lambda m: None)
+    svc.start()
+    ok, body = svc.submit({"tenant": "ta", "target": tgt, "calib": calib})
+    assert ok, body
+    sid = body["scan_id"]
+    t0 = time.monotonic()
+    while svc.phase != "crashed":
+        assert time.monotonic() - t0 < 120.0, \
+            f"no crash; scan is {svc.status(sid)}"
+        time.sleep(0.05)
+    # died mid-flight: no terminal state journaled, both views credited
+    assert svc.status(sid)["state"] not in TERMINAL
+    rs = replay_serving(os.path.join(root, "ledger.jsonl"))
+    assert rs["scans"][sid]["state"] not in TERMINAL
+    assert len(rs["completed"]) == 2
+    svc.close()
+    assert svc.phase == "crashed"     # close() never launders a crash
+    faults.reset()
+
+    svc2 = serving.ScanService(root, cfg=_cfg(), log=lambda m: None)
+    svc2.start()
+    try:
+        d = _wait(svc2, sid)
+        assert d["state"] == "done", d
+        # zero recompute: every view came back as a cache hit
+        assert d["report"]["views_computed"] == 0, d["report"]
+        assert d["report"]["views_cached"] == 2, d["report"]
+        for art, name in (("ply", "merged.ply"), ("stl", "model.stl")):
+            path, err = svc2.result_path(sid, art)
+            assert path, err
+            assert _read(path) == _read(os.path.join(solo, name)), \
+                f"{name} differs from solo run after crash-restart"
+    finally:
+        svc2.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain: budget breach checkpoints, restart completes
+# ---------------------------------------------------------------------------
+
+def test_drain_budget_breach_checkpoints_and_restart_completes(tmp_path,
+                                                               calib):
+    """stop() past the drain budget aborts the in-flight assembly via
+    the PR-7 run-budget lever (failures.json included), parks the scan
+    CHECKPOINTED (non-terminal), and the next start() finishes it over
+    the still-warm cache."""
+    tgt = str(tmp_path / "in")
+    os.makedirs(tgt)
+    _render_scan(tgt)
+    root = str(tmp_path / "svc")
+    svc = serving.ScanService(root, cfg=_cfg(), log=lambda m: None)
+    svc.start()
+    ok, body = svc.submit({"tenant": "ta", "target": tgt, "calib": calib})
+    assert ok, body
+    sid = body["scan_id"]
+    # catch the scan mid-assembly (RunContext installed = run_pipeline
+    # is actually running), then drain with a hopeless budget
+    t0 = time.monotonic()
+    while not (svc.status(sid)["state"] == "assembling"
+               and dl.current() is not None):
+        assert time.monotonic() - t0 < 120.0, svc.status(sid)
+        time.sleep(0.005)
+    res = svc.stop(drain_budget_s=0.1)
+    assert sid in res["checkpointed"], res
+    job = svc.adm.jobs[sid]
+    assert job.state == "checkpointed", job.as_dict()
+    # the abort path left its manifest (run_pipeline clears stale
+    # failures.json on the resumed run, so this must be checked NOW)
+    with open(os.path.join(job.out_dir, "failures.json")) as f:
+        assert json.load(f)["aborted"] is True
+
+    svc2 = serving.ScanService(root, cfg=_cfg(), log=lambda m: None)
+    svc2.start()
+    try:
+        d = _wait(svc2, sid)
+        assert d["state"] == "done", d
+        # the warmed views survived the checkpoint: nothing recomputed
+        assert d["report"]["views_computed"] == 0, d["report"]
+        path, err = svc2.result_path(sid, "ply")
+        assert path, err
+    finally:
+        svc2.close()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (unit, fake clock)
+# ---------------------------------------------------------------------------
+
+def test_breaker_open_halfopen_probe_close_and_reopen(tmp_path):
+    clk = {"t": 100.0}
+    adm = AdmissionController(str(tmp_path / "ledger.jsonl"), "r0",
+                              breaker_threshold=2, breaker_cooldown_s=10.0,
+                              clock=lambda: clk["t"], log=lambda m: None)
+    n = iter(range(1, 100))
+
+    def sub(tenant="ta"):
+        job = ScanJob(f"{tenant}-{next(n)}", tenant, "tgt", "cal", "out")
+        ok, info = adm.submit(job)
+        return job, ok, info
+
+    try:
+        j, ok, _ = sub()
+        assert ok
+        adm.finish(j.scan_id, "failed", error="boom")
+        j, ok, _ = sub()          # one failure: still closed
+        assert ok
+        adm.finish(j.scan_id, "failed", error="boom")
+        # threshold hit -> open: fast-fail with the cooldown remainder
+        clk["t"] += 4.0
+        _, ok, info = sub()
+        assert not ok and info["reason"] == "circuit-open", info
+        assert 0 < info["retry_after_s"] <= 6.001, info
+        # blast radius is the tenant, not the service
+        _, ok, _ = sub("tb")
+        assert ok
+        # cooldown elapsed -> half-open: exactly ONE probe goes through
+        clk["t"] += 10.0
+        probe, ok, _ = sub()
+        assert ok
+        _, ok, info = sub()
+        assert not ok and "probe" in info["error"], info
+        # probe success closes the breaker
+        adm.finish(probe.scan_id, "done")
+        j, ok, _ = sub()
+        assert ok
+        adm.finish(j.scan_id, "degraded")   # degraded counts as success
+        # re-open, then a FAILED probe re-opens with a fresh cooldown
+        for _ in range(2):
+            j, ok, _ = sub()
+            assert ok
+            adm.finish(j.scan_id, "aborted", error="slo")
+        clk["t"] += 10.0
+        probe, ok, _ = sub()
+        assert ok
+        adm.finish(probe.scan_id, "failed", error="still broken")
+        _, ok, info = sub()
+        assert not ok and info["reason"] == "circuit-open", info
+        # a replayed failure streak re-arms the breaker on restart
+        adm.restore_breaker("tc", 2)
+        _, ok, info = sub("tc")
+        assert not ok and info["reason"] == "circuit-open", info
+    finally:
+        adm.close()
+
+
+# ---------------------------------------------------------------------------
+# overload shedding (unit)
+# ---------------------------------------------------------------------------
+
+def test_shed_expired_drops_hopeless_queue_waiters(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    adm = AdmissionController(path, "r0", max_queue_wait_s=0.05,
+                              log=lambda m: None)
+    try:
+        ja = ScanJob("ta-1", "ta", "tgt", "cal", "out")
+        jb = ScanJob("tb-1", "tb", "tgt", "cal", "out", budget_s=0.01)
+        assert adm.submit(ja)[0] and adm.submit(jb)[0]
+        time.sleep(0.12)
+        shed = adm.shed_expired()
+        assert {j.scan_id for j in shed} == {"ta-1", "tb-1"}
+        assert ja.state == "shed" and "max_queue_wait_s" in ja.error
+        assert jb.state == "shed" and "SLO budget" in jb.error
+        assert adm.queue == []
+        assert adm.shed_expired() == []       # idempotent
+    finally:
+        adm.close()
+    rs = replay_serving(path)
+    assert rs["scans"]["ta-1"]["state"] == "shed"
+    assert rs["tenant_fails"] == {}   # shed carries no breaker evidence
+
+
+# ---------------------------------------------------------------------------
+# ledger fold (unit)
+# ---------------------------------------------------------------------------
+
+def test_replay_serving_folds_lifecycle_and_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    adm = AdmissionController(path, "r0", log=lambda m: None)
+    try:
+        j = ScanJob("ta-s0001", "ta", "tgt", "cal", "outA", budget_s=2.0)
+        assert adm.submit(j)[0]
+        assert [x.scan_id for x in adm.admit_next()] == ["ta-s0001"]
+        adm.add_items("ta-s0001", [{"index": 0, "src": "s", "key": "k"}])
+        (iid, gen, _spec), = adm.next_views("lane0", 4)
+        adm.complete(iid, "lane0", gen)
+        adm.finish("ta-s0001", "degraded", error="one view down",
+                   report={"merged_points": 5})
+        j2 = ScanJob("tb-s0001", "tb", "t2", "cal", "outB")
+        assert adm.submit(j2)[0]
+        assert adm.checkpoint("tb-s0001", reason="drain")
+        adm.restore(j2)                 # journals resume -> queued again
+    finally:
+        adm.close()
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"type": "fin')        # crash mid-append
+    rs = replay_serving(path)
+    a = rs["scans"]["ta-s0001"]
+    assert a["state"] == "degraded" and a["error"] == "one view down"
+    assert a["report"] == {"merged_points": 5}
+    assert a["budget_s"] == 2.0 and a["out_dir"] == "outA"
+    b = rs["scans"]["tb-s0001"]
+    assert b["state"] == "queued" and b["target"] == "t2"
+    assert rs["completed"] == {"ta-s0001/view:0"}
+    assert rs["tenant_fails"].get("ta") == 0    # degraded resets streak
+    assert rs["segments"] == 1 and rs["events"] > 0
+
+
+# ---------------------------------------------------------------------------
+# torn request records + auto-id continuity at startup
+# ---------------------------------------------------------------------------
+
+def test_resume_skips_torn_records_and_continues_auto_ids(tmp_path, calib):
+    tgt = str(tmp_path / "in")
+    os.makedirs(os.path.join(tgt, "scan_000deg_scan"))
+    root = str(tmp_path / "svc")
+    svc = serving.ScanService(root, cfg=_cfg(), log=lambda m: None)
+    ok, body = svc.submit({"tenant": "ta", "target": tgt, "calib": calib})
+    assert ok and body["scan_id"] == "ta-s0001"
+    svc.close()
+    req_dir = os.path.join(root, "requests")
+    with open(os.path.join(req_dir, "ta-torn.json"), "w") as f:
+        f.write('{"schema": "sl3d-req')          # torn mid-write
+    with open(os.path.join(req_dir, "ta-old.json"), "w") as f:
+        json.dump({"schema": "sl3d-request-v0", "scan_id": "ta-old",
+                   "calib": calib}, f)           # unknown schema
+    stale_tmp = os.path.join(req_dir, "x.json.tmp")
+    with open(stale_tmp, "w") as f:
+        f.write("{}")
+
+    svc2 = serving.ScanService(root, cfg=_cfg(), log=lambda m: None)
+    svc2._resume()
+    try:
+        assert svc2.adm.jobs["ta-s0001"].state == "queued"
+        assert "ta-torn" not in svc2.adm.jobs
+        assert "ta-old" not in svc2.adm.jobs
+        assert not os.path.exists(stale_tmp)     # staging leftovers swept
+        # auto ids continue past the replayed sequence — no collision
+        ok, body = svc2.submit({"tenant": "ta", "target": tgt,
+                                "calib": calib})
+        assert ok and body["scan_id"] == "ta-s0002", body
+    finally:
+        svc2.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: machine-readable reasons + Retry-After, drain phase
+# ---------------------------------------------------------------------------
+
+def test_http_rejections_carry_reason_and_retry_after(tmp_path, calib):
+    tgt = str(tmp_path / "in")
+    os.makedirs(os.path.join(tgt, "scan_000deg_scan"))
+    cfg = _cfg()
+    cfg.serving.tenant_queue_quota = 0       # every submit over quota
+    httpd, svc = serving.start_gateway(str(tmp_path / "svc"), cfg=cfg,
+                                       log=lambda m: None)
+    import threading
+
+    th = threading.Thread(target=httpd.serve_forever,
+                          kwargs={"poll_interval": 0.05}, daemon=True)
+    th.start()
+    base = f"http://{httpd.server_address[0]}:{httpd.server_address[1]}"
+
+    def post(payload):
+        req = urllib.request.Request(
+            base + "/submit", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        return urllib.request.urlopen(req, timeout=10)
+
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"tenant": "ta", "target": tgt, "calib": calib})
+        assert ei.value.code == 429
+        assert ei.value.headers.get("Retry-After") is not None
+        body = json.loads(ei.value.read())
+        assert body["reason"] == "tenant-queue-quota", body
+        # drain flips the phase: healthz degrades, submits 503 + hint
+        svc.drain(budget_s=0.0)
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["ok"] is False and h["phase"] == "draining", h
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"tenant": "ta", "target": tgt, "calib": calib})
+        assert ei.value.code == 503
+        assert int(ei.value.headers.get("Retry-After")) >= 1
+        body = json.loads(ei.value.read())
+        assert body["reason"] == "draining", body
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        svc.close()

@@ -129,13 +129,14 @@ else
 fi
 
 # ---- soak smoke: 3 seeded runs over a randomized fault matrix
-# (transient/permanent/crash/stall/slow mixes) plus 1 coordinated
-# 2-worker run from the host-scope kill matrix — every run must
-# TERMINATE within budget with a schema-valid trace journal (ISSUE 7)
-# and a replayable ledger (ISSUE 9); longer sweeps:
-# python tools/soak.py --runs 20 ----
+# (transient/permanent/crash/stall/slow mixes), 1 coordinated 2-worker
+# run from the host-scope kill matrix, and 1 serving kill->restart run
+# from the serve-scope matrix — every run must TERMINATE within budget
+# with a schema-valid trace journal (ISSUE 7), a replayable ledger
+# (ISSUE 9), and every accepted serve request recovered (ISSUE 13);
+# longer sweeps: python tools/soak.py --runs 20 ----
 soak_rc=0
-soak=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/soak.py --runs 3 --views 4 --budget-s 150 --multiproc-runs 1 2>&1) || soak_rc=$?
+soak=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/soak.py --runs 3 --views 4 --budget-s 150 --multiproc-runs 1 --serve-runs 1 2>&1) || soak_rc=$?
 echo "$soak" > tools/_ci/soak_smoke.log
 if [ $soak_rc -eq 0 ] && echo "$soak" | grep -q 'SOAK=ok'; then
   echo "$soak" | grep 'SOAK=ok'
@@ -170,6 +171,22 @@ if [ $serve_rc -eq 0 ] && echo "$serve" | grep -q 'SERVE_SMOKE=ok'; then
   echo "$serve" | grep 'SERVE_SMOKE=ok'
 else
   echo "SERVE_SMOKE=FAIL (rc=$serve_rc; see tools/_ci/serve_smoke.log)"
+  [ $rc -eq 0 ] && rc=1
+fi
+
+# ---- serve chaos smoke: a REAL `sl3d serve` subprocess felled by an
+# injected serve.crash (exit 137, ledger fd dangling), restarted over
+# the same root — the resumed request must finish DONE with zero
+# recompute and byte parity vs a solo run, the client's scan_id must
+# stay idempotent across the crash, and SIGTERM must drain to exit 0
+# (ISSUE 13) ----
+schaos_rc=0
+schaos=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/serve_chaos_smoke.py 2>&1) || schaos_rc=$?
+echo "$schaos" > tools/_ci/serve_chaos_smoke.log
+if [ $schaos_rc -eq 0 ] && echo "$schaos" | grep -q 'SERVE_CHAOS_SMOKE=ok'; then
+  echo "$schaos" | grep 'SERVE_CHAOS_SMOKE=ok'
+else
+  echo "SERVE_CHAOS_SMOKE=FAIL (rc=$schaos_rc; see tools/_ci/serve_chaos_smoke.log)"
   [ $rc -eq 0 ] && rc=1
 fi
 exit $rc

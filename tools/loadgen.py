@@ -28,21 +28,51 @@ Usage:
 writes once listening (the ready handshake). Exit 0 when every request
 reached done/degraded (degraded IS a completed request — the per-request
 failure-domain contract), 1 otherwise.
+
+Kill→restart mode (the durable-serving measurement arm)::
+
+    python tools/loadgen.py --root <root> --manifest m.json --scans 2 \
+        --kill-after 5 --restart
+
+SIGKILLs the serving process mid-load (pid from ``serve.json``),
+relaunches it (``--restart-cmd`` or the argv recorded in serve.json),
+and keeps driving: submissions carry stable client scan_ids so retries
+through the outage are idempotent, pollers ride out connection-refused
+until the new process answers, and every completed result is
+sha256-hashed so the summary reports ``recovery_s`` (SIGKILL →
+/healthz ok) and ``parity_ok`` (same input ⇒ same bytes, served before
+or after the kill). Rejections always carry the gateway's
+machine-readable ``reason`` (quota-reject vs overload-shed vs breaker).
 """
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import random
 import re
+import shlex
+import signal
+import subprocess
 import sys
 import threading
 import time
 import urllib.error
 import urllib.request
 
-_TERMINAL = ("done", "degraded", "failed", "aborted")
+_TERMINAL = ("done", "degraded", "failed", "aborted", "shed")
+_NETERR = (urllib.error.URLError, ConnectionError, OSError)
+
+
+class Gateway:
+    """Mutable gateway address: the kill→restart thread swaps ``base``
+    under the drivers when the relaunched service comes up on a new
+    ephemeral port."""
+
+    def __init__(self, base: str, root: str | None = None):
+        self.base = base
+        self.root = root
 
 
 def _get(url: str, timeout: float = 10.0):
@@ -96,15 +126,19 @@ def _scrape_counter(text: str, name: str) -> float:
 
 class TenantDriver(threading.Thread):
     """One tenant's arrival process: Poisson gaps, submit, poll to
-    terminal. Results append to the shared list (lock-guarded)."""
+    terminal. Results append to the shared list (lock-guarded). With
+    ``client_ids`` every submission carries a stable scan_id, so a retry
+    through a gateway outage is idempotent (the restarted service
+    answers with the SAME request, never a duplicate scan)."""
 
-    def __init__(self, base: str, tenant: str, inputs: list[dict],
+    def __init__(self, gw: Gateway, tenant: str, inputs: list[dict],
                  scans: int, rate: float, rng: random.Random,
                  results: list, lock: threading.Lock,
                  poll_s: float = 0.25, request_timeout_s: float = 600.0,
-                 budget_s: float = 0.0):
+                 budget_s: float = 0.0, client_ids: bool = False,
+                 hash_results: bool = False):
         super().__init__(name=f"loadgen-{tenant}", daemon=True)
-        self.base = base
+        self.gw = gw
         self.tenant = tenant
         self.inputs = inputs
         self.scans = scans
@@ -115,32 +149,74 @@ class TenantDriver(threading.Thread):
         self.poll_s = poll_s
         self.request_timeout_s = request_timeout_s
         self.budget_s = budget_s
+        self.client_ids = client_ids
+        self.hash_results = hash_results
+
+    def _submit(self, payload: dict, t0: float):
+        """Submit, riding out outages (connection refused during a kill →
+        restart window) and 503s that carry a retry hint."""
+        while time.monotonic() - t0 < self.request_timeout_s:
+            try:
+                code, body = _post_json(self.gw.base + "/submit", payload)
+            except _NETERR:
+                time.sleep(self.poll_s)
+                continue
+            if code == 503 and body.get("reason") in ("draining",
+                                                      "transient"):
+                time.sleep(min(float(body.get("retry_after_s", 1.0)),
+                               2.0))
+                continue
+            return code, body
+        return 0, {"error": "gateway unreachable", "reason": "timeout"}
 
     def _one(self, i: int) -> dict:
         spec = self.inputs[i % len(self.inputs)]
         payload = {"tenant": self.tenant, "target": spec["target"],
                    "calib": spec["calib"]}
+        if self.client_ids:
+            payload["scan_id"] = f"lg{i:03d}"
         if "weight" in spec:
             payload["weight"] = spec["weight"]
         if self.budget_s:
             payload["budget_s"] = self.budget_s
         t0 = time.monotonic()
-        code, body = _post_json(self.base + "/submit", payload)
+        code, body = self._submit(payload, t0)
         if code != 200:
             return {"tenant": self.tenant, "state": "rejected",
                     "http": code, "error": body.get("error", ""),
+                    "reason": body.get("reason", ""),
+                    "target": spec["target"],
                     "latency_s": time.monotonic() - t0}
         sid = body["scan_id"]
         while time.monotonic() - t0 < self.request_timeout_s:
-            _, raw = _get(self.base + f"/status/{sid}")
-            d = json.loads(raw)
+            try:
+                _, raw = _get(self.gw.base + f"/status/{sid}")
+                d = json.loads(raw)
+            except _NETERR:
+                time.sleep(self.poll_s)   # gateway down; resume pending
+                continue
             if d["state"] in _TERMINAL:
-                return {"tenant": self.tenant, "scan_id": sid,
-                        "state": d["state"],
-                        "latency_s": time.monotonic() - t0}
+                res = {"tenant": self.tenant, "scan_id": sid,
+                       "state": d["state"], "target": spec["target"],
+                       "latency_s": time.monotonic() - t0}
+                if (self.hash_results
+                        and d["state"] in ("done", "degraded")):
+                    self._hash_into(res, sid)
+                return res
             time.sleep(self.poll_s)
         return {"tenant": self.tenant, "scan_id": sid, "state": "timeout",
+                "target": spec["target"],
                 "latency_s": time.monotonic() - t0}
+
+    def _hash_into(self, res: dict, sid: str) -> None:
+        for art in ("ply", "stl"):
+            try:
+                _, raw = _get(self.gw.base
+                              + f"/result/{sid}?artifact={art}",
+                              timeout=60.0)
+                res[f"sha_{art}"] = hashlib.sha256(raw).hexdigest()
+            except (*_NETERR, urllib.error.HTTPError):
+                res[f"sha_{art}"] = None
 
     def run(self) -> None:
         for i in range(self.scans):
@@ -151,31 +227,118 @@ class TenantDriver(threading.Thread):
                 self.results.append(res)
 
 
+def _kill_restart(gw: Gateway, kill_after_s: float, restart: bool,
+                  restart_cmd: str | None, out: dict, log=print) -> None:
+    """The chaos arm: SIGKILL the serving pid from serve.json after
+    ``kill_after_s``, then (optionally) relaunch it and record
+    ``recovery_s`` = SIGKILL → first /healthz ok. Runs on its own
+    thread while the tenant drivers ride out the outage."""
+    time.sleep(kill_after_s)
+    sj = os.path.join(gw.root, "serve.json")
+    try:
+        with open(sj) as f:
+            info = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        out["kill_error"] = f"serve.json unreadable: {e}"
+        return
+    pid = int(info["pid"])
+    t_kill = time.monotonic()
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except OSError as e:
+        out["kill_error"] = f"SIGKILL pid {pid}: {e}"
+        return
+    out["killed_pid"] = pid
+    log(f"[loadgen] SIGKILL pid {pid} after {kill_after_s:g}s of load")
+    if not restart:
+        return
+    if restart_cmd:
+        cmd = shlex.split(restart_cmd)
+    else:
+        argv = info.get("argv") or []
+        if not argv:
+            out["kill_error"] = ("no argv in serve.json and no "
+                                 "--restart-cmd")
+            return
+        cmd = ([sys.executable] + argv if argv[0].endswith(".py")
+               else list(argv))
+    try:
+        os.remove(sj)       # stale handshake: wait for the NEW process
+    except OSError:
+        pass
+    with open(os.path.join(gw.root, "restart.log"), "ab") as rlog:
+        proc = subprocess.Popen(cmd, stdout=rlog, stderr=rlog)
+    out["restarted_pid"] = proc.pid
+    log(f"[loadgen] restarting: {' '.join(cmd)} (pid {proc.pid})")
+    try:
+        base = discover(gw.root, timeout_s=120.0)
+        t_end = time.monotonic() + 120.0
+        while time.monotonic() < t_end:
+            try:
+                _, raw = _get(base + "/healthz", timeout=5.0)
+                if json.loads(raw).get("ok"):
+                    break
+            except _NETERR:
+                pass
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("restarted gateway never became healthy")
+        gw.base = base      # drivers pick the new address up mid-poll
+        out["recovery_s"] = round(time.monotonic() - t_kill, 3)
+        log(f"[loadgen] gateway back at {base} "
+            f"(recovery {out['recovery_s']}s)")
+    except (TimeoutError, OSError) as e:
+        out["kill_error"] = f"restart failed: {e}"
+
+
 def run_load(base: str, manifest: dict, scans: int, rate: float,
              seed: int = 0, budget_s: float = 0.0,
-             request_timeout_s: float = 600.0, log=print) -> dict:
+             request_timeout_s: float = 600.0, root: str | None = None,
+             kill_after_s: float = 0.0, restart: bool = False,
+             restart_cmd: str | None = None, client_ids: bool = False,
+             hash_results: bool = False, log=print) -> dict:
     """Drive the gateway with every tenant in ``manifest`` and summarize.
     Importable — ``bench.py``'s serve arm calls this directly."""
     tenants = manifest["tenants"]
     results: list[dict] = []
     lock = threading.Lock()
+    gw = Gateway(base, root=root)
+    kill_info: dict = {}
+    killer = None
+    if kill_after_s > 0:
+        if not root:
+            raise ValueError("--kill-after needs --root (pid + argv come "
+                             "from serve.json)")
+        client_ids = hash_results = True      # idempotent retries + parity
+        killer = threading.Thread(
+            target=_kill_restart,
+            args=(gw, kill_after_s, restart, restart_cmd, kill_info, log),
+            daemon=True)
     t_wall = time.monotonic()
     drivers = [
-        TenantDriver(base, tenant, inputs, scans, rate,
+        TenantDriver(gw, tenant, inputs, scans, rate,
                      random.Random(seed * 1000 + i), results, lock,
                      request_timeout_s=request_timeout_s,
-                     budget_s=budget_s)
+                     budget_s=budget_s, client_ids=client_ids,
+                     hash_results=hash_results)
         for i, (tenant, inputs) in enumerate(sorted(tenants.items()))
     ]
+    if killer is not None:
+        killer.start()
     for d in drivers:
         d.start()
     for d in drivers:
         d.join()
+    if killer is not None:
+        killer.join(timeout=10.0)
     wall = time.monotonic() - t_wall
 
     states: dict[str, int] = {}
+    reasons: dict[str, int] = {}
     for r in results:
         states[r["state"]] = states.get(r["state"], 0) + 1
+        if r.get("reason"):
+            reasons[r["reason"]] = reasons.get(r["reason"], 0) + 1
     completed = [r for r in results if r["state"] in ("done", "degraded")]
     lats = sorted(r["latency_s"] for r in completed)
     out = {
@@ -191,8 +354,23 @@ def run_load(base: str, manifest: dict, scans: int, rate: float,
                           if lats else None),
         "results": results,
     }
+    if reasons:
+        out["reject_reasons"] = reasons
+    if kill_info:
+        out["kill"] = kill_info
+    if hash_results:
+        # post-restart parity: every completion of the SAME (tenant,
+        # target) must serve the SAME bytes, killed gateway or not
+        groups: dict[tuple, set] = {}
+        for r in completed:
+            if r.get("sha_ply"):
+                groups.setdefault((r["tenant"], r["target"]), set()).add(
+                    (r["sha_ply"], r.get("sha_stl")))
+        out["parity_groups"] = len(groups)
+        out["parity_ok"] = (all(len(v) == 1 for v in groups.values())
+                            if groups else None)
     try:
-        _, raw = _get(base + "/metrics")
+        _, raw = _get(gw.base + "/metrics")
         text = raw.decode()
         launches = _scrape_counter(text, "sl3d_serve_launches_total")
         views = _scrape_counter(text, "sl3d_serve_launch_views_total")
@@ -227,16 +405,34 @@ def main(argv=None) -> int:
     ap.add_argument("--budget-s", type=float, default=0.0,
                     help="per-request SLO budget sent with every submit")
     ap.add_argument("--request-timeout-s", type=float, default=600.0)
+    ap.add_argument("--kill-after", type=float, default=0.0,
+                    help="SIGKILL the serving process (pid from "
+                         "serve.json) after this many seconds of load; "
+                         "needs --root")
+    ap.add_argument("--restart", action="store_true",
+                    help="with --kill-after: relaunch the service and "
+                         "report recovery time + post-restart parity")
+    ap.add_argument("--restart-cmd", default=None,
+                    help="shell command to relaunch the service "
+                         "(default: the argv recorded in serve.json)")
+    ap.add_argument("--hash-results", action="store_true",
+                    help="sha256 every completed PLY/STL and report "
+                         "parity per (tenant, target)")
     ap.add_argument("--out", default=None, help="write summary JSON here")
     args = ap.parse_args(argv)
     if not args.url and not args.root:
         ap.error("one of --url / --root is required")
+    if args.kill_after > 0 and not args.root:
+        ap.error("--kill-after needs --root")
     base = args.url or discover(args.root)
     with open(args.manifest) as f:
         manifest = json.load(f)
     out = run_load(base, manifest, args.scans, args.rate, seed=args.seed,
                    budget_s=args.budget_s,
-                   request_timeout_s=args.request_timeout_s)
+                   request_timeout_s=args.request_timeout_s,
+                   root=args.root, kill_after_s=args.kill_after,
+                   restart=args.restart, restart_cmd=args.restart_cmd,
+                   hash_results=args.hash_results)
     line = json.dumps(out)
     print(line)
     if args.out:
@@ -245,6 +441,9 @@ def main(argv=None) -> int:
     ok = (out["submitted"] > 0
           and all(r["state"] in ("done", "degraded")
                   for r in out["results"]))
+    if args.kill_after > 0:
+        ok = (ok and "kill_error" not in out.get("kill", {})
+              and out.get("parity_ok") is not False)
     return 0 if ok else 1
 
 

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""CI smoke for durable serving (ISSUE 13): a REAL ``sl3d serve``
+process, felled by an injected ``serve.crash`` at the assembly boundary
+(the process exits 137, a kill -9 twin, with the ledger fd dangling),
+then restarted over the same state root.
+
+Asserts, end to end over HTTP against the real CLI entry:
+  * the crashed process exited 137 WITHOUT journaling a finish — the
+    accepted request is non-terminal in the replayed ledger;
+  * the restarted (fault-free) process resumes the request to DONE with
+    ZERO recompute (``views_computed == 0`` — every view is a cache hit)
+    and its /result PLY + STL are byte-identical to a solo
+    ``run_pipeline`` of the same input: the PR-8 parity construction
+    carried across process death;
+  * the client's durable scan_id is idempotent across the crash — the
+    same re-POST returns the existing (done) request, not a new scan;
+  * SIGTERM on the restarted process drains and exits 0 ("stopped
+    cleanly" — a container stop is a resume point, not data loss).
+
+Prints ``SERVE_CHAOS_SMOKE=ok`` and exits 0 on success.
+"""
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from structured_light_for_3d_model_replication_tpu.io import matfile
+from structured_light_for_3d_model_replication_tpu.parallel.admission import (
+    TERMINAL,
+    replay_serving,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+from serve_smoke import STEPS, make_cfg, post_json, get, render_scan
+
+CAM, PROJ = (160, 120), (128, 64)
+
+# the smoke's pipeline shape, as CLI --set overrides (the subprocess must
+# run the SAME config the solo reference ran, or parity is vacuous)
+_SETS = [
+    "parallel.backend=numpy",
+    f"decode.n_cols={PROJ[0]}", f"decode.n_rows={PROJ[1]}",
+    "decode.thresh_mode=manual",
+    "merge.voxel_size=4.0", "merge.ransac_trials=512",
+    "merge.icp_iters=10",
+    "mesh.depth=5", "mesh.density_trim_quantile=0.0",
+    "serving.clean_steps=statistical",
+    "serving.host=127.0.0.1", "serving.port=0",
+]
+
+
+def launch(root: str, ready: str, log_path: str,
+           extra_sets=()) -> subprocess.Popen:
+    cmd = [sys.executable, "-m",
+           "structured_light_for_3d_model_replication_tpu.cli", "serve",
+           root, "--ready-file", ready]
+    for s in list(_SETS) + list(extra_sets):
+        cmd += ["--set", s]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    logf = open(log_path, "a")
+    return subprocess.Popen(cmd, stdout=logf, stderr=subprocess.STDOUT,
+                            env=env)
+
+
+def wait_ready(ready: str, proc: subprocess.Popen,
+               timeout_s: float = 120.0) -> str:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"serve exited {proc.returncode} before ready")
+        if os.path.exists(ready):
+            try:
+                with open(ready) as f:
+                    info = json.load(f)
+                base = f"http://{info['host']}:{info['port']}"
+                with urllib.request.urlopen(base + "/healthz",
+                                            timeout=5) as r:
+                    if json.loads(r.read()).get("ok"):
+                        return base
+            except (ValueError, OSError, urllib.error.URLError):
+                pass
+        time.sleep(0.1)
+    raise TimeoutError(f"serve not ready after {timeout_s}s")
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="sl3d_serve_chaos_")
+    try:
+        rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+        calib = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(calib, rig.calibration())
+        tgt = os.path.join(tmp, "in_tclean")
+        os.makedirs(tgt)
+        render_scan(tgt, views=2, shift=0.0)
+
+        solo = os.path.join(tmp, "solo")
+        rep = stages.run_pipeline(calib, tgt, solo, cfg=make_cfg(),
+                                  steps=STEPS, log=lambda m: None)
+        assert rep.failed == [], rep.failed
+        print("[serve_chaos] solo reference done "
+              f"({rep.merged_points:,} points)")
+
+        root = os.path.join(tmp, "svc")
+        log_path = os.path.join(tmp, "serve.log")
+        payload = {"tenant": "tclean", "target": tgt, "calib": calib,
+                   "scan_id": "c1"}
+
+        # ---- generation 1: armed to crash at the assembly boundary ----
+        ready1 = os.path.join(tmp, "ready1.json")
+        proc = launch(root, ready1, log_path,
+                      extra_sets=["faults.spec=serve.crash~assembly"
+                                  ":crash"])
+        base = wait_ready(ready1, proc)
+        print(f"[serve_chaos] gen-1 up at {base} (pid {proc.pid}, "
+              f"crash armed)")
+        body = post_json(f"{base}/submit", payload)
+        sid = body["scan_id"]
+        print(f"[serve_chaos] accepted {sid}; waiting for the crash")
+        rc = proc.wait(timeout=300)
+        assert rc == 137, f"expected exit 137 (injected crash), got {rc}"
+        print("[serve_chaos] gen-1 died 137 mid-flight (as injected)")
+
+        # no terminal state was journaled for the accepted request, and
+        # its durable record is on disk
+        rs = replay_serving(os.path.join(root, "ledger.jsonl"))
+        assert sid in rs["scans"], rs["scans"].keys()
+        assert rs["scans"][sid]["state"] not in TERMINAL, rs["scans"][sid]
+        assert os.path.exists(os.path.join(root, "requests",
+                                           f"{sid}.json"))
+        print(f"[serve_chaos] ledger: {sid} is "
+              f"{rs['scans'][sid]['state']!r} (non-terminal), "
+              f"{len(rs['completed'])} view(s) credited")
+
+        # ---- generation 2: fault-free restart over the same root ------
+        ready2 = os.path.join(tmp, "ready2.json")
+        proc = launch(root, ready2, log_path)
+        base = wait_ready(ready2, proc)
+        print(f"[serve_chaos] gen-2 up at {base} (pid {proc.pid})")
+        try:
+            t0 = time.monotonic()
+            while True:
+                d = json.loads(get(f"{base}/status/{sid}"))
+                if d["state"] in TERMINAL:
+                    break
+                assert time.monotonic() - t0 < 300.0, d
+                time.sleep(0.25)
+            assert d["state"] == "done", d
+            report = d.get("report") or {}
+            assert report.get("views_computed") == 0, report
+            print(f"[serve_chaos] resumed to done with zero recompute "
+                  f"({report.get('views_cached')} cached view(s))")
+
+            ply = get(f"{base}/result/{sid}?artifact=ply")
+            stl = get(f"{base}/result/{sid}?artifact=stl")
+            with open(os.path.join(solo, "merged.ply"), "rb") as f:
+                assert f.read() == ply, "PLY diverged across crash-restart"
+            with open(os.path.join(solo, "model.stl"), "rb") as f:
+                assert f.read() == stl, "STL diverged across crash-restart"
+            print("[serve_chaos] byte parity with solo run holds across "
+                  "the crash")
+
+            # durable idempotency: the client's retry of its original
+            # submit lands on the SAME (finished) request
+            body = post_json(f"{base}/submit", payload)
+            assert body.get("duplicate") is True, body
+            assert body["scan_id"] == sid and body["state"] == "done"
+            print("[serve_chaos] re-POST of the original submit is "
+                  "idempotent (duplicate of the done request)")
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        assert rc == 0, f"SIGTERM drain should exit 0, got {rc}"
+        with open(log_path) as f:
+            assert "stopped cleanly" in f.read()
+        print("[serve_chaos] SIGTERM drained and exited 0")
+        print("SERVE_CHAOS_SMOKE=ok")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,15 @@ coordinated runs under seeded ``worker.kill`` / ``worker.preempt(T)`` /
 ``net.partition(T)`` rules. The same never-hang contract applies, plus
 the work ledger must replay and every per-host journal must validate.
 
+``--serve-runs`` (ISSUE 13) appends a serving kill->restart matrix:
+each run drives a ScanService under a seeded serve-scope rule
+(``serve.crash`` at the grant/complete/assembly boundaries, or
+``ledger.append`` crash/transient), lets it crash or finish, then
+RESTARTS a fault-free service over the same root. The recovery
+contract: every accepted request must be terminal (done/degraded)
+after the restart, within budget, and ``replay_serving`` must fold the
+ledger without error.
+
 Prints ``SOAK=ok runs=N ...`` (exit 0) or ``SOAK=FAIL (...)`` (exit 1).
 CI runs a short arm (``tools/ci_tier1.sh`` SOAK_SMOKE); longer sweeps:
 
@@ -59,6 +68,14 @@ KINDS = ["transient", "permanent", "crash", "stall(0.8)", "slow(0.3)"]
 # the run must still terminate with a replayable ledger
 HOST_KINDS = ["worker.kill", "worker.preempt(0.3)", "net.partition(0.8)"]
 HOST_MATCH = ["", "w0", "w1"]
+
+# serving-scope kill matrix (ISSUE 13): crash the in-process service at
+# each durability boundary (grant journaled / bytes cached / assembly
+# started) or on the journal append itself; a transient on the append
+# surfaces as a full-disk-style submit failure the service must survive
+SERVE_RULES = ["serve.crash~grant:crash", "serve.crash~complete:crash",
+               "serve.crash~assembly:crash", "ledger.append:crash",
+               "ledger.append:transient"]
 
 
 def fail(why: str) -> int:
@@ -97,6 +114,10 @@ def main() -> int:
                     help="additional 2-worker coordinated runs drawn from "
                          "the host-scope kill matrix (worker.kill / "
                          "worker.preempt / net.partition); 0 disables")
+    ap.add_argument("--serve-runs", type=int, default=2,
+                    help="additional serving kill->restart runs drawn "
+                         "from the serve-scope matrix (serve.crash / "
+                         "ledger.append); 0 disables")
     args = ap.parse_args()
 
     from structured_light_for_3d_model_replication_tpu.cli import (
@@ -115,7 +136,8 @@ def main() -> int:
 
     # last line of defense: if the deadline layer itself wedges, dump every
     # thread's stack and die loudly instead of hanging CI
-    alarm_s = int(args.budget_s * (args.runs + args.multiproc_runs) + 120)
+    alarm_s = int(args.budget_s * (args.runs + args.multiproc_runs
+                                   + 2 * args.serve_runs) + 120)
 
     def on_alarm(signum, frame):
         faulthandler.dump_traceback(all_threads=True)
@@ -275,9 +297,93 @@ def main() -> int:
             outcomes[f"mp-{outcome}"] = outcomes.get(f"mp-{outcome}", 0) + 1
             print(f"[soak] mp run {i}: {outcome:<9} {wall:5.1f}s  [{spec}]")
 
+        # ---- serving kill->restart matrix (ISSUE 13): an in-process
+        # ScanService under a seeded serve-scope rule. Generation 1 runs
+        # until it crashes (phase=crashed, no finish journaled) or every
+        # request settles; generation 2 restarts FAULT-FREE over the same
+        # root and must bring every accepted request to done/degraded
+        # within budget, with a ledger replay_serving can fold.
+        from structured_light_for_3d_model_replication_tpu.parallel.admission import (  # noqa: E501
+            TERMINAL,
+            replay_serving,
+        )
+        from structured_light_for_3d_model_replication_tpu.pipeline import (
+            serving,
+        )
+
+        def serve_cfg() -> Config:
+            c = cfg()
+            # tiny synthetic clouds carry no dominant RANSAC plane: the
+            # default clean chain would fail every view
+            c.serving.clean_steps = "statistical"
+            return c
+
+        for i in range(args.serve_runs):
+            spec = rng.choice(SERVE_RULES)
+            sroot = os.path.join(tmp, f"serve_{i:03d}")
+            t0 = time.monotonic()
+            svc = serving.ScanService(sroot, cfg=serve_cfg(),
+                                      log=lambda m: None)
+            svc.start()
+            faults.configure(spec, seed=args.seed + 2000 + i)
+            crashed = False
+            try:
+                for tenant in ("ta", "tb"):
+                    svc.submit({"tenant": tenant, "target": root,
+                                "calib": calib})
+            except faults.InjectedCrash:
+                crashed = True       # died in the submit path itself
+            t_end = t0 + args.budget_s
+            while not crashed and time.monotonic() < t_end:
+                if svc.phase == "crashed":
+                    crashed = True
+                    break
+                with svc.adm.lock:
+                    jobs = list(svc.adm.jobs.values())
+                if jobs and all(j.state in TERMINAL for j in jobs):
+                    break
+                time.sleep(0.1)
+            faults.reset()
+            svc.close()
+            outcome = "crashed" if crashed else "survived"
+
+            svc2 = serving.ScanService(sroot, cfg=serve_cfg(),
+                                       log=lambda m: None)
+            svc2.start()
+            t_end = time.monotonic() + args.budget_s
+            settled = False
+            while time.monotonic() < t_end:
+                with svc2.adm.lock:
+                    jobs = list(svc2.adm.jobs.values())
+                if all(j.state in TERMINAL for j in jobs):
+                    settled = True
+                    break
+                time.sleep(0.1)
+            states = {j.scan_id: j.state for j in jobs}
+            svc2.close()
+            wall = time.monotonic() - t0
+            walls.append(round(wall, 1))
+            if not settled:
+                return fail(f"serve run {i} [{spec}] not settled after "
+                            f"restart: {states}")
+            bad = {s: st for s, st in states.items()
+                   if st not in ("done", "degraded")}
+            if bad:
+                return fail(f"serve run {i} [{spec}] accepted requests "
+                            f"not recovered: {bad}")
+            try:
+                rs = replay_serving(os.path.join(sroot, "ledger.jsonl"))
+            except ValueError as e:
+                return fail(f"serve run {i} [{spec}] ledger invalid: {e}")
+            outcomes[f"serve-{outcome}"] = \
+                outcomes.get(f"serve-{outcome}", 0) + 1
+            print(f"[soak] serve run {i}: {outcome:<9} {wall:5.1f}s  "
+                  f"[{spec}] ({len(states)} scan(s), "
+                  f"{len(rs['completed'])} credited item(s))")
+
         summary = json.dumps(outcomes, sort_keys=True)
         print(f"SOAK=ok runs={args.runs} seed={args.seed} "
-              f"multiproc={args.multiproc_runs} "
+              f"multiproc={args.multiproc_runs} serve={args.serve_runs} "
               f"outcomes={summary} max_wall={max(walls)}s")
         return 0
     finally:
