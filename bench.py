@@ -1421,6 +1421,17 @@ def bench_serve(tenants: int = 3, scans_per_tenant: int = 2,
     wall ratio and the contract is <= 1.02x. The off arm runs last, so
     compile-cache warmth can only inflate the ratio (conservative).
 
+    HA A/B (ISSUE 14): the same load once more with
+    ``serving.ha_enabled`` on — a single gateway that elects itself
+    (epoch 1) and renews its lease throughout, with every ledger append
+    epoch-stamped + fence-checked. ``ha_overhead_x`` is the HA/cross
+    wall ratio; contract <= 1.02x — leases and fencing must cost the
+    single-gateway hot path nothing measurable. Election happens BEFORE
+    the load starts (the arm waits for role=leader), so the measured
+    wall is pure serving; compile warmth is saturated by the earlier
+    arms (all arms run identical scans), so arm order doesn't move this
+    ratio.
+
     REQUIRES jax (the batched lane needs a device scanner) — runs under
     ``--serve-only`` (CPU-pinned unless the caller chose a platform) or
     the ``_run_serve_child`` subprocess from ``--pipeline-only``. The
@@ -1495,7 +1506,8 @@ def bench_serve(tenants: int = 3, scans_per_tenant: int = 2,
                 entries.append({"target": tgt, "calib": calib_path})
             manifest["tenants"][name] = entries
 
-        def mkcfg(max_active: int, durable: bool = True) -> Config:
+        def mkcfg(max_active: int, durable: bool = True,
+                  ha: bool = False) -> Config:
             c = Config()
             c.decode.n_cols, c.decode.n_rows = PIPE_PROJ
             c.decode.thresh_mode = "manual"
@@ -1510,13 +1522,16 @@ def bench_serve(tenants: int = 3, scans_per_tenant: int = 2,
             c.serving.port = 0
             c.serving.max_active_scans = max_active
             c.serving.durable = durable
+            if ha:
+                c.serving.ha_enabled = True
+                c.serving.ha_lease_s = 5.0
             return c
 
-        def run_arm(tag: str, max_active: int,
-                    durable: bool = True) -> tuple[dict, dict]:
+        def run_arm(tag: str, max_active: int, durable: bool = True,
+                    ha: bool = False) -> tuple[dict, dict]:
             root = os.path.join(tmp, f"svc_{tag}")
             httpd, svc = serving.start_gateway(
-                root, cfg=mkcfg(max_active, durable=durable),
+                root, cfg=mkcfg(max_active, durable=durable, ha=ha),
                 log=lambda m: None)
             th = threading.Thread(target=httpd.serve_forever,
                                   kwargs={"poll_interval": 0.1},
@@ -1525,6 +1540,13 @@ def bench_serve(tenants: int = 3, scans_per_tenant: int = 2,
             base = (f"http://{httpd.server_address[0]}:"
                     f"{httpd.server_address[1]}")
             try:
+                if ha:
+                    # Self-election takes ~one poll tick; wait it out so
+                    # the measured wall is pure serving, not election.
+                    t_end = time.time() + 60.0
+                    while svc.role != "leader" and time.time() < t_end:
+                        time.sleep(0.05)
+                    assert svc.role == "leader", svc.role
                 res = lg.run_load(base, manifest, scans_per_tenant,
                                   rate_hz, seed=seed, log=log)
             finally:
@@ -1558,6 +1580,19 @@ def bench_serve(tenants: int = 3, scans_per_tenant: int = 2,
         else:
             out["durability_overhead_x"] = None
             out["durability_overhead_ok"] = None
+
+        # ---- HA overhead A/B (ISSUE 14): same load, durable on, with
+        # leader election + per-append epoch fencing active. Compile
+        # warmth is saturated after the first arm, so running this last
+        # doesn't move the ratio vs the cross (non-HA) sample.
+        out["ha"], _ = run_arm("ha", max_active=tenants, ha=True)
+        wall_ha = out["ha"].get("wall_s")
+        if wall_on and wall_ha:
+            out["ha_overhead_x"] = round(wall_ha / wall_on, 3)
+            out["ha_overhead_ok"] = out["ha_overhead_x"] <= 1.02
+        else:
+            out["ha_overhead_x"] = None
+            out["ha_overhead_ok"] = None
 
         fill_c = out["cross"].get("mean_views_per_launch")
         fill_s = out["single"].get("mean_views_per_launch")

@@ -473,6 +473,21 @@ class ServingConfig:
     # its outcome closes or re-opens the breaker. 0 = disabled
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 30.0
+    # ---- gateway HA (ISSUE 14) ----
+    # run this gateway as a member of a leader-elected group over ONE
+    # shared root: exactly one member owns the engine (the leader, chosen
+    # through the fsync'd lease file <root>/leader.json), the rest serve
+    # reads and redirect /submit to the leader. Off = solo gateway,
+    # identical to PR-12 behaviour (no lease file, no fence checks)
+    ha_enabled: bool = False
+    # leader lease lifetime (sec): a leader that stops renewing for this
+    # long is considered dead and a standby takes over (epoch bump).
+    # Lower = faster failover, more lease-file traffic
+    ha_lease_s: float = 5.0
+    # leader renew cadence (sec); 0 = ha_lease_s / 3
+    ha_renew_s: float = 0.0
+    # follower takeover-poll cadence (sec); 0 = ha_lease_s / 5
+    ha_poll_s: float = 0.0
 
 
 @dataclass

@@ -144,7 +144,7 @@ class AdmissionController:
                  tenant_queue_quota: int = 8, queue_depth: int = 64,
                  max_queue_wait_s: float = 0.0, breaker_threshold: int = 0,
                  breaker_cooldown_s: float = 30.0, clock=time.monotonic,
-                 log=print):
+                 epoch=None, fence=None, log=print):
         self.lock = threading.RLock()
         self.log = log
         self.max_active_scans = int(max_active_scans)
@@ -156,7 +156,12 @@ class AdmissionController:
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self._clock = clock                      # injectable for tests
         self.leases = LeaseTable(lease_s)
-        self.ledger = Ledger(ledger_path, run_id, meta={"mode": "serving"})
+        # HA (ISSUE 14): the gateway's election handle supplies ``epoch``
+        # (stamps every journal line with the writer's fencing token) and
+        # ``fence`` (rejects the append of a deposed leader). Solo
+        # gateways pass neither and journal exactly as before.
+        self.ledger = Ledger(ledger_path, run_id, meta={"mode": "serving"},
+                             epoch=epoch, fence=fence)
         self.jobs: dict[str, ScanJob] = {}       # scan_id -> job
         self.queue: list[str] = []               # queued scan_ids, FIFO/tenant
         self.items: dict[str, _Item] = {}        # item id -> item
@@ -556,22 +561,32 @@ def replay_serving(path: str) -> dict:
     submit → queued, admit → admitted, warmed, finish → its terminal
     state (with error/report), shed, checkpoint → checkpointed, resume →
     queued again — plus each tenant's consecutive failed/aborted streak,
-    so circuit breakers survive restarts. Returns::
+    so circuit breakers survive restarts.
+
+    Epoch fencing (ISSUE 14): HA gateways stamp every line with the
+    writer's election epoch. The fold tracks the newest epoch seen so
+    far and IGNORES any later line carrying an older one — the append a
+    zombie leader raced past the live fence cannot resurrect state or
+    credit items the new leader's segment already owns. Lines without an
+    epoch (solo gateways, pre-HA ledgers) are never fenced. Returns::
 
         {"scans": {scan_id: {"tenant", "state", "target", "calib",
                              "out_dir", "weight", "budget_s",
                              "submitted_unix", "error", "report",
                              "elapsed_s"}},
          "completed": set[item_id], "tenant_fails": {tenant: int},
-         "segments": int, "events": int}
+         "segments": int, "events": int,
+         "max_epoch": int, "stale_ignored": int}
     """
     scans: dict[str, dict] = {}
     completed: set[str] = set()
     tenant_fails: dict[str, int] = {}
     segments = events = 0
+    max_epoch = stale_ignored = 0
     if not os.path.exists(path):
         return {"scans": scans, "completed": completed,
-                "tenant_fails": tenant_fails, "segments": 0, "events": 0}
+                "tenant_fails": tenant_fails, "segments": 0, "events": 0,
+                "max_epoch": 0, "stale_ignored": 0}
 
     def rec_for(rec: dict) -> dict:
         sid = rec["scan"]
@@ -594,6 +609,13 @@ def replay_serving(path: str) -> dict:
                 ev = json.loads(line)
             except ValueError:
                 continue        # torn tail from a crash mid-append
+            e = ev.get("epoch")
+            if e is not None:
+                e = int(e)
+                if e < max_epoch:
+                    stale_ignored += 1   # fenced-out zombie append
+                    continue
+                max_epoch = e
             t = ev.get("type")
             if t == "meta":
                 if ev.get("schema") != LEDGER_SCHEMA:
@@ -643,4 +665,5 @@ def replay_serving(path: str) -> dict:
                     tenant_fails[tenant] = 0
     return {"scans": scans, "completed": completed,
             "tenant_fails": tenant_fails, "segments": segments,
-            "events": events}
+            "events": events, "max_epoch": max_epoch,
+            "stale_ignored": stale_ignored}

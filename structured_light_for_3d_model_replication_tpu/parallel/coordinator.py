@@ -71,12 +71,23 @@ class Ledger:
     line-buffered and fsynced — a torn final line (kill -9 mid-write) is
     tolerated by replay, never repaired in place."""
 
-    def __init__(self, path: str, run_id: str, meta: dict | None = None):
+    def __init__(self, path: str, run_id: str, meta: dict | None = None,
+                 epoch=None, fence=None):
+        # HA serving (ISSUE 14): ``epoch`` is a callable returning the
+        # writer's current fencing token — every line gets stamped with
+        # it; ``fence`` is called before each append and raises (e.g.
+        # election.FencedWrite) to REJECT the write of a deposed leader.
+        # Both default off: solo pipelines and the PR-8 coordinator pay
+        # nothing.
         self.path = path
         self._lock = threading.Lock()
+        self._epoch = epoch
+        self._fence = fence
         self._f = open(path, "a", encoding="utf-8")
         head = {"type": "meta", "schema": LEDGER_SCHEMA, "run_id": run_id,
                 "t0_unix": time.time()}
+        if epoch is not None:
+            head["epoch"] = int(epoch())
         head.update(meta or {})
         self._append(head)
 
@@ -91,7 +102,11 @@ class Ledger:
         # torn-tail / lost-line case replay must tolerate), a transient
         # here surfaces to the caller exactly like a full-disk write
         faults.fire("ledger.append", item=type_)
+        if self._fence is not None:
+            self._fence()
         rec = {"type": type_, "t": round(time.time(), 6)}
+        if self._epoch is not None:
+            rec["epoch"] = int(self._epoch())
         rec.update(fields)
         self._append(rec)
 

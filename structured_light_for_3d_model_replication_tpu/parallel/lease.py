@@ -20,6 +20,13 @@ Invariants:
     per-worker, not per-item, so a worker deep in one long item keeps its
     whole grant set alive (the OverlapStats.add hook beats on every stage
     transition, which is far more often than lease_s).
+
+The same grant/renew/expire/steal + monotonic-counter-fencing protocol,
+lifted from in-process items to whole PROCESSES, is ``parallel/
+election.py`` (ISSUE 14): one leader lease per serving root instead of
+one lease per item, the takeover epoch instead of the generation, and
+``FencedWrite`` instead of the stale-``complete`` rejection — the
+late-echo rule here is literally the fencing rule there.
 """
 from __future__ import annotations
 

@@ -362,6 +362,16 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("--ready-file", default=None,
                    help="also write the bound-address JSON here once "
                         "listening (CI/loadgen discovery handshake)")
+    p.add_argument("--ha", action="store_true", default=None,
+                   help="join the leader-elected gateway group over this "
+                        "root (serving.ha_enabled): exactly one member "
+                        "owns the engine, the rest serve reads and "
+                        "redirect /submit to the leader; kill the leader "
+                        "and a standby takes over within --ha-lease "
+                        "seconds with zero recompute")
+    p.add_argument("--ha-lease", type=float, default=None,
+                   help="leader lease lifetime in seconds — the failover "
+                        "bound (default: serving.ha_lease_s)")
     add_config_args(p)
 
     p = sub.add_parser("viewer",
@@ -809,6 +819,10 @@ def _cmd_serve(args) -> int:
         cfg.serving.max_active_scans = args.max_active_scans
     if args.drain_budget is not None:
         cfg.serving.drain_budget_s = args.drain_budget
+    if args.ha:
+        cfg.serving.ha_enabled = True
+    if args.ha_lease is not None:
+        cfg.serving.ha_lease_s = args.ha_lease
     return serving.serve(args.root, cfg=cfg,
                          ready_file=args.ready_file)
 

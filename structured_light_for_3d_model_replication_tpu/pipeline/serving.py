@@ -77,10 +77,37 @@ Durability (ISSUE 13) — the service state outlives the process:
             ``http.submit`` in the gateway — the kill→restart matrix in
             ``tools/soak.py`` and the SERVE_CHAOS_SMOKE CI arm drive
             them end to end.
+
+Gateway HA (ISSUE 14) — ``serving.ha_enabled`` runs N gateways over ONE
+shared root, exactly one owning the engine at a time:
+
+  election  ``parallel/election.py``: an fsync'd, atomically-renewed
+            leader lease (``<root>/leader.json``) with a monotonic epoch
+            that bumps on every takeover. Followers bind HTTP, serve
+            reads (/status /result /metrics /healthz answer from a
+            cached fold of the shared ledger + the shared artifact
+            tree), and answer /submit with a machine-readable
+            ``not-leader`` redirect carrying the leader's address.
+  fencing   the leader's ledger appends and request records are stamped
+            with its epoch and pass ``LeaderLease.fence`` first — a
+            deposed leader waking from a stall has the write REJECTED
+            (``FencedWrite``) and self-demotes; ``replay_serving``
+            applies the same rule offline, ignoring stale-epoch lines.
+            Split-brain therefore cannot interleave two writers' credit:
+            at most one epoch's appends are ever folded past a takeover.
+  takeover  is exactly the restart-resume path run on the standby:
+            replay ledger + request records, re-queue non-terminal
+            scans, finish ledger-credited views as pure cache hits
+            (``views_computed == 0``, byte parity by construction).
+            ``serve.json`` is atomically rewritten with the new epoch so
+            clients re-discover. ``election.acquire``/``election.renew``
+            chaos sites + ``tools/soak.py --ha-runs`` + the HA_SMOKE CI
+            arm prove the failover bound end to end.
 """
 from __future__ import annotations
 
 import copy
+import fcntl
 import json
 import os
 import re
@@ -106,6 +133,7 @@ from structured_light_for_3d_model_replication_tpu.parallel.admission import (
 from structured_light_for_3d_model_replication_tpu.parallel.admission import (
     TERMINAL as _TERMINAL,
 )
+from structured_light_for_3d_model_replication_tpu.parallel import election
 from structured_light_for_3d_model_replication_tpu.pipeline.stagecache import (
     TenantCache,
 )
@@ -126,11 +154,14 @@ REQUEST_SCHEMA = "sl3d-request-v1"
 
 # machine-readable /submit rejection reasons -> HTTP status. 429 =
 # per-tenant/backlog quota (client backs off and retries), 503 =
-# service-side refusal (draining, open breaker, injected transient —
-# retry after Retry-After), 409 = durable-id conflict, 400 = malformed
+# service-side refusal (draining, open breaker, injected transient,
+# HA follower redirect — retry after Retry-After, at the advertised
+# leader when the body carries one), 409 = durable-id conflict,
+# 400 = malformed
 _REASON_HTTP = {"tenant-queue-quota": 429, "queue-full": 429,
                 "draining": 503, "stopped": 503, "crashed": 503,
                 "circuit-open": 503, "transient": 503,
+                "not-leader": 503,
                 "scan-id-conflict": 409, "bad-request": 400}
 
 
@@ -182,15 +213,35 @@ class ScanService:
         self.run_id = tel.new_run_id()
         self.registry = tel.MetricsRegistry()
         scfg = self.cfg.serving
-        self.adm = AdmissionController(
-            os.path.join(self.root, "ledger.jsonl"), self.run_id,
-            lease_s=scfg.lease_s, max_active_scans=scfg.max_active_scans,
-            tenant_active_quota=scfg.tenant_active_quota,
-            tenant_queue_quota=scfg.tenant_queue_quota,
-            queue_depth=scfg.queue_depth,
-            max_queue_wait_s=scfg.max_queue_wait_s,
-            breaker_threshold=scfg.breaker_threshold,
-            breaker_cooldown_s=scfg.breaker_cooldown_s, log=log)
+        self._ledger_path = os.path.join(self.root, "ledger.jsonl")
+        # HA (ISSUE 14): with ha_enabled this gateway joins a leader-
+        # elected group over the shared root. It boots as a FOLLOWER —
+        # no ledger open, no engine — and only builds the admission
+        # core when it wins the lease (see _promote). role is one of
+        # solo | follower | leader | demoting.
+        self.ha = bool(scfg.ha_enabled)
+        self.role = "follower" if self.ha else "solo"
+        self.election: election.LeaderLease | None = None
+        self._adv: dict | None = None   # advertised address (gateway)
+        self._guard_f = None            # single-writer flock (solo mode)
+        self._ha_thread: threading.Thread | None = None
+        self._reign_threads: list[threading.Thread] = []
+        self._lead_stop = threading.Event()   # set on demotion only
+        self._demote_lock = threading.Lock()
+        self._view_key: tuple | None = None   # follower fold cache
+        self._view_rs: dict | None = None
+        if self.ha:
+            self.election = election.LeaderLease(
+                os.path.join(self.root, "leader.json"),
+                owner=self.run_id, lease_s=scfg.ha_lease_s)
+            self._probe_guard()
+            self.adm: AdmissionController | None = None
+        else:
+            # single-writer guard BEFORE the ledger opens: a second solo
+            # gateway on this root must fail fast, not interleave meta
+            # lines into a ledger someone else is serving from
+            self._acquire_guard()
+            self.adm = self._make_adm()
         # lifecycle phase: ready -> draining -> stopped (crashed when an
         # injected crash felled the in-process service). A bare
         # ScanService accepts submits from construction (tests drive it
@@ -214,23 +265,332 @@ class ScanService:
         self._seq = 0
         self._seq_lock = threading.Lock()
 
+    # ---- HA plumbing -----------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        """This gateway's fencing token: 0 for solo gateways and
+        followers, the held lease epoch while leading."""
+        return self.election.epoch if self.election is not None else 0
+
+    def _make_adm(self) -> AdmissionController:
+        scfg = self.cfg.serving
+        ep = fence = None
+        if self.election is not None:
+            ep = lambda: self.election.epoch      # noqa: E731
+            fence = self.election.fence
+        return AdmissionController(
+            self._ledger_path, self.run_id,
+            lease_s=scfg.lease_s, max_active_scans=scfg.max_active_scans,
+            tenant_active_quota=scfg.tenant_active_quota,
+            tenant_queue_quota=scfg.tenant_queue_quota,
+            queue_depth=scfg.queue_depth,
+            max_queue_wait_s=scfg.max_queue_wait_s,
+            breaker_threshold=scfg.breaker_threshold,
+            breaker_cooldown_s=scfg.breaker_cooldown_s,
+            epoch=ep, fence=fence, log=self.log)
+
+    def _guard_path(self) -> str:
+        return os.path.join(self.root, "serve.lock")
+
+    def _acquire_guard(self) -> None:
+        """Single-writer guard for SOLO gateways (ISSUE 14 satellite):
+        hold an exclusive flock on ``<root>/serve.lock`` for the life of
+        the service. A second solo gateway on the same root fails fast
+        with who-owns-it instead of silently interleaving ledger
+        appends. Same-pid contention is tolerated — an in-process
+        crash-restart twin (tests, soak) still holds the dead instance's
+        fd, and the pid proves it is us."""
+        lp = os.path.join(self.root, "leader.json")
+        try:
+            with open(lp, encoding="utf-8") as f:
+                cur = json.load(f)
+        except (OSError, ValueError):
+            cur = None
+        if (cur is not None
+                and float(cur.get("expires_unix", 0.0)) > time.time()):
+            raise RuntimeError(
+                f"root {self.root} already served by HA leader "
+                f"{cur.get('owner')!r} (pid {cur.get('pid')}, epoch "
+                f"{cur.get('epoch')}); start this gateway with "
+                f"serving.ha_enabled to join the group")
+        f = open(self._guard_path(), "a+", encoding="utf-8")
+        try:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            f.seek(0)
+            try:
+                info = json.load(f)
+            except ValueError:
+                info = {}
+            f.close()
+            if int(info.get("pid", -1)) == os.getpid():
+                self.log("[serve] serve.lock held by this process "
+                         "(in-process restart); continuing")
+                return
+            raise RuntimeError(
+                f"root {self.root} already served by pid "
+                f"{info.get('pid')} (run {info.get('run_id')}, "
+                f"{'HA epoch %s' % info.get('epoch') if info.get('ha') else 'solo'}"
+                f"); refusing a second writer — stop it or run an HA "
+                f"group (serving.ha_enabled)") from None
+        f.seek(0)
+        f.truncate()
+        json.dump({"pid": os.getpid(), "run_id": self.run_id,
+                   "ha": False, "epoch": 0}, f)
+        f.flush()
+        self._guard_f = f
+
+    def _probe_guard(self) -> None:
+        """HA members don't HOLD the flock (a zombie's fd must never
+        block a takeover — the lease file is their arbiter), but they do
+        refuse to join a root a SOLO gateway is actively serving."""
+        try:
+            f = open(self._guard_path(), "r+", encoding="utf-8")
+        except OSError:
+            return
+        try:
+            try:
+                fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+            except OSError:
+                f.seek(0)
+                try:
+                    info = json.load(f)
+                except ValueError:
+                    info = {}
+                if (not info.get("ha")
+                        and int(info.get("pid", -1)) != os.getpid()):
+                    raise RuntimeError(
+                        f"root {self.root} already served by solo "
+                        f"gateway pid {info.get('pid')} (run "
+                        f"{info.get('run_id')}); stop it before "
+                        f"starting an HA group") from None
+        finally:
+            f.close()
+
+    def _release_guard(self) -> None:
+        if self._guard_f is None:
+            return
+        try:
+            fcntl.flock(self._guard_f.fileno(), fcntl.LOCK_UN)
+        except OSError:
+            pass
+        try:
+            self._guard_f.close()
+        except OSError:
+            pass
+        self._guard_f = None
+
+    def advertise(self, host: str, port: int, argv=None) -> None:
+        """Record this gateway's bound address — the leader lease and
+        serve.json both carry it so clients and followers can point at
+        the current leader. Called by start_gateway before start()."""
+        self._adv = {"host": host, "port": int(port),
+                     "argv": list(argv if argv is not None else sys.argv)}
+        if self.election is not None:
+            self.election.info.update(host=host, port=int(port))
+
+    def _publish_serve_json(self) -> None:
+        """The discovery handshake, epoch-stamped and ATOMICALLY
+        rewritten (ISSUE 14 satellite): a client holding a stale leader
+        address re-reads this file and sees a newer epoch + address
+        instead of retrying a dead socket forever. Solo gateways write
+        it once at startup (epoch 0); HA leaders rewrite it on every
+        takeover."""
+        if self._adv is None:
+            return
+        info = {"host": self._adv["host"], "port": self._adv["port"],
+                "pid": os.getpid(), "run_id": self.run_id,
+                "root": self.root, "argv": self._adv["argv"],
+                "role": self.role, "epoch": self.epoch}
+        path = os.path.join(self.root, "serve.json")
+        with atomic_write(path) as tmp:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(info, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+
+    def _redirect_body(self) -> dict:
+        """The follower's /submit answer: PR-12's machine-readable
+        rejection envelope pointing at the current leader."""
+        scfg = self.cfg.serving
+        body = {"error": f"this gateway is a {self.role}; submit to "
+                         f"the leader",
+                "reason": "not-leader", "role": self.role,
+                "retry_after_s": round(
+                    scfg.ha_poll_s or max(0.1, scfg.ha_lease_s / 5.0), 3)}
+        cur = self.election.current() if self.election is not None else None
+        if cur is not None:
+            body["epoch"] = int(cur.get("epoch", 0))
+            if cur.get("host") is not None and cur.get("port") is not None:
+                body["leader"] = {
+                    "host": cur["host"], "port": cur["port"],
+                    "url": f"http://{cur['host']}:{cur['port']}"}
+        return body
+
     # ---- lifecycle -------------------------------------------------------
 
     def start(self) -> None:
         scfg = self.cfg.serving
+        if self.ha:
+            # HA member: the election loop owns the engine lifecycle —
+            # it promotes (building admission + lanes) when this member
+            # wins the lease and demotes when it loses it
+            self._ha_thread = threading.Thread(
+                target=self._ha_loop, name="sl3d-serve-ha", daemon=True)
+            self._ha_thread.start()
+            self.log(f"[serve] HA member up (run {self.run_id}) "
+                     f"root={self.root} — awaiting election")
+            return
         if scfg.durable:
             self._resume()
+        self._threads.extend(self._start_engine_threads())
+        self.log(f"[serve] service up (run {self.run_id}) root={self.root}")
+
+    def _start_engine_threads(self) -> list[threading.Thread]:
+        scfg = self.cfg.serving
+        lead = self._lead_stop
+        ths: list[threading.Thread] = []
         for i in range(max(1, scfg.engine_lanes)):
             t = threading.Thread(target=self._engine_loop,
-                                 args=(f"lane{i}",),
+                                 args=(f"lane{i}", lead),
                                  name=f"sl3d-serve-engine-{i}", daemon=True)
             t.start()
-            self._threads.append(t)
-        t = threading.Thread(target=self._assembler_loop,
+            ths.append(t)
+        t = threading.Thread(target=self._assembler_loop, args=(lead,),
                              name="sl3d-serve-assembler", daemon=True)
         t.start()
-        self._threads.append(t)
-        self.log(f"[serve] service up (run {self.run_id}) root={self.root}")
+        ths.append(t)
+        return ths
+
+    # ---- HA lifecycle ----------------------------------------------------
+
+    def _ha_loop(self) -> None:
+        """The member's election state machine. Followers try to acquire
+        every poll tick (cheap: one flock'd read, a write only on a win);
+        the leader renews every renew tick. A renew that comes back
+        superseded — the manufactured zombie case: a stalled renew let
+        the lease expire and a standby stole it — demotes; the fence on
+        every ledger append is the backstop for writes already in
+        flight."""
+        scfg = self.cfg.serving
+        renew_s = scfg.ha_renew_s or max(0.1, scfg.ha_lease_s / 3.0)
+        poll_s = scfg.ha_poll_s or max(0.1, scfg.ha_lease_s / 5.0)
+        while not self._stop.is_set():
+            if self.role == "leader":
+                ok = True
+                try:
+                    ok = self.election.renew()
+                except faults.InjectedCrash as e:
+                    self._crash("election.renew", e)
+                    return
+                except BaseException as e:
+                    # transient lease-file trouble: keep leading, retry
+                    # next tick — expiry + steal is the real arbiter
+                    self.log(f"[serve] lease renew error: "
+                             f"{type(e).__name__}: {e}")
+                if not ok:
+                    self._request_demote("lease lost (renew superseded)")
+                self._stop.wait(renew_s)
+            elif self.role == "follower" and self.phase == "ready":
+                won = False
+                try:
+                    won = self.election.acquire()
+                except faults.InjectedCrash as e:
+                    self._crash("election.acquire", e)
+                    return
+                except BaseException as e:
+                    self.log(f"[serve] lease acquire error: "
+                             f"{type(e).__name__}: {e}")
+                if won and not self._stop.is_set():
+                    try:
+                        self._promote()
+                    except BaseException as e:
+                        self.log(f"[serve] promotion FAILED: "
+                                 f"{type(e).__name__}: {e}")
+                        try:
+                            self.election.release()
+                        except Exception:
+                            pass
+                else:
+                    self._stop.wait(poll_s)
+            else:           # demoting (a worker thread is tearing down)
+                self._stop.wait(poll_s)
+
+    def _promote(self) -> None:
+        """Takeover: PR-12's restart-resume run on the standby. Open a
+        new ledger segment stamped with our epoch, fold what every
+        previous epoch journaled, re-queue non-terminal scans (their
+        credited views are already cache bytes — zero recompute), start
+        the engine, and atomically republish serve.json so clients
+        re-discover."""
+        ep = self.election.epoch
+        self.log(f"[serve] elected LEADER (epoch {ep}, run {self.run_id})")
+        self._lead_stop = threading.Event()
+        self.adm = self._make_adm()
+        try:
+            self.adm.ledger.event("takeover", owner=self.run_id)
+            if self.cfg.serving.durable:
+                self._resume()
+        except BaseException:
+            adm, self.adm = self.adm, None
+            try:
+                adm.close()
+            except Exception:
+                pass
+            raise
+        self._reign_threads = self._start_engine_threads()
+        with self._demote_lock:
+            self.role = "leader"
+        self.registry.inc("sl3d_serve_takeovers_total")
+        self._publish_serve_json()
+
+    def _request_demote(self, why: str) -> None:
+        """Thread-safe, idempotent-per-reign demotion trigger — safe to
+        call from the engine/assembler threads being torn down (the
+        teardown runs on a helper thread and never joins its caller)."""
+        with self._demote_lock:
+            if not self.ha or self.role != "leader":
+                return
+            self.role = "demoting"
+        threading.Thread(target=self._demote, args=(why,),
+                         daemon=True).start()
+
+    def _demote(self, why: str) -> None:
+        self.log(f"[serve] DEPOSED (epoch {self.election.epoch}): {why} "
+                 f"— demoting to follower")
+        self._lead_stop.set()
+        with self._assembly_cv:
+            self._assembly_cv.notify_all()
+        # an in-flight assembly is left to FINISH, not aborted: its
+        # terminal journal line is fenced (the new leader owns the
+        # credit) and its artifacts are byte-identical to what the new
+        # leader produces over the same cache, so letting it run is
+        # harmless — while dl.current() is process-global and may
+        # belong to the NEW leader's run when both members share a
+        # process (tests, soak), so aborting it could kill the wrong
+        # reign's work
+        me = threading.current_thread()
+        for t in self._reign_threads:
+            if t is not me and t.is_alive():
+                t.join()        # unbounded: engine/assembly always end
+        self._reign_threads = []
+        adm, self.adm = self.adm, None
+        if adm is not None:
+            try:
+                adm.close()
+            except Exception:
+                pass
+        with self._scan_lock:
+            self._scans.clear()
+            self._scanners.clear()
+        with self._assembly_cv:
+            self._assembly_q.clear()
+        self.election.epoch = 0
+        self.registry.inc("sl3d_serve_demotions_total")
+        with self._demote_lock:
+            self.role = "follower"
 
     def _resume(self) -> None:
         """Restart-resume: request records + ledger replay → the queue a
@@ -316,6 +676,8 @@ class ScanService:
         budget = scfg.drain_budget_s if budget_s is None else budget_s
         self.phase = "draining"
         self._draining.set()
+        if self.adm is None:      # HA follower: nothing in flight here
+            return {"finished": 0, "checkpointed": []}
         try:
             self.adm.ledger.event("drain", budget_s=budget)
         except Exception:
@@ -367,9 +729,24 @@ class ScanService:
         self._stop.set()
         with self._assembly_cv:
             self._assembly_cv.notify_all()
-        for t in self._threads:
+        for t in self._threads + self._reign_threads:
             t.join(timeout=10.0)
-        self.adm.close()
+        if self._ha_thread is not None:
+            self._ha_thread.join(timeout=10.0)
+        adm = self.adm
+        if adm is not None:
+            adm.close()
+        if (self.election is not None and self.election.epoch > 0
+                and self.phase != "crashed"):
+            # graceful step-down: expire the lease NOW so the standby
+            # takes over on its next poll. A crashed service must NOT
+            # release — simulated process death hands over by expiry,
+            # exactly like the real kill -9
+            try:
+                self.election.release()
+            except Exception:
+                pass
+        self._release_guard()
         if self.phase != "crashed":
             self.phase = "stopped"
 
@@ -409,6 +786,12 @@ class ScanService:
                                       if self.phase == "draining"
                                       else self.phase),
                            "retry_after_s": max(1.0, scfg.drain_budget_s)}
+        adm = self.adm
+        if self.ha and (self.role != "leader" or adm is None):
+            # HA follower / mid-transition member: machine-readable
+            # redirect to the current leader (the PR-12 envelope)
+            self.registry.inc("sl3d_serve_redirected_total")
+            return False, self._redirect_body()
         tenant = _safe_id(payload.get("tenant"), "anon")
         target = str(payload.get("target") or "")
         calib = str(payload.get("calib") or "")
@@ -434,8 +817,8 @@ class ScanService:
                       budget_s=float(budget or 0.0))
         persist = self._write_record if scfg.durable else None
         try:
-            with self.adm.lock:
-                prior = self.adm.jobs.get(scan_id)
+            with adm.lock:
+                prior = adm.jobs.get(scan_id)
                 if prior is not None:
                     if (prior.tenant, prior.target, prior.calib) == \
                             (job.tenant, job.target, job.calib):
@@ -446,9 +829,15 @@ class ScanService:
                                             "exists with different "
                                             "inputs",
                                    "reason": "scan-id-conflict"}
-                ok, info = self.adm.submit(job, persist=persist)
+                ok, info = adm.submit(job, persist=persist)
         except faults.InjectedCrash:
             raise
+        except election.FencedWrite as e:
+            # deposed between the role check and the journal append: the
+            # fence rejected the write before any line hit the ledger
+            self.log(f"[serve] submit fenced: {e}")
+            self._request_demote(f"submit: {e}")
+            return False, self._redirect_body()
         except BaseException as e:
             # durable-record or journal write failed: nothing admitted,
             # the client can safely retry the same scan_id
@@ -476,7 +865,8 @@ class ScanService:
                "tenant": job.tenant, "target": job.target,
                "calib": job.calib, "out_dir": job.out_dir,
                "weight": job.weight, "budget_s": job.budget_s,
-               "submitted_unix": job.submitted_unix}
+               "submitted_unix": job.submitted_unix,
+               "epoch": self.epoch}   # writer's fencing token (HA)
         path = os.path.join(self.requests_dir, f"{job.scan_id}.json")
         with atomic_write(path) as tmp:
             with open(tmp, "w", encoding="utf-8") as f:
@@ -484,36 +874,84 @@ class ScanService:
                 f.flush()
                 os.fsync(f.fileno())
 
+    def _follower_view(self) -> dict:
+        """The follower read model: a fold of the SHARED ledger, cached
+        by (size, mtime) so /status polls don't re-fold an unchanged
+        file. Epoch fencing inside replay_serving means a follower never
+        reports state a deposed writer raced in."""
+        try:
+            st = os.stat(self._ledger_path)
+            key = (st.st_size, st.st_mtime_ns)
+        except OSError:
+            key = None
+        if key is not None and key == self._view_key \
+                and self._view_rs is not None:
+            return self._view_rs
+        rs = replay_serving(self._ledger_path)
+        self._view_key, self._view_rs = key, rs
+        return rs
+
     def status(self, scan_id: str) -> dict | None:
-        with self.adm.lock:
-            job = self.adm.jobs.get(scan_id)
+        adm = self.adm
+        if adm is None:       # HA follower: answer from the shared ledger
+            r = self._follower_view()["scans"].get(scan_id)
+            if r is None:
+                return None
+            return {"scan_id": scan_id, "tenant": r["tenant"],
+                    "state": r["state"], "error": r["error"],
+                    "report": r["report"], "elapsed_s": r["elapsed_s"],
+                    "items": {}, "via": "follower-replay"}
+        with adm.lock:
+            job = adm.jobs.get(scan_id)
             if job is None:
                 return None
             d = job.as_dict()
-            d["items"] = self.adm.scan_item_states(scan_id)
+            d["items"] = adm.scan_item_states(scan_id)
             return d
 
     def result_path(self, scan_id: str, artifact: str) -> tuple[str, dict]:
-        """Path of a finished request's artifact, or ("", error-body)."""
-        with self.adm.lock:
-            job = self.adm.jobs.get(scan_id)
-        if job is None:
-            return "", {"error": f"unknown scan_id {scan_id!r}"}
-        if job.state not in ("done", "degraded"):
-            return "", {"error": f"scan {scan_id!r} is {job.state}",
-                        "state": job.state}
+        """Path of a finished request's artifact, or ("", error-body).
+        Works on followers too: artifacts live on the SHARED root, and
+        the ledger fold says which requests are terminal."""
+        adm = self.adm
+        if adm is None:
+            r = self._follower_view()["scans"].get(scan_id)
+            if r is None:
+                return "", {"error": f"unknown scan_id {scan_id!r}"}
+            state, out_dir = r["state"], r["out_dir"]
+        else:
+            with adm.lock:
+                job = adm.jobs.get(scan_id)
+            if job is None:
+                return "", {"error": f"unknown scan_id {scan_id!r}"}
+            state, out_dir = job.state, job.out_dir
+        if state not in ("done", "degraded"):
+            return "", {"error": f"scan {scan_id!r} is {state}",
+                        "state": state}
         name = {"ply": "merged.ply", "stl": "model.stl"}.get(artifact)
         if name is None:
             return "", {"error": f"unknown artifact {artifact!r} "
                                  "(want ply|stl)"}
-        path = os.path.join(job.out_dir, name)
+        path = os.path.join(out_dir, name)
         if not os.path.isfile(path):
             return "", {"error": f"{name} missing for {scan_id!r}"}
         return path, {}
 
     def snapshot(self) -> dict:
-        snap = self.adm.snapshot()
+        adm = self.adm
+        if adm is None:
+            states = [r["state"]
+                      for r in self._follower_view()["scans"].values()]
+            snap = {"active": sum(1 for s in states
+                                  if s in ("admitted", "warmed",
+                                           "assembling")),
+                    "queued": states.count("queued"),
+                    "scans": len(states)}
+        else:
+            snap = adm.snapshot()
         snap["run_id"] = self.run_id
+        snap["role"] = self.role
+        snap["epoch"] = self.epoch
         return snap
 
     # ---- engine: plan ----------------------------------------------------
@@ -591,10 +1029,10 @@ class ScanService:
 
     # ---- engine: item programs ------------------------------------------
 
-    def _engine_loop(self, lane: str) -> None:
+    def _engine_loop(self, lane: str, lead: threading.Event) -> None:
         poll = max(0.01, self.cfg.serving.poll_s)
         batch_n = max(1, self.cfg.parallel.compute_batch)
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not lead.is_set():
             try:
                 self.adm.sweep_expired()
                 for job in self.adm.shed_expired():
@@ -604,6 +1042,8 @@ class ScanService:
                     for job in self.adm.admit_next():
                         try:
                             self._plan(job)
+                        except election.FencedWrite:
+                            raise
                         except Exception as e:
                             self.adm.finish(job.scan_id, "failed",
                                             error=f"plan: {e}")
@@ -621,6 +1061,13 @@ class ScanService:
                 # survive: it simulates process death (restart-resume is
                 # the recovery path, not this loop)
                 self._crash(f"engine {lane}", e)
+                return
+            except election.FencedWrite as e:
+                # a journal append was rejected: this gateway was deposed
+                # while the lane worked. Nothing hit the ledger; the new
+                # leader's resume owns every affected scan. Self-demote.
+                self.log(f"[serve] engine {lane}: write fenced ({e})")
+                self._request_demote(f"engine {lane}: {e}")
                 return
             except BaseException as e:
                 # the engine must survive anything else an item throws at
@@ -650,7 +1097,7 @@ class ScanService:
                     "load",
                     lambda s=spec["src"]: st._load_fired(s, self.cfg),
                     self._policy)
-            except faults.InjectedCrash:
+            except (faults.InjectedCrash, election.FencedWrite):
                 raise
             except BaseException as e:
                 self.adm.failed(iid, lane, gen, f"load: {e}")
@@ -705,7 +1152,7 @@ class ScanService:
                     spec["src"])),
                 self._policy)
             self._finish_item(lane, iid, gen, spec, ctx, pts, cols)
-        except faults.InjectedCrash:
+        except (faults.InjectedCrash, election.FencedWrite):
             raise
         except BaseException as e:
             self.adm.failed(iid, lane, gen, f"compute: {e}")
@@ -766,14 +1213,14 @@ class ScanService:
                             tri.CloudResult(pts_v[j], cols_v[j], val_v[j]))
                         self._finish_item(lane, iid, gen, spec, ctx, pts,
                                           cols)
-                    except faults.InjectedCrash:
+                    except (faults.InjectedCrash, election.FencedWrite):
                         raise
                     except BaseException as e:
                         self.adm.failed(iid, lane, gen, f"drain: {e}")
                         self.registry.inc("sl3d_serve_view_failures_total",
                                           tenant=ctx.job.tenant)
                 return
-            except faults.InjectedCrash:
+            except (faults.InjectedCrash, election.FencedWrite):
                 raise
             except BaseException as e:
                 poisoned = e
@@ -801,20 +1248,26 @@ class ScanService:
                 self._assembly_q.extend(ready)
                 self._assembly_cv.notify_all()
 
-    def _assembler_loop(self) -> None:
+    def _assembler_loop(self, lead: threading.Event) -> None:
         """ONE assembly at a time: requests share the engine for warming
         but serialize through the proven single-process pipeline — device
         contention stays simple and the byte-parity argument stays
         exactly PR-8's."""
         while True:
             with self._assembly_cv:
-                while not self._assembly_q and not self._stop.is_set():
+                while (not self._assembly_q and not self._stop.is_set()
+                       and not lead.is_set()):
                     self._assembly_cv.wait(timeout=0.5)
+                if lead.is_set():
+                    return      # deposed: the new leader owns the queue
                 if self._stop.is_set() and not self._assembly_q:
                     return
                 sid = self._assembly_q.pop(0)
-            with self.adm.lock:
-                job = self.adm.jobs.get(sid)
+            adm = self.adm
+            if adm is None:     # deposed underneath us
+                return
+            with adm.lock:
+                job = adm.jobs.get(sid)
             if job is None or job.state != "warmed":
                 continue        # checkpointed/finished underneath us
             try:
@@ -824,6 +1277,14 @@ class ScanService:
                 # journaled, scan left "assembling" — restart re-queues
                 # it and re-assembles over the warm cache
                 self._crash(f"assembly {sid}", e)
+                return
+            except election.FencedWrite as e:
+                # the terminal journal line was rejected: deposed mid-
+                # assembly. The artifacts are fine (atomic writes, same
+                # bytes the new leader will produce over the same cache)
+                # but the CREDIT belongs to the new epoch — self-demote
+                self.log(f"[serve] assembly {sid}: write fenced ({e})")
+                self._request_demote(f"assembly {sid}: {e}")
                 return
 
     def _job_log(self, job):
@@ -839,9 +1300,10 @@ class ScanService:
         breach → aborted (PR-7 manifest); anything else → failed. The
         service outlives every one of these."""
         st = self._stages
+        adm = self.adm      # capture: demotion swaps self.adm to None
         with self._scan_lock:
             ctx = self._scans.get(job.scan_id)
-        with self.adm.lock:
+        with adm.lock:
             job.state = "assembling"
         # crash boundary: warmed + journaled, assembly never started —
         # restart finds every view cached and re-assembles for free
@@ -890,12 +1352,12 @@ class ScanService:
             with self._scan_lock:
                 self._scans.pop(job.scan_id, None)
         if state == "checkpointed":
-            self.adm.checkpoint(job.scan_id, reason=error)
+            adm.checkpoint(job.scan_id, reason=error)
             self.registry.inc("sl3d_serve_checkpointed_total",
                               tenant=job.tenant)
         else:
-            self.adm.finish(job.scan_id, state, error=error,
-                            report=report_d)
+            adm.finish(job.scan_id, state, error=error,
+                       report=report_d)
             self._finish_metrics(job, state,
                                  assembly_s=time.monotonic() - t0)
         self.log(f"[serve] {job.scan_id}: {state.upper()} "
@@ -914,11 +1376,17 @@ class ScanService:
     # ---- metrics surface -------------------------------------------------
 
     def metrics_text(self) -> str:
-        snap = self.adm.snapshot()
-        self.registry.set_gauge("sl3d_serve_scans_active", snap["active"])
-        self.registry.set_gauge("sl3d_serve_scans_queued", snap["queued"])
+        snap = self.snapshot()
+        self.registry.set_gauge("sl3d_serve_scans_active",
+                                snap.get("active", 0))
+        self.registry.set_gauge("sl3d_serve_scans_queued",
+                                snap.get("queued", 0))
         self.registry.set_gauge("sl3d_serve_ready",
                                 1.0 if self.phase == "ready" else 0.0)
+        self.registry.set_gauge(
+            "sl3d_serve_leader",
+            1.0 if self.role in ("solo", "leader") else 0.0)
+        self.registry.set_gauge("sl3d_serve_epoch", float(self.epoch))
         return tel.prometheus_text(self.registry.as_dict())
 
 
@@ -995,6 +1463,8 @@ class _Handler(BaseHTTPRequestHandler):
             phase = self.service.phase
             return self._json(200, {"ok": phase == "ready",
                                     "phase": phase,
+                                    "role": snap["role"],
+                                    "epoch": snap["epoch"],
                                     "run_id": snap["run_id"],
                                     "active": snap["active"],
                                     "queued": snap["queued"]})
@@ -1035,16 +1505,23 @@ def start_gateway(root: str, cfg: Config | None = None, log=print,
     httpd.service = svc                  # type: ignore[attr-defined]
     httpd.daemon_threads = True
     host, port = httpd.server_address[0], httpd.server_address[1]
+    # the bound address must be known BEFORE start(): an HA member that
+    # wins the election advertises it in the lease + serve.json
+    svc.advertise(host, port, argv=sys.argv)
     svc.start()
+    if not svc.ha:
+        # solo: publish the discovery handshake now (epoch 0). HA:
+        # serve.json is the LEADER's to write — _promote rewrites it
+        # atomically with the new epoch on every takeover
+        svc._publish_serve_json()
     info = {"host": host, "port": port, "pid": os.getpid(),
-            "run_id": svc.run_id, "root": svc.root,
+            "run_id": svc.run_id, "root": svc.root, "role": svc.role,
+            "epoch": svc.epoch,
             "argv": list(sys.argv)}   # loadgen --restart relaunch recipe
-    with open(os.path.join(svc.root, "serve.json"), "w") as f:
-        json.dump(info, f)
     if ready_file:
         with open(ready_file, "w") as f:
             json.dump(info, f)
-    log(f"[serve] listening on http://{host}:{port} "
+    log(f"[serve] listening on http://{host}:{port} role={svc.role} "
         f"(endpoints: /submit /status/<id> /result/<id> /metrics "
         f"/healthz)")
     return httpd, svc

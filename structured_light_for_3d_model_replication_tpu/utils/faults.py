@@ -41,6 +41,13 @@ supplies the two halves of making that chain resilient:
    ``http.submit``       gateway /submit handling before admission (the
                          client-visible 503 + Retry-After path;
                          pipeline/serving.py)
+   ``election.acquire``  HA leader-lease acquire attempt (item is the
+                         member's owner id; parallel/election.py)
+   ``election.renew``    HA leader-lease renew — a ``stall(T)`` here with
+                         T past the lease is how a ZOMBIE leader is
+                         manufactured: the lease expires mid-stall, a
+                         standby steals it, and the waker's next append
+                         is fenced (parallel/election.py)
    ====================  ====================================================
 
 2. **Retry/quarantine toolkit** — the exception classifier

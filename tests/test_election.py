@@ -1,0 +1,275 @@
+"""Unit tests for leader election + epoch fencing (parallel/election.py,
+ISSUE 14) on a fake clock, plus the ``replay_serving`` fold over
+epoch-interleaved ledger segments.
+
+Contract under test: epochs are monotonic and bump exactly on TAKEOVER
+(never on self-renewal); a live lease cannot be stolen, an expired one
+can; a deposed holder's renew fails and drops its epoch; ``fence``
+rejects a write the moment a newer epoch exists on disk (and the Ledger
+calls it before every append); replay ignores records a zombie raced in
+after a newer epoch appeared — including a torn line exactly at the
+epoch boundary.
+"""
+import json
+import os
+
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.parallel.admission import (
+    replay_serving,
+)
+from structured_light_for_3d_model_replication_tpu.parallel.coordinator import (
+    LEDGER_SCHEMA,
+    Ledger,
+)
+from structured_light_for_3d_model_replication_tpu.parallel.election import (
+    FencedWrite,
+    LeaderLease,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture()
+def clock():
+    return FakeClock()
+
+
+def _lease(tmp_path, owner, clock, lease_s=10.0):
+    return LeaderLease(str(tmp_path / "leader.json"), owner=owner,
+                       lease_s=lease_s, clock=clock)
+
+
+# ---------------------------------------------------------------------------
+# lease protocol
+# ---------------------------------------------------------------------------
+
+def test_first_acquire_bumps_to_epoch_one(tmp_path, clock):
+    a = _lease(tmp_path, "gwA", clock)
+    assert a.acquire()
+    assert a.epoch == 1
+    cur = a.current()
+    assert cur["owner"] == "gwA" and cur["epoch"] == 1
+    assert cur["expires_unix"] == pytest.approx(clock.t + 10.0)
+
+
+def test_live_lease_cannot_be_stolen(tmp_path, clock):
+    a = _lease(tmp_path, "gwA", clock)
+    b = _lease(tmp_path, "gwB", clock)
+    assert a.acquire()
+    clock.advance(5.0)          # still inside the lease
+    assert not b.acquire()
+    assert b.epoch == 0
+
+
+def test_renew_extends_without_epoch_bump(tmp_path, clock):
+    a = _lease(tmp_path, "gwA", clock)
+    b = _lease(tmp_path, "gwB", clock)
+    assert a.acquire()
+    for _ in range(5):
+        clock.advance(8.0)
+        assert a.renew()
+        assert a.epoch == 1     # self-renewal NEVER bumps
+        assert not b.acquire()  # renewed lease stays live
+
+
+def test_expired_lease_steal_bumps_epoch_and_deposes(tmp_path, clock):
+    a = _lease(tmp_path, "gwA", clock)
+    b = _lease(tmp_path, "gwB", clock)
+    assert a.acquire()
+    clock.advance(11.0)         # past lease_s: gwA went quiet
+    assert b.acquire()
+    assert b.epoch == 2         # takeover bumps
+    # the zombie wakes: renew observes the newer epoch and fails
+    assert not a.renew()
+    assert a.epoch == 0
+
+
+def test_epochs_monotonic_across_steal_cycles(tmp_path, clock):
+    a = _lease(tmp_path, "gwA", clock)
+    b = _lease(tmp_path, "gwB", clock)
+    seen = []
+    for _ in range(3):
+        clock.advance(11.0)
+        assert a.acquire()
+        seen.append(a.epoch)
+        clock.advance(11.0)
+        assert b.acquire()
+        seen.append(b.epoch)
+    assert seen == sorted(seen) and len(set(seen)) == len(seen)
+
+
+def test_reacquire_own_lease_keeps_epoch(tmp_path, clock):
+    a = _lease(tmp_path, "gwA", clock)
+    assert a.acquire()
+    assert a.acquire()          # idempotent self-acquire
+    assert a.epoch == 1
+
+
+def test_release_lets_standby_take_over_immediately(tmp_path, clock):
+    a = _lease(tmp_path, "gwA", clock)
+    b = _lease(tmp_path, "gwB", clock)
+    assert a.acquire()
+    a.release()                 # graceful step-down: expire NOW
+    assert a.epoch == 0
+    assert b.acquire()          # no waiting out the lease
+    assert b.epoch == 2
+
+
+def test_torn_lease_file_treated_as_free(tmp_path, clock):
+    path = tmp_path / "leader.json"
+    path.write_text('{"schema": "sl3d-leader-v1", "epo')
+    a = _lease(tmp_path, "gwA", clock)
+    assert a.acquire()
+    assert a.epoch == 1
+
+
+# ---------------------------------------------------------------------------
+# fencing
+# ---------------------------------------------------------------------------
+
+def test_fence_passes_while_leading_and_rejects_after_steal(tmp_path,
+                                                            clock):
+    a = _lease(tmp_path, "gwA", clock)
+    b = _lease(tmp_path, "gwB", clock)
+    assert a.acquire()
+    a.fence()                   # our own epoch: no raise
+    clock.advance(11.0)
+    assert b.acquire()
+    with pytest.raises(FencedWrite):
+        a.fence()
+    b.fence()                   # the new leader writes freely
+
+
+def test_fence_with_no_lease_file_is_noop(tmp_path, clock):
+    a = _lease(tmp_path, "gwA", clock)
+    a.fence()                   # nothing on disk -> nothing newer
+
+
+def test_ledger_appends_stamped_and_fenced(tmp_path, clock):
+    """The integration the serving layer relies on: a Ledger wired to a
+    lease stamps every line with the writer's epoch and REJECTS the
+    append of a deposed writer before any byte hits the file."""
+    a = _lease(tmp_path, "gwA", clock)
+    b = _lease(tmp_path, "gwB", clock)
+    path = str(tmp_path / "ledger.jsonl")
+    assert a.acquire()
+    led_a = Ledger(path, "runA", meta={"mode": "serving"},
+                   epoch=lambda: a.epoch, fence=a.fence)
+    led_a.event("submit", scan="s1", tenant="t")
+    clock.advance(11.0)
+    assert b.acquire()          # gwA deposed mid-flight
+    with pytest.raises(FencedWrite):
+        led_a.event("finish", scan="s1", state="done")
+    led_a.close()
+    lines = [json.loads(x) for x in
+             open(path, encoding="utf-8").read().splitlines()]
+    # the fenced line never landed; every landed line carries epoch 1
+    assert [x["type"] for x in lines] == ["meta", "submit"]
+    assert all(x["epoch"] == 1 for x in lines)
+
+
+# ---------------------------------------------------------------------------
+# replay over epoch-interleaved segments (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+def _line(**kw) -> str:
+    return json.dumps(kw, sort_keys=True) + "\n"
+
+
+def _meta(epoch: int) -> str:
+    return _line(type="meta", schema=LEDGER_SCHEMA, run_id=f"r{epoch}",
+                 t0_unix=0.0, mode="serving", epoch=epoch)
+
+
+def test_replay_ignores_stale_epoch_records(tmp_path):
+    """The zombie interleave: epoch-1 lines landing AFTER epoch 2 began
+    (the append that raced past the live fence) must not resurrect state
+    or credit items the new epoch owns."""
+    path = str(tmp_path / "ledger.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(_meta(1))
+        f.write(_line(type="submit", scan="s1", tenant="t", epoch=1,
+                      target="/in", calib="/c", out_dir="/o", t=1.0))
+        f.write(_line(type="admit", scan="s1", tenant="t", epoch=1))
+        f.write(_line(type="complete", item="s1/view:0", epoch=1))
+        f.write(_meta(2))       # takeover
+        f.write(_line(type="resume", scan="s1", tenant="t", epoch=2))
+        # zombie epoch-1 appends AFTER the takeover:
+        f.write(_line(type="complete", item="s1/view:1", epoch=1))
+        f.write(_line(type="finish", scan="s1", tenant="t", state="done",
+                      epoch=1))
+    rs = replay_serving(path)
+    assert rs["max_epoch"] == 2
+    assert rs["stale_ignored"] == 2
+    # epoch-1 credit from BEFORE the takeover survives; the raced-in
+    # credit and the stale finish do not
+    assert rs["completed"] == {"s1/view:0"}
+    assert rs["scans"]["s1"]["state"] == "queued"   # resume, not done
+    assert rs["segments"] == 2
+
+
+def test_replay_torn_tail_at_epoch_boundary(tmp_path):
+    """kill -9 exactly while the NEW epoch's meta head was being written:
+    the torn meta line is skipped, and the first complete epoch-2 event
+    still advances the fold's epoch watermark."""
+    path = str(tmp_path / "ledger.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(_meta(1))
+        f.write(_line(type="submit", scan="s1", tenant="t", epoch=1,
+                      target="/in", calib="/c", out_dir="/o", t=1.0))
+        f.write(_meta(2)[:17])  # torn mid-meta at the boundary
+    rs = replay_serving(path)
+    assert rs["scans"]["s1"]["state"] == "queued"
+    assert rs["max_epoch"] == 1 and rs["segments"] == 1
+    # the next incarnation appends a fresh segment after the torn line
+    with open(path, "a", encoding="utf-8") as f:
+        f.write("\n")
+        f.write(_meta(3))
+        f.write(_line(type="finish", scan="s1", tenant="t", state="done",
+                      epoch=3, elapsed_s=1.0))
+        f.write(_line(type="complete", item="s1/view:0", epoch=1))  # stale
+    rs = replay_serving(path)
+    assert rs["scans"]["s1"]["state"] == "done"
+    assert rs["max_epoch"] == 3
+    assert rs["stale_ignored"] == 1
+    assert rs["completed"] == set()
+
+
+def test_replay_unstamped_ledger_never_fenced(tmp_path):
+    """Pre-HA / solo ledgers carry no epoch field anywhere: the fold
+    must treat them exactly as before (max_epoch 0, nothing ignored)."""
+    path = str(tmp_path / "ledger.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(_line(type="meta", schema=LEDGER_SCHEMA, run_id="r",
+                      t0_unix=0.0, mode="serving"))
+        f.write(_line(type="submit", scan="s1", tenant="t",
+                      target="/in", calib="/c", out_dir="/o", t=1.0))
+        f.write(_line(type="complete", item="s1/view:0"))
+    rs = replay_serving(path)
+    assert rs["max_epoch"] == 0 and rs["stale_ignored"] == 0
+    assert rs["completed"] == {"s1/view:0"}
+    assert rs["scans"]["s1"]["state"] == "queued"
+
+
+def test_election_fault_sites_fire(tmp_path, clock):
+    from structured_light_for_3d_model_replication_tpu.utils import faults
+    faults.configure("election.acquire:transient")
+    try:
+        a = _lease(tmp_path, "gwA", clock)
+        with pytest.raises(faults.TransientFault):
+            a.acquire()
+        assert a.epoch == 0     # nothing written under the fault
+        assert a.current() is None
+        assert a.acquire()      # x1 spent: next attempt wins
+    finally:
+        faults.reset()

@@ -1,0 +1,363 @@
+"""ISSUE-14 gateway-HA contract, driven in-process: follower redirect +
+follower reads over the shared ledger, zombie-leader fencing (a deposed
+writer's submit is rejected before any byte lands and the member
+self-demotes), crash failover with zero recompute / byte parity / auto
+scan-id continuation across two epochs, and the single-writer solo
+guard.
+
+The heavyweight version — two REAL ``sl3d serve`` processes and a
+kill -9 of the leader — lives in ``tools/ha_smoke.py`` (the HA_SMOKE CI
+arm); here a "gateway" is a ScanService over the same root and a
+"crash" is ``phase=crashed`` without a journaled finish.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io import images as imio
+from structured_light_for_3d_model_replication_tpu.io import matfile
+from structured_light_for_3d_model_replication_tpu.parallel.admission import (
+    replay_serving,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline import serving
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import faults
+from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+
+CAM, PROJ = (160, 120), (128, 64)
+STEPS = ("statistical",)
+TERMINAL = ("done", "degraded", "failed", "aborted", "shed")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.reset()
+
+
+def _render_scan(tgt: str, views: int = 2) -> None:
+    rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+    scene = syn.sphere_on_background()
+    obj, background = scene.objects
+    satellite = syn.Sphere(np.array([48.0, -92.0, 430.0]), 16.0)
+    step = 360.0 / views
+    pivot = np.array([0.0, 0.0, 420.0])
+    for i, (R, t) in enumerate(syn.turntable_poses(views, step, pivot)):
+        frames, _ = syn.render_scene(
+            rig, syn.Scene([obj.transformed(R, t),
+                            satellite.transformed(R, t), background]))
+        imio.save_stack(
+            os.path.join(tgt, f"scan_{int(round(i * step)):03d}deg_scan"),
+            frames)
+
+
+@pytest.fixture(scope="module")
+def calib(tmp_path_factory):
+    root = tmp_path_factory.mktemp("calib")
+    path = str(root / "calib.mat")
+    rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+    matfile.save_calibration(path, rig.calibration())
+    return path
+
+
+def _cfg(lease_s=None, renew_s=None, poll_s=None) -> Config:
+    cfg = Config()
+    cfg.parallel.backend = "numpy"
+    cfg.decode.n_cols, cfg.decode.n_rows = PROJ
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 512
+    cfg.merge.icp_iters = 10
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    cfg.serving.clean_steps = "statistical"
+    cfg.serving.port = 0
+    if lease_s is not None:
+        cfg.serving.ha_enabled = True
+        cfg.serving.ha_lease_s = lease_s
+        if renew_s is not None:
+            cfg.serving.ha_renew_s = renew_s
+        if poll_s is not None:
+            cfg.serving.ha_poll_s = poll_s
+    return cfg
+
+
+def _wait_role(svc, role, timeout=30.0):
+    t0 = time.monotonic()
+    while svc.role != role:
+        assert time.monotonic() - t0 < timeout, \
+            f"still {svc.role!r}, wanted {role!r}"
+        time.sleep(0.05)
+
+
+def _wait_state(svc, sid, timeout=240.0):
+    t0 = time.monotonic()
+    d = None
+    while time.monotonic() - t0 < timeout:
+        d = svc.status(sid)
+        if d is not None and d["state"] in TERMINAL:
+            return d
+        time.sleep(0.1)
+    raise TimeoutError(f"{sid} still {d and d['state']} after {timeout}s")
+
+
+def _read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+# ---------------------------------------------------------------------------
+# follower: redirect envelope + reads over the shared ledger
+# ---------------------------------------------------------------------------
+
+def test_follower_redirects_submit_and_serves_reads(tmp_path, calib):
+    tgt = str(tmp_path / "in")
+    os.makedirs(tgt)
+    _render_scan(tgt)
+    root = str(tmp_path / "svc")
+    leader = serving.ScanService(root, cfg=_cfg(lease_s=2.0, poll_s=0.1),
+                                 log=lambda m: None)
+    leader.advertise("127.0.0.1", 9101)
+    leader.start()
+    _wait_role(leader, "leader")
+    follower = serving.ScanService(root,
+                                   cfg=_cfg(lease_s=2.0, poll_s=0.1),
+                                   log=lambda m: None)
+    follower.advertise("127.0.0.1", 9102)
+    follower.start()
+    try:
+        # the discovery handshake: serve.json is the leader's, epoch 1
+        with open(os.path.join(root, "serve.json")) as f:
+            sj = json.load(f)
+        assert sj["role"] == "leader" and sj["epoch"] == 1
+        assert sj["run_id"] == leader.run_id and sj["port"] == 9101
+
+        # follower /submit: machine-readable redirect, nothing admitted
+        time.sleep(0.3)                 # a poll tick: still follower
+        assert follower.role == "follower"
+        ok, body = follower.submit({"tenant": "ta", "target": tgt,
+                                    "calib": calib})
+        assert not ok
+        assert body["reason"] == "not-leader"
+        assert body["role"] == "follower" and body["epoch"] == 1
+        assert body["leader"]["url"] == "http://127.0.0.1:9101"
+        assert body["retry_after_s"] > 0
+
+        # the scan itself goes to the leader ...
+        ok, body = leader.submit({"tenant": "ta", "target": tgt,
+                                  "calib": calib})
+        assert ok, body
+        sid = body["scan_id"]
+        d = _wait_state(leader, sid)
+        assert d["state"] == "done", d
+
+        # ... and the FOLLOWER answers /status and /result for it from
+        # the shared ledger, without ever owning the engine
+        t0 = time.monotonic()
+        while True:
+            fd = follower.status(sid)
+            if fd is not None and fd["state"] == "done":
+                break
+            assert time.monotonic() - t0 < 30.0, fd
+            time.sleep(0.1)
+        assert fd["via"] == "follower-replay"
+        assert fd["report"]["merged_points"] > 0
+        fpath, err = follower.result_path(sid, "ply")
+        assert fpath, err
+        lpath, _ = leader.result_path(sid, "ply")
+        assert _read(fpath) == _read(lpath)
+        snap = follower.snapshot()
+        assert snap["role"] == "follower" and snap["epoch"] == 0
+        assert follower.metrics_text().count("sl3d_serve_leader 0.0")
+    finally:
+        follower.close()
+        leader.close()
+
+
+# ---------------------------------------------------------------------------
+# zombie leader: fenced submit, self-demotion
+# ---------------------------------------------------------------------------
+
+def test_zombie_leader_submit_is_fenced_and_demotes(tmp_path, calib):
+    """A leader that stops renewing (here: an absurd renew interval —
+    the stalled-renew zombie without the sleep) keeps believing it
+    leads; a standby steals the expired lease. The zombie's next journal
+    append hits the fence BEFORE any byte lands, the client gets the
+    not-leader redirect, and the member demotes itself."""
+    root = str(tmp_path / "svc")
+    tgt = str(tmp_path / "in")
+    os.makedirs(tgt)                    # a valid-looking, empty target
+    zombie = serving.ScanService(
+        root, cfg=_cfg(lease_s=0.5, renew_s=60.0, poll_s=0.1),
+        log=lambda m: None)
+    zombie.start()
+    _wait_role(zombie, "leader")
+    assert zombie.epoch == 1
+    standby = serving.ScanService(root,
+                                  cfg=_cfg(lease_s=0.5, poll_s=0.1),
+                                  log=lambda m: None)
+    standby.advertise("127.0.0.1", 9103)
+    standby.start()
+    try:
+        _wait_role(standby, "leader", timeout=15.0)   # stole at expiry
+        assert standby.epoch == 2
+        assert zombie.role == "leader"  # still believes (renew pending)
+        ok, body = zombie.submit({"tenant": "ta", "target": tgt,
+                                  "calib": calib})
+        assert not ok
+        assert body["reason"] == "not-leader"
+        assert body["epoch"] == 2       # read fresh off the lease file
+        _wait_role(zombie, "follower", timeout=15.0)
+        assert zombie.epoch == 0 and zombie.adm is None
+        assert standby.role == "leader"
+        # the fence held: the zombie's submit left NO line in the ledger
+        rs = replay_serving(os.path.join(root, "ledger.jsonl"))
+        assert rs["scans"] == {}
+        assert rs["max_epoch"] == 2 and rs["segments"] == 2
+    finally:
+        standby.close()
+        zombie.close()
+
+
+# ---------------------------------------------------------------------------
+# crash failover: zero recompute, byte parity, auto-id continuation
+# ---------------------------------------------------------------------------
+
+def test_crash_failover_zero_recompute_parity_and_id_continuation(
+        tmp_path, calib):
+    """The tentpole acceptance, in-process: the leader dies mid-assembly
+    (serve.crash, lease never released — handover is by expiry, exactly
+    like kill -9); the standby steals within the lease bound, replays
+    the shared ledger, finishes the scan as pure cache hits with PLY
+    byte parity vs an uninterrupted solo run, and continues the auto
+    scan-id sequence the dead epoch started."""
+    tgt = str(tmp_path / "in")
+    os.makedirs(tgt)
+    _render_scan(tgt)
+    solo = str(tmp_path / "solo")
+    rep = stages.run_pipeline(calib, tgt, solo, cfg=_cfg(), steps=STEPS,
+                              log=lambda m: None)
+    assert rep.failed == []
+
+    root = str(tmp_path / "svc")
+    cfg = _cfg(lease_s=1.0, poll_s=0.2)
+    cfg.faults.spec = "serve.crash~assembly:crash"
+    faults.configure_from(cfg.faults)
+    a = serving.ScanService(root, cfg=cfg, log=lambda m: None)
+    a.start()
+    _wait_role(a, "leader")
+    ok, body = a.submit({"tenant": "ta", "target": tgt, "calib": calib})
+    assert ok, body
+    sid = body["scan_id"]
+    assert sid == "ta-s0001"            # epoch 1 minted the first auto id
+    t0 = time.monotonic()
+    while a.phase != "crashed":
+        assert time.monotonic() - t0 < 180.0, a.status(sid)
+        time.sleep(0.05)
+    faults.reset()
+    # died leading: the lease is NOT released; expiry is the handover
+    assert a.election.current()["owner"] == a.run_id
+
+    b = serving.ScanService(root, cfg=_cfg(lease_s=1.0, poll_s=0.2),
+                            log=lambda m: None)
+    b.advertise("127.0.0.1", 9104)
+    b.start()
+    try:
+        t0 = time.monotonic()
+        _wait_role(b, "leader", timeout=30.0)
+        takeover_s = time.monotonic() - t0
+        assert b.epoch == 2
+        # serve.json atomically re-published with the new epoch
+        with open(os.path.join(root, "serve.json")) as f:
+            sj = json.load(f)
+        assert sj["epoch"] == 2 and sj["run_id"] == b.run_id
+        d = _wait_state(b, sid)
+        assert d["state"] == "done", d
+        # zero recompute: every epoch-1-credited view was a cache hit
+        assert d["report"]["views_computed"] == 0, d["report"]
+        assert d["report"]["views_cached"] == 2, d["report"]
+        for art, name in (("ply", "merged.ply"), ("stl", "model.stl")):
+            path, err = b.result_path(sid, art)
+            assert path, err
+            assert _read(path) == _read(os.path.join(solo, name)), \
+                f"{name} differs from solo run after failover"
+        # auto scan-id continuation across epochs: the resumed _seq
+        # means the new leader mints s0002, not a colliding s0001
+        ok, body = b.submit({"tenant": "ta", "target": tgt,
+                             "calib": calib})
+        assert ok, body
+        assert body["scan_id"] == "ta-s0002"
+        assert "duplicate" not in body
+        d2 = _wait_state(b, "ta-s0002")
+        assert d2["state"] == "done", d2
+        assert takeover_s < 30.0
+    finally:
+        b.close()
+        a.close()
+        assert a.phase == "crashed"     # close() never launders a crash
+
+
+# ---------------------------------------------------------------------------
+# single-writer solo guard
+# ---------------------------------------------------------------------------
+
+_HOLDER_SRC = r"""
+import fcntl, json, os, sys, time
+path = sys.argv[1]
+f = open(path, "a+")
+fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+f.seek(0); f.truncate()
+json.dump({"pid": os.getpid(), "run_id": "foreign", "ha": False,
+           "epoch": 0}, f)
+f.flush()
+print("held", flush=True)
+time.sleep(120)
+"""
+
+
+def test_solo_guard_rejects_second_writer(tmp_path):
+    """satellite: a root actively served by a solo gateway in ANOTHER
+    process refuses both a second solo gateway and an HA member, naming
+    the holder. (flock is per open-file-description, so the foreign
+    holder must really be another process.)"""
+    root = str(tmp_path / "svc")
+    os.makedirs(root)
+    p = subprocess.Popen(
+        [sys.executable, "-c", _HOLDER_SRC,
+         os.path.join(root, "serve.lock")],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert p.stdout.readline().strip() == "held"
+        with pytest.raises(RuntimeError, match="already served by pid"):
+            serving.ScanService(root, cfg=_cfg(), log=lambda m: None)
+        with pytest.raises(RuntimeError, match="solo gateway"):
+            serving.ScanService(root, cfg=_cfg(lease_s=2.0),
+                                log=lambda m: None)
+    finally:
+        p.kill()
+        p.wait()
+    # the kernel released the dead holder's flock: the root serves again
+    svc = serving.ScanService(root, cfg=_cfg(), log=lambda m: None)
+    svc.close()
+
+
+def test_solo_refuses_root_with_live_ha_leader(tmp_path):
+    root = str(tmp_path / "svc")
+    os.makedirs(root)
+    with open(os.path.join(root, "leader.json"), "w") as f:
+        json.dump({"schema": "sl3d-leader-v1", "owner": "gwX", "epoch": 3,
+                   "expires_unix": time.time() + 60.0, "pid": 12345}, f)
+    with pytest.raises(RuntimeError, match="HA leader"):
+        serving.ScanService(root, cfg=_cfg(), log=lambda m: None)
+    # an expired lease is a dead group: solo may take the root over
+    with open(os.path.join(root, "leader.json"), "w") as f:
+        json.dump({"schema": "sl3d-leader-v1", "owner": "gwX", "epoch": 3,
+                   "expires_unix": time.time() - 60.0, "pid": 12345}, f)
+    svc = serving.ScanService(root, cfg=_cfg(), log=lambda m: None)
+    svc.close()

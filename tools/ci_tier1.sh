@@ -189,4 +189,22 @@ else
   echo "SERVE_CHAOS_SMOKE=FAIL (rc=$schaos_rc; see tools/_ci/serve_chaos_smoke.log)"
   [ $rc -eq 0 ] && rc=1
 fi
+
+# ---- HA smoke: TWO real `sl3d serve` gateways over one shared root;
+# the leader dies 137 mid-assembly (lease never released) — the standby
+# must steal the expired lease within the lease bound (measured
+# failover_s), rewrite serve.json with the bumped epoch, finish the
+# orphaned request with zero recompute and byte parity vs a solo run,
+# keep the client's scan_id idempotent across the takeover, and drain
+# to exit 0 on SIGTERM; the follower must answer /submit with the
+# machine-readable not-leader redirect while the leader lives (ISSUE 14) ----
+ha_rc=0
+ha=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/ha_smoke.py 2>&1) || ha_rc=$?
+echo "$ha" > tools/_ci/ha_smoke.log
+if [ $ha_rc -eq 0 ] && echo "$ha" | grep -q 'HA_SMOKE=ok'; then
+  echo "$ha" | grep 'HA_SMOKE=ok'
+else
+  echo "HA_SMOKE=FAIL (rc=$ha_rc; see tools/_ci/ha_smoke.log)"
+  [ $rc -eq 0 ] && rc=1
+fi
 exit $rc

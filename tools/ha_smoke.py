@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""CI smoke for gateway HA (ISSUE 14): TWO real ``sl3d serve`` processes
+over one shared root, the leader felled by an injected ``serve.crash``
+at the assembly boundary (exit 137, lease never released — a kill -9
+twin), the standby left to steal the expired lease and finish the work.
+
+Asserts, end to end over HTTP against the real CLI entry:
+  * exactly one member leads (healthz ``role``/``epoch``); the follower
+    answers /submit with the machine-readable ``not-leader`` redirect
+    pointing at the live leader, and serve.json carries the leader's
+    address + epoch;
+  * after the leader dies 137 mid-assembly the standby PROMOTES within
+    the lease bound (measured ``failover_s``: leader death -> standby
+    reports role=leader with the bumped epoch) and atomically rewrites
+    serve.json so stale clients re-discover;
+  * the orphaned request finishes DONE on the new leader with ZERO
+    recompute (``views_computed == 0`` — every epoch-1-credited view is
+    a cache hit) and /result PLY + STL byte-identical to a solo
+    ``run_pipeline``: the PR-8 parity construction carried across the
+    takeover;
+  * the client's durable scan_id is idempotent across the failover —
+    the same re-POST lands on the existing (done) request;
+  * SIGTERM on the survivor drains and exits 0.
+
+Prints ``HA_SMOKE=ok`` and exits 0 on success.
+"""
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from structured_light_for_3d_model_replication_tpu.io import matfile
+from structured_light_for_3d_model_replication_tpu.parallel.admission import (
+    TERMINAL,
+    replay_serving,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+from serve_smoke import STEPS, make_cfg, post_json, get, render_scan
+
+CAM, PROJ = (160, 120), (128, 64)
+LEASE_S = 3.0
+
+_SETS = [
+    "parallel.backend=numpy",
+    f"decode.n_cols={PROJ[0]}", f"decode.n_rows={PROJ[1]}",
+    "decode.thresh_mode=manual",
+    "merge.voxel_size=4.0", "merge.ransac_trials=512",
+    "merge.icp_iters=10",
+    "mesh.depth=5", "mesh.density_trim_quantile=0.0",
+    "serving.clean_steps=statistical",
+    "serving.host=127.0.0.1", "serving.port=0",
+    "serving.ha_enabled=true",
+    f"serving.ha_lease_s={LEASE_S}",
+    "serving.ha_poll_s=0.3",
+]
+
+
+def launch(root: str, ready: str, log_path: str,
+           extra_sets=()) -> subprocess.Popen:
+    cmd = [sys.executable, "-m",
+           "structured_light_for_3d_model_replication_tpu.cli", "serve",
+           root, "--ready-file", ready]
+    for s in list(_SETS) + list(extra_sets):
+        cmd += ["--set", s]
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   x for x in (repo, os.environ.get("PYTHONPATH")) if x))
+    logf = open(log_path, "a")
+    return subprocess.Popen(cmd, stdout=logf, stderr=subprocess.STDOUT,
+                            env=env)
+
+
+def wait_ready(ready: str, proc: subprocess.Popen,
+               timeout_s: float = 120.0) -> str:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"serve exited {proc.returncode} before ready")
+        if os.path.exists(ready):
+            try:
+                with open(ready) as f:
+                    info = json.load(f)
+                base = f"http://{info['host']}:{info['port']}"
+                with urllib.request.urlopen(base + "/healthz",
+                                            timeout=5) as r:
+                    if json.loads(r.read()).get("ok"):
+                        return base
+            except (ValueError, OSError, urllib.error.URLError):
+                pass
+        time.sleep(0.1)
+    raise TimeoutError(f"serve not ready after {timeout_s}s")
+
+
+def healthz(base: str) -> dict:
+    return json.loads(get(f"{base}/healthz"))
+
+
+def wait_role(base: str, role: str, timeout_s: float = 60.0) -> dict:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        try:
+            h = healthz(base)
+            if h["role"] == role:
+                return h
+        except (OSError, urllib.error.URLError):
+            pass
+        time.sleep(0.1)
+    raise TimeoutError(f"{base} never became {role!r}")
+
+
+def post_raw(url: str, payload: dict) -> tuple[int, dict]:
+    """POST that hands back non-2xx bodies instead of raising — the
+    follower redirect is a 503 we WANT to inspect."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="sl3d_ha_smoke_")
+    try:
+        rig = syn.default_rig(cam_size=CAM, proj_size=PROJ)
+        calib = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(calib, rig.calibration())
+        tgt = os.path.join(tmp, "in_tha")
+        os.makedirs(tgt)
+        render_scan(tgt, views=2, shift=0.0)
+
+        solo = os.path.join(tmp, "solo")
+        rep = stages.run_pipeline(calib, tgt, solo, cfg=make_cfg(),
+                                  steps=STEPS, log=lambda m: None)
+        assert rep.failed == [], rep.failed
+        print(f"[ha] solo reference done ({rep.merged_points:,} points)")
+
+        root = os.path.join(tmp, "svc")
+        log_path = os.path.join(tmp, "serve.log")
+        payload = {"tenant": "tha", "target": tgt, "calib": calib,
+                   "scan_id": "h1"}
+
+        # ---- gen 1: leader, armed to crash at the assembly boundary --
+        ready1 = os.path.join(tmp, "ready1.json")
+        p1 = launch(root, ready1, log_path,
+                    extra_sets=["faults.spec=serve.crash~assembly"
+                                ":crash"])
+        base1 = wait_ready(ready1, p1)
+        h1 = wait_role(base1, "leader")
+        assert h1["epoch"] == 1, h1
+        print(f"[ha] gen-1 LEADER at {base1} (pid {p1.pid}, epoch 1, "
+              f"crash armed)")
+
+        # ---- gen 2: joins as follower --------------------------------
+        ready2 = os.path.join(tmp, "ready2.json")
+        p2 = launch(root, ready2, log_path)
+        base2 = wait_ready(ready2, p2)
+        h2 = healthz(base2)
+        assert h2["role"] == "follower" and h2["epoch"] == 0, h2
+        print(f"[ha] gen-2 follower at {base2} (pid {p2.pid})")
+
+        # serve.json is the leader's discovery record
+        with open(os.path.join(root, "serve.json")) as f:
+            sj = json.load(f)
+        assert sj["epoch"] == 1 and sj["pid"] == p1.pid, sj
+
+        # follower /submit: machine-readable redirect at the live leader
+        code, body = post_raw(f"{base2}/submit", payload)
+        assert code == 503, (code, body)
+        assert body["reason"] == "not-leader", body
+        assert body["leader"]["url"] == base1, body
+        assert body["retry_after_s"] > 0, body
+        print(f"[ha] follower redirected /submit to {body['leader']['url']}"
+              f" (503 not-leader, epoch {body['epoch']})")
+
+        # ---- submit to the leader; it dies mid-assembly --------------
+        body = post_json(f"{base1}/submit", payload)
+        sid = body["scan_id"]
+        print(f"[ha] leader accepted {sid}; waiting for the crash")
+        rc = p1.wait(timeout=300)
+        t_death = time.monotonic()
+        assert rc == 137, f"expected exit 137 (injected crash), got {rc}"
+        rs = replay_serving(os.path.join(root, "ledger.jsonl"))
+        assert rs["scans"][sid]["state"] not in TERMINAL, rs["scans"][sid]
+        print(f"[ha] leader died 137 mid-flight; {sid} is "
+              f"{rs['scans'][sid]['state']!r}, "
+              f"{len(rs['completed'])} view(s) credited under epoch 1")
+
+        # ---- failover: the standby steals the expired lease ----------
+        h2 = wait_role(base2, "leader",
+                       timeout_s=LEASE_S + 30.0)
+        failover_s = time.monotonic() - t_death
+        assert h2["epoch"] == 2, h2
+        # lease bound: expiry (<= LEASE_S after the leader's last renew,
+        # which was at most a renew tick before death) + poll + resume
+        assert failover_s <= LEASE_S + 10.0, failover_s
+        with open(os.path.join(root, "serve.json")) as f:
+            sj = json.load(f)
+        assert sj["epoch"] == 2 and sj["pid"] == p2.pid, sj
+        print(f"[ha] standby promoted in failover_s={failover_s:.2f} "
+              f"(lease {LEASE_S}s, epoch 2, serve.json rewritten)")
+
+        try:
+            t0 = time.monotonic()
+            while True:
+                d = json.loads(get(f"{base2}/status/{sid}"))
+                if d["state"] in TERMINAL:
+                    break
+                assert time.monotonic() - t0 < 300.0, d
+                time.sleep(0.25)
+            assert d["state"] == "done", d
+            report = d.get("report") or {}
+            assert report.get("views_computed") == 0, report
+            print(f"[ha] new leader finished {sid} with zero recompute "
+                  f"({report.get('views_cached')} cached view(s))")
+
+            ply = get(f"{base2}/result/{sid}?artifact=ply")
+            stl = get(f"{base2}/result/{sid}?artifact=stl")
+            with open(os.path.join(solo, "merged.ply"), "rb") as f:
+                assert f.read() == ply, "PLY diverged across failover"
+            with open(os.path.join(solo, "model.stl"), "rb") as f:
+                assert f.read() == stl, "STL diverged across failover"
+            print("[ha] byte parity with solo run holds across the "
+                  "takeover")
+
+            body = post_json(f"{base2}/submit", payload)
+            assert body.get("duplicate") is True, body
+            assert body["scan_id"] == sid and body["state"] == "done"
+            print("[ha] re-POST of the original submit is idempotent "
+                  "across the failover")
+        finally:
+            if p2.poll() is None:
+                p2.send_signal(signal.SIGTERM)
+        rc = p2.wait(timeout=120)
+        assert rc == 0, f"SIGTERM drain should exit 0, got {rc}"
+        with open(log_path) as f:
+            assert "stopped cleanly" in f.read()
+        print("[ha] SIGTERM drained the survivor, exit 0")
+        print(f"HA_SMOKE=ok failover_s={failover_s:.2f}")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

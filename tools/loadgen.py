@@ -43,6 +43,26 @@ sha256-hashed so the summary reports ``recovery_s`` (SIGKILL →
 /healthz ok) and ``parity_ok`` (same input ⇒ same bytes, served before
 or after the kill). Rejections always carry the gateway's
 machine-readable ``reason`` (quota-reject vs overload-shed vs breaker).
+
+HA mode (the ISSUE-14 measurement arm)::
+
+    python tools/loadgen.py --root <root> --manifest m.json --scans 2 \
+        --gateways 2 --serve-cmd "python -m <pkg>.cli serve <root> \
+            --set serving.ha_enabled=true ..." --kill-leader-after 5
+
+Launches N gateway processes over ONE shared root (each runs the
+``--serve-cmd`` template verbatim; they elect a leader among
+themselves), SIGKILLs the LEADER (pid from the epoch-stamped
+``serve.json``) mid-load, and keeps driving WITHOUT a restart — the
+surviving members hold the election. Drivers speak the HA protocol:
+a 503 ``not-leader`` reply switches them to the leader address in the
+redirect body, and any network error re-reads ``serve.json`` (the new
+leader rewrites it atomically on takeover) and adopts whatever it says.
+The summary reports ``failover_s`` — leader death → first accepted
+submit (202) on the new leader — plus the healthz-level takeover time
+and the same ``parity_ok`` sha256 check as the restart arm. Survivors
+are SIGTERMed (drain) when the load completes; their exit codes land in
+the summary.
 """
 from __future__ import annotations
 
@@ -68,11 +88,34 @@ _NETERR = (urllib.error.URLError, ConnectionError, OSError)
 class Gateway:
     """Mutable gateway address: the kill→restart thread swaps ``base``
     under the drivers when the relaunched service comes up on a new
-    ephemeral port."""
+    ephemeral port, and HA drivers swap it themselves when a redirect
+    or a dead socket tells them the leadership moved."""
 
     def __init__(self, base: str, root: str | None = None):
         self.base = base
         self.root = root
+        self._lock = threading.Lock()
+
+    def adopt(self, base: str) -> None:
+        with self._lock:
+            self.base = base
+
+    def refresh(self) -> bool:
+        """Re-read ``serve.json`` and adopt whatever address it carries
+        (the leader rewrites it atomically on every takeover). Returns
+        True when the address changed."""
+        if not self.root:
+            return False
+        try:
+            with open(os.path.join(self.root, "serve.json")) as f:
+                info = json.load(f)
+            base = f"http://{info['host']}:{info['port']}"
+        except (OSError, json.JSONDecodeError, KeyError):
+            return False
+        with self._lock:
+            changed = base != self.base
+            self.base = base
+        return changed
 
 
 def _get(url: str, timeout: float = 10.0):
@@ -159,7 +202,19 @@ class TenantDriver(threading.Thread):
             try:
                 code, body = _post_json(self.gw.base + "/submit", payload)
             except _NETERR:
+                self.gw.refresh()     # leadership may have moved
                 time.sleep(self.poll_s)
+                continue
+            if code == 503 and body.get("reason") == "not-leader":
+                # HA follower redirect: adopt the leader address from
+                # the body, else whatever serve.json now says
+                leader = (body.get("leader") or {}).get("url")
+                if leader:
+                    self.gw.adopt(leader)
+                else:
+                    self.gw.refresh()
+                time.sleep(min(float(body.get("retry_after_s", 0.2)),
+                               1.0))
                 continue
             if code == 503 and body.get("reason") in ("draining",
                                                       "transient"):
@@ -188,16 +243,19 @@ class TenantDriver(threading.Thread):
                     "target": spec["target"],
                     "latency_s": time.monotonic() - t0}
         sid = body["scan_id"]
+        accepted_mono = time.monotonic()
         while time.monotonic() - t0 < self.request_timeout_s:
             try:
                 _, raw = _get(self.gw.base + f"/status/{sid}")
                 d = json.loads(raw)
             except _NETERR:
-                time.sleep(self.poll_s)   # gateway down; resume pending
+                self.gw.refresh()     # gateway down; leadership may move
+                time.sleep(self.poll_s)
                 continue
             if d["state"] in _TERMINAL:
                 res = {"tenant": self.tenant, "scan_id": sid,
                        "state": d["state"], "target": spec["target"],
+                       "accepted_mono": accepted_mono,
                        "latency_s": time.monotonic() - t0}
                 if (self.hash_results
                         and d["state"] in ("done", "degraded")):
@@ -291,12 +349,101 @@ def _kill_restart(gw: Gateway, kill_after_s: float, restart: bool,
         out["kill_error"] = f"restart failed: {e}"
 
 
+def _launch_gateways(serve_cmd: str, n: int, root: str,
+                     log=print) -> list[subprocess.Popen]:
+    """HA fleet launcher: run the ``--serve-cmd`` template N times over
+    the shared root and wait until ONE member leads (serve.json appears
+    and its /healthz answers ok). Ports must be ephemeral
+    (serving.port=0) — members discover each other via the root, not via
+    the command line."""
+    procs: list[subprocess.Popen] = []
+    logf = open(os.path.join(root, "gateways.log"), "ab") \
+        if os.path.isdir(root) else None
+    for i in range(n):
+        proc = subprocess.Popen(shlex.split(serve_cmd),
+                                stdout=logf or subprocess.DEVNULL,
+                                stderr=subprocess.STDOUT)
+        procs.append(proc)
+        log(f"[loadgen] gateway {i + 1}/{n} launched (pid {proc.pid})")
+    base = discover(root, timeout_s=180.0)
+    t_end = time.monotonic() + 180.0
+    while time.monotonic() < t_end:
+        dead = [p for p in procs if p.poll() is not None]
+        if dead:
+            raise RuntimeError(
+                f"gateway pid {dead[0].pid} exited "
+                f"{dead[0].returncode} before the group elected")
+        try:
+            _, raw = _get(base + "/healthz", timeout=5.0)
+            h = json.loads(raw)
+            if h.get("ok") and h.get("role") == "leader":
+                log(f"[loadgen] leader at {base} (epoch {h.get('epoch')})")
+                return procs
+        except _NETERR:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError("HA group never elected a leader")
+
+
+def _kill_leader(gw: Gateway, kill_after_s: float, out: dict,
+                 log=print) -> None:
+    """The HA chaos arm: SIGKILL the LEADER (pid + epoch from the
+    epoch-stamped serve.json) mid-load and wait — with NO restart — for
+    a surviving member to steal the expired lease, bump the epoch, and
+    rewrite serve.json. Records ``t_kill_mono`` (the failover_s origin)
+    and ``failover_healthz_s`` (death → new leader healthy)."""
+    time.sleep(kill_after_s)
+    sj = os.path.join(gw.root, "serve.json")
+    try:
+        with open(sj) as f:
+            info = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        out["kill_error"] = f"serve.json unreadable: {e}"
+        return
+    pid, epoch = int(info["pid"]), int(info.get("epoch", 0))
+    t_kill = time.monotonic()
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except OSError as e:
+        out["kill_error"] = f"SIGKILL leader pid {pid}: {e}"
+        return
+    out["killed_pid"] = pid
+    out["killed_epoch"] = epoch
+    out["t_kill_mono"] = t_kill
+    log(f"[loadgen] SIGKILL LEADER pid {pid} (epoch {epoch}) after "
+        f"{kill_after_s:g}s of load; awaiting takeover")
+    t_end = time.monotonic() + 300.0
+    while time.monotonic() < t_end:
+        try:
+            with open(sj) as f:
+                info = json.load(f)
+            if int(info.get("epoch", 0)) > epoch:
+                base = f"http://{info['host']}:{info['port']}"
+                _, raw = _get(base + "/healthz", timeout=5.0)
+                h = json.loads(raw)
+                if h.get("ok") and h.get("role") == "leader":
+                    gw.adopt(base)
+                    out["new_epoch"] = h.get("epoch")
+                    out["failover_healthz_s"] = round(
+                        time.monotonic() - t_kill, 3)
+                    log(f"[loadgen] new leader at {base} (epoch "
+                        f"{h.get('epoch')}, healthz after "
+                        f"{out['failover_healthz_s']}s)")
+                    return
+        except (*_NETERR, json.JSONDecodeError, KeyError, ValueError):
+            pass
+        time.sleep(0.2)
+    out["kill_error"] = "no takeover within 300s of the leader kill"
+
+
 def run_load(base: str, manifest: dict, scans: int, rate: float,
              seed: int = 0, budget_s: float = 0.0,
              request_timeout_s: float = 600.0, root: str | None = None,
              kill_after_s: float = 0.0, restart: bool = False,
              restart_cmd: str | None = None, client_ids: bool = False,
-             hash_results: bool = False, log=print) -> dict:
+             hash_results: bool = False, gateways: int = 0,
+             serve_cmd: str | None = None,
+             kill_leader_after_s: float = 0.0, log=print) -> dict:
     """Drive the gateway with every tenant in ``manifest`` and summarize.
     Importable — ``bench.py``'s serve arm calls this directly."""
     tenants = manifest["tenants"]
@@ -305,7 +452,22 @@ def run_load(base: str, manifest: dict, scans: int, rate: float,
     gw = Gateway(base, root=root)
     kill_info: dict = {}
     killer = None
-    if kill_after_s > 0:
+    procs: list[subprocess.Popen] = []
+    if gateways > 0:
+        if not root or not serve_cmd:
+            raise ValueError("--gateways needs --root and --serve-cmd")
+        procs = _launch_gateways(serve_cmd, gateways, root, log=log)
+        gw.refresh()                          # point at the leader
+    if kill_leader_after_s > 0:
+        if not root:
+            raise ValueError("--kill-leader-after needs --root (pid + "
+                             "epoch come from serve.json)")
+        client_ids = hash_results = True      # idempotent retries + parity
+        killer = threading.Thread(
+            target=_kill_leader,
+            args=(gw, kill_leader_after_s, kill_info, log),
+            daemon=True)
+    elif kill_after_s > 0:
         if not root:
             raise ValueError("--kill-after needs --root (pid + argv come "
                              "from serve.json)")
@@ -358,6 +520,16 @@ def run_load(base: str, manifest: dict, scans: int, rate: float,
         out["reject_reasons"] = reasons
     if kill_info:
         out["kill"] = kill_info
+    if kill_leader_after_s > 0 and "t_kill_mono" in kill_info:
+        # failover_s: leader death -> first accepted submit (202) that
+        # landed on the NEW leader; healthz-level takeover as fallback
+        t_kill = kill_info["t_kill_mono"]
+        post = sorted(r["accepted_mono"] - t_kill for r in results
+                      if r.get("accepted_mono", 0.0) > t_kill)
+        out["failover_s"] = round(post[0], 3) if post \
+            else kill_info.get("failover_healthz_s")
+        out["failover_healthz_s"] = kill_info.get("failover_healthz_s")
+        kill_info.pop("t_kill_mono", None)
     if hash_results:
         # post-restart parity: every completion of the SAME (tenant,
         # target) must serve the SAME bytes, killed gateway or not
@@ -384,6 +556,23 @@ def run_load(base: str, manifest: dict, scans: int, rate: float,
             text, "sl3d_serve_cross_tenant_launches_total")
     except (OSError, ValueError) as e:
         log(f"[loadgen] metrics scrape failed: {e}")
+    if procs:
+        # drain the fleet we launched: SIGTERM is the graceful path —
+        # survivors must exit 0 (the killed leader reports -9/137)
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        rcs = []
+        for p in procs:
+            try:
+                rcs.append(p.wait(timeout=120))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs.append(None)
+        out["gateway_exit_codes"] = rcs
+        killed = kill_info.get("killed_pid")
+        out["survivors_clean"] = all(
+            rc == 0 for p, rc in zip(procs, rcs) if p.pid != killed)
     return out
 
 
@@ -415,6 +604,20 @@ def main(argv=None) -> int:
     ap.add_argument("--restart-cmd", default=None,
                     help="shell command to relaunch the service "
                          "(default: the argv recorded in serve.json)")
+    ap.add_argument("--gateways", type=int, default=0,
+                    help="HA mode: launch this many gateway processes "
+                         "(each runs --serve-cmd) over the shared "
+                         "--root before driving load")
+    ap.add_argument("--serve-cmd", default=None,
+                    help="with --gateways: the serve command to run per "
+                         "gateway (must use serving.ha_enabled=true and "
+                         "serving.port=0 on the shared root)")
+    ap.add_argument("--kill-leader-after", type=float, default=0.0,
+                    help="HA mode: SIGKILL the LEADER (pid from the "
+                         "epoch-stamped serve.json) after this many "
+                         "seconds of load and let the survivors take "
+                         "over (no restart); reports failover_s; "
+                         "needs --root")
     ap.add_argument("--hash-results", action="store_true",
                     help="sha256 every completed PLY/STL and report "
                          "parity per (tenant, target)")
@@ -424,7 +627,15 @@ def main(argv=None) -> int:
         ap.error("one of --url / --root is required")
     if args.kill_after > 0 and not args.root:
         ap.error("--kill-after needs --root")
-    base = args.url or discover(args.root)
+    if args.kill_leader_after > 0 and not args.root:
+        ap.error("--kill-leader-after needs --root")
+    if args.gateways > 0 and not (args.root and args.serve_cmd):
+        ap.error("--gateways needs --root and --serve-cmd")
+    if args.gateways > 0:
+        os.makedirs(args.root, exist_ok=True)
+        base = ""           # run_load discovers the leader post-launch
+    else:
+        base = args.url or discover(args.root)
     with open(args.manifest) as f:
         manifest = json.load(f)
     out = run_load(base, manifest, args.scans, args.rate, seed=args.seed,
@@ -432,7 +643,9 @@ def main(argv=None) -> int:
                    request_timeout_s=args.request_timeout_s,
                    root=args.root, kill_after_s=args.kill_after,
                    restart=args.restart, restart_cmd=args.restart_cmd,
-                   hash_results=args.hash_results)
+                   hash_results=args.hash_results,
+                   gateways=args.gateways, serve_cmd=args.serve_cmd,
+                   kill_leader_after_s=args.kill_leader_after)
     line = json.dumps(out)
     print(line)
     if args.out:
@@ -444,6 +657,12 @@ def main(argv=None) -> int:
     if args.kill_after > 0:
         ok = (ok and "kill_error" not in out.get("kill", {})
               and out.get("parity_ok") is not False)
+    if args.kill_leader_after > 0:
+        ok = (ok and "kill_error" not in out.get("kill", {})
+              and out.get("parity_ok") is not False
+              and out.get("failover_s") is not None)
+    if args.gateways > 0:
+        ok = ok and out.get("survivors_clean", False)
     return 0 if ok else 1
 
 

@@ -77,6 +77,18 @@ SERVE_RULES = ["serve.crash~grant:crash", "serve.crash~complete:crash",
                "serve.crash~assembly:crash", "ledger.append:crash",
                "ledger.append:transient"]
 
+# gateway-HA matrix (ISSUE 14): two in-process HA members over one
+# root; the leader either CRASHES at a durability boundary (lease never
+# released — the standby must wait out the expiry) or is turned into a
+# ZOMBIE (its renew stalls past the lease; a standby steals the epoch
+# while the stale leader's engine is still appending — the fence must
+# reject every late write). "zombie-renew" is formatted with the
+# leader's run_id at draw time so only the leader's renew stalls.
+HA_KINDS = ["crash-grant", "crash-assembly", "zombie-renew"]
+HA_RULES = {"crash-grant": "serve.crash~grant:crash",
+            "crash-assembly": "serve.crash~assembly:crash",
+            "zombie-renew": "election.renew~{leader}:stall(3.0)"}
+
 
 def fail(why: str) -> int:
     print(f"SOAK=FAIL ({why})")
@@ -118,6 +130,13 @@ def main() -> int:
                     help="additional serving kill->restart runs drawn "
                          "from the serve-scope matrix (serve.crash / "
                          "ledger.append); 0 disables")
+    ap.add_argument("--ha-runs", type=int, default=0,
+                    help="additional two-member gateway-HA runs drawn "
+                         "from the HA matrix (leader crash at a "
+                         "durability boundary / stalled-renew zombie); "
+                         "every accepted request must settle on the "
+                         "surviving leader with a cleanly folding, "
+                         "fence-consistent ledger; 0 disables")
     args = ap.parse_args()
 
     from structured_light_for_3d_model_replication_tpu.cli import (
@@ -137,7 +156,8 @@ def main() -> int:
     # last line of defense: if the deadline layer itself wedges, dump every
     # thread's stack and die loudly instead of hanging CI
     alarm_s = int(args.budget_s * (args.runs + args.multiproc_runs
-                                   + 2 * args.serve_runs) + 120)
+                                   + 2 * args.serve_runs
+                                   + 2 * args.ha_runs) + 120)
 
     def on_alarm(signum, frame):
         faulthandler.dump_traceback(all_threads=True)
@@ -381,9 +401,102 @@ def main() -> int:
                   f"[{spec}] ({len(states)} scan(s), "
                   f"{len(rs['completed'])} credited item(s))")
 
+        # ---- gateway-HA matrix (ISSUE 14): leader A + standby B over
+        # one root; A is felled (crash or stalled-renew zombie) and B
+        # must steal the epoch, resume, and settle every accepted
+        # request — with the fold ignoring anything A raced in late.
+        def ha_cfg() -> Config:
+            c = serve_cfg()
+            c.serving.ha_enabled = True
+            c.serving.ha_lease_s = 1.5
+            c.serving.ha_renew_s = 0.4
+            c.serving.ha_poll_s = 0.3
+            return c
+
+        for i in range(args.ha_runs):
+            kind = rng.choice(HA_KINDS)
+            hroot = os.path.join(tmp, f"ha_{i:03d}")
+            t0 = time.monotonic()
+            a = serving.ScanService(hroot, cfg=ha_cfg(),
+                                    log=lambda m: None)
+            a.start()
+            t_end = time.monotonic() + 30.0
+            while a.role != "leader" and time.monotonic() < t_end:
+                time.sleep(0.05)
+            if a.role != "leader":
+                a.close()
+                return fail(f"ha run {i} [{kind}] member never led")
+            b = serving.ScanService(hroot, cfg=ha_cfg(),
+                                    log=lambda m: None)
+            b.start()
+            spec = HA_RULES[kind].format(leader=a.run_id)
+            faults.configure(spec, seed=args.seed + 3000 + i)
+            accepted = []
+            try:
+                for tenant in ("ta", "tb"):
+                    ok, body = a.submit({"tenant": tenant, "target": root,
+                                         "calib": calib})
+                    if ok:
+                        accepted.append(body["scan_id"])
+            except faults.InjectedCrash:
+                pass                 # died in the submit path itself
+            if not accepted:
+                faults.reset()
+                b.close()
+                a.close()
+                return fail(f"ha run {i} [{kind}] leader accepted "
+                            f"nothing")
+            # settlement happens on the SURVIVING leader (B): it must
+            # steal the epoch and bring every accepted request terminal
+            t_end = t0 + 2 * args.budget_s
+            states: dict = {}
+            settled = False
+            while time.monotonic() < t_end:
+                ds = [b.status(s) for s in accepted]
+                states = {s: (d["state"] if d else None)
+                          for s, d in zip(accepted, ds)}
+                if all(d is not None and d["state"] in TERMINAL
+                       for d in ds):
+                    settled = True
+                    break
+                time.sleep(0.1)
+            faults.reset()
+            b.close()
+            a.close()
+            wall = time.monotonic() - t0
+            walls.append(round(wall, 1))
+            if not settled:
+                return fail(f"ha run {i} [{kind}] not settled on the "
+                            f"standby: {states}")
+            bad = {s: st for s, st in states.items()
+                   if st not in ("done", "degraded")}
+            if bad:
+                return fail(f"ha run {i} [{kind}] accepted requests not "
+                            f"recovered: {bad}")
+            try:
+                rs = replay_serving(os.path.join(hroot, "ledger.jsonl"))
+            except ValueError as e:
+                return fail(f"ha run {i} [{kind}] ledger invalid: {e}")
+            if rs["max_epoch"] < 2:
+                return fail(f"ha run {i} [{kind}] no takeover journaled "
+                            f"(max_epoch {rs['max_epoch']})")
+            stuck = {s: rs["scans"][s]["state"] for s in accepted
+                     if s in rs["scans"]
+                     and rs["scans"][s]["state"] not in ("done",
+                                                         "degraded")}
+            if stuck:
+                return fail(f"ha run {i} [{kind}] fold disagrees (stale-"
+                            f"epoch credit leaked?): {stuck}")
+            outcomes[f"ha-{kind}"] = outcomes.get(f"ha-{kind}", 0) + 1
+            print(f"[soak] ha run    {i}: {kind:<14} {wall:5.1f}s  "
+                  f"epoch {rs['max_epoch']}, "
+                  f"{rs['stale_ignored']} stale record(s) fenced out of "
+                  f"the fold ({len(accepted)} scan(s))")
+
         summary = json.dumps(outcomes, sort_keys=True)
         print(f"SOAK=ok runs={args.runs} seed={args.seed} "
               f"multiproc={args.multiproc_runs} serve={args.serve_runs} "
+              f"ha={args.ha_runs} "
               f"outcomes={summary} max_wall={max(walls)}s")
         return 0
     finally:
