@@ -171,6 +171,16 @@ PIPE_COLD_IO_S = 0.04   # injected per-view load latency for the cold-IO arm
                         # (~a 46-frame 1080p stack over NFS/object storage)
 
 
+def _device_count_or_none():
+    """Device count WITHOUT forcing accelerator init: host-only arms must
+    stay accelerator-free, so only report when jax is already live."""
+    mod = sys.modules.get("jax")
+    try:
+        return mod.device_count() if mod is not None else None
+    except Exception:
+        return None
+
+
 def bench_reconstruct_pipeline(views: int = PIPE_VIEWS, reps: int = 2,
                                inject_io_latency_s: float = 0.0) -> dict:
     """Batch reconstruct from disk, serial (io_workers=1) vs pipelined
@@ -1393,6 +1403,189 @@ def bench_multiproc(views: int = PIPE_VIEWS) -> dict:
     return out
 
 
+def bench_fabric(views: int = PIPE_VIEWS) -> dict:
+    """Pod-fabric cost + locality payoff (ISSUE 15).
+
+    Arms A/B (``single_s`` vs ``fabric_hooked_s``, interleaved best-of-2):
+    arm A is the stock single-process pipeline; arm B runs the SAME scan
+    with its stage cache swapped for a ``FabricCache`` write-through to a
+    live loopback ``BlobServer`` — every payload put is hashed and pushed
+    over a real TCP socket, upper-bounding what the blobstore costs a
+    worker that never even needs L2. ``fabric_overhead`` = B/A is the
+    <= 1.02x contract number.
+
+    Arm C (``fabric_s``): the real thing — the scan sharded across 2
+    worker processes over real TCP (``coordinator.listen`` + shared
+    secret, private per-worker L1 roots), with ``parity_ply``/
+    ``parity_stl`` byte comparisons against arm A, the blobstore's wire
+    counters (``wire_bytes``, ``blob_hit_ratio`` = served fetches over
+    fetch attempts), and the COLD locality split (``cold_locality_hits``/
+    ``_misses`` — racy by nature: a cold 2-worker pod only hits when one
+    worker happened to compute or fetch both of a pair's endpoint views).
+
+    Arm D (``resume_s``): the deterministic locality probe — the same
+    scan with every L1 (both workers' private roots AND the coordinator's
+    assembly cache) pre-seeded from arm A's view payloads, i.e. a pod
+    rejoining after a coordinator restart. Workers re-announce their full
+    inventory on hello, so EVERY pair grant prefers a holder:
+    ``locality_hit_rate`` must be 1.0, pair registration reads straight
+    from L1, and ``recompute_avoided_s`` = C - D is what the warm fabric
+    bought. Walls are regime records on a 1-CPU box; the contract numbers
+    are the overhead ratio, the parity bits, and the locality rate."""
+    import shutil
+    import tempfile
+
+    from structured_light_for_3d_model_replication_tpu.config import Config
+    from structured_light_for_3d_model_replication_tpu.io import images as imio
+    from structured_light_for_3d_model_replication_tpu.io import matfile
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+    from structured_light_for_3d_model_replication_tpu.pipeline.blobstore import (
+        BlobClient,
+        BlobServer,
+        FabricCache,
+    )
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        synthetic as syn,
+    )
+
+    out: dict = {"views": views, "backend": "numpy", "workers": 2,
+                 "host_cpus": os.cpu_count(),
+                 "device_count": _device_count_or_none()}
+    tmp = tempfile.mkdtemp(prefix="slbench_fab_")
+    try:
+        rig = syn.default_rig(cam_size=PIPE_CAM, proj_size=PIPE_PROJ)
+        scene = syn.sphere_on_background()
+        obj, background = scene.objects
+        calib_path = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(calib_path, rig.calibration())
+        root = os.path.join(tmp, "scans")
+        os.makedirs(root)
+        step = 360.0 / views
+        pivot = np.array([0.0, 0.0, 420.0])
+        for i, (R, t) in enumerate(syn.turntable_poses(views, step, pivot)):
+            frames, _ = syn.render_scene(
+                rig, syn.Scene([obj.transformed(R, t), background]))
+            imio.save_stack(
+                os.path.join(root, f"scan_{int(round(i * step)):03d}deg_scan"),
+                frames)
+
+        def cfg(workers: int = 0, listen: str = "", secret: str = "") -> Config:
+            c = Config()
+            c.parallel.backend = "numpy"
+            c.decode.n_cols, c.decode.n_rows = PIPE_PROJ
+            c.decode.thresh_mode = "manual"
+            c.merge.voxel_size = 4.0
+            c.merge.ransac_trials = 512
+            c.merge.icp_iters = 10
+            c.mesh.depth = 5
+            c.mesh.density_trim_quantile = 0.0
+            c.coordinator.workers = workers
+            c.coordinator.listen = listen
+            c.coordinator.secret = secret
+            return c
+
+        steps = ("statistical",)
+        single_walls, hooked_walls = [], []
+        for rep_i in range(2):
+            t0 = time.perf_counter()
+            rep = stages.run_pipeline(calib_path, root,
+                                      os.path.join(tmp, f"sp{rep_i}"),
+                                      cfg=cfg(), steps=steps,
+                                      log=lambda m: None)
+            single_walls.append(time.perf_counter() - t0)
+            assert not rep.failed, rep.failed
+            # arm B: same single-process run, cache swapped for a
+            # write-through FabricCache against a live loopback blobstore
+            # (fresh L2 root per rep so every push pays full freight)
+            out_hk = os.path.join(tmp, f"hk{rep_i}")
+            srv = BlobServer(os.path.join(tmp, f"l2_{rep_i}"), port=0)
+            cli = BlobClient(srv.endpoint, connect_timeout_s=10.0)
+            try:
+                fcache = FabricCache(os.path.join(out_hk, ".slscan-cache"),
+                                     cli, log=lambda m: None)
+                t0 = time.perf_counter()
+                rep2 = stages.run_pipeline(calib_path, root, out_hk,
+                                           cfg=cfg(), steps=steps,
+                                           log=lambda m: None, cache=fcache)
+                hooked_walls.append(time.perf_counter() - t0)
+                out["l2_bytes_pushed"] = srv.counters()["bytes_pushed"]
+            finally:
+                cli.close()
+                srv.close()
+            assert not rep2.failed, rep2.failed
+        out["single_s"] = round(min(single_walls), 4)
+        out["fabric_hooked_s"] = round(min(hooked_walls), 4)
+        out["single_walls"] = [round(w, 4) for w in single_walls]
+        out["hooked_walls"] = [round(w, 4) for w in hooked_walls]
+        out["fabric_overhead"] = (
+            round(out["fabric_hooked_s"] / out["single_s"], 3)
+            if out["single_s"] else None)
+
+        # ---- arm C: cold 2-worker pod over real TCP + byte parity ------
+        out_fab = os.path.join(tmp, "fab")
+        t0 = time.perf_counter()
+        rep3 = stages.run_pipeline(
+            calib_path, root, out_fab,
+            cfg=cfg(workers=2, listen="127.0.0.1:0", secret="bench-pod"),
+            steps=steps, log=lambda m: None)
+        out["fabric_s"] = round(time.perf_counter() - t0, 4)
+        out["fabric_vs_single"] = (
+            round(out["fabric_s"] / out["single_s"], 3)
+            if out["single_s"] else None)
+        info = rep3.coordinator or {}
+        fb = info.get("fabric") or {}
+        out["wire_bytes"] = fb.get("bytes_fetched", 0) \
+            + fb.get("bytes_pushed", 0)
+        out["bytes_fetched"] = fb.get("bytes_fetched", 0)
+        out["bytes_pushed"] = fb.get("bytes_pushed", 0)
+        out["bytes_deduped"] = fb.get("bytes_deduped", 0)
+        attempts = fb.get("fetches", 0) + fb.get("misses", 0)
+        out["blob_hit_ratio"] = (
+            round(fb.get("fetches", 0) / attempts, 3) if attempts else None)
+        out["cold_locality_hits"] = info.get("locality_hits")
+        out["cold_locality_misses"] = info.get("locality_misses")
+        for name, key in (("merged.ply", "parity_ply"),
+                          ("model.stl", "parity_stl")):
+            with open(os.path.join(tmp, "sp0", name), "rb") as fa, \
+                    open(os.path.join(out_fab, name), "rb") as fb2:
+                out[key] = fa.read() == fb2.read()
+
+        # ---- arm D: warm-resume pod — the deterministic locality probe -
+        out_res = os.path.join(tmp, "res")
+        src_cache = os.path.join(tmp, "sp0", ".slscan-cache")
+        seeds = [os.path.join(out_res, ".slscan-cache"),
+                 os.path.join(out_res, ".slscan-cache.w0"),
+                 os.path.join(out_res, ".slscan-cache.w1")]
+        for d in seeds:
+            os.makedirs(d, exist_ok=True)
+            for f in os.listdir(src_cache):
+                if f.startswith("view-") and f.endswith(".npz"):
+                    shutil.copy(os.path.join(src_cache, f),
+                                os.path.join(d, f))
+        t0 = time.perf_counter()
+        rep4 = stages.run_pipeline(
+            calib_path, root, out_res,
+            cfg=cfg(workers=2, listen="127.0.0.1:0", secret="bench-pod"),
+            steps=steps, log=lambda m: None)
+        out["resume_s"] = round(time.perf_counter() - t0, 4)
+        out["recompute_avoided_s"] = (
+            round(out["fabric_s"] - out["resume_s"], 4)
+            if out.get("fabric_s") else None)
+        rinfo = rep4.coordinator or {}
+        hits = rinfo.get("locality_hits", 0) or 0
+        misses = rinfo.get("locality_misses", 0) or 0
+        out["locality_hits"] = hits
+        out["locality_misses"] = misses
+        out["locality_hit_rate"] = (
+            round(hits / (hits + misses), 3) if hits + misses else None)
+        with open(os.path.join(tmp, "sp0", "model.stl"), "rb") as fa, \
+                open(os.path.join(out_res, "model.stl"), "rb") as fb2:
+            out["parity_stl_resume"] = fa.read() == fb2.read()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def bench_serve(tenants: int = 3, scans_per_tenant: int = 2,
                 views: int = 2, compute_batch: int = 4,
                 rate_hz: float = 5.0, seed: int = 0) -> dict:
@@ -2274,6 +2467,25 @@ def main() -> None:
                 "error": f"{type(e).__name__}: {e}"[:200]}
             log(f"multiproc arm FAILED ({final['multiproc']['error']})")
 
+        # pod-fabric overhead + 2-worker real-TCP parity + locality
+        # (host-only: numpy backend, loopback sockets)
+        try:
+            log("fabric arm (blobstore write-through overhead + 2-worker "
+                "TCP pod + warm-resume locality probe)...")
+            final["fabric"] = bench_fabric()
+            fa = final["fabric"]
+            log(f"fabric: single {fa['single_s']}s vs hooked "
+                f"{fa['fabric_hooked_s']}s (x{fa['fabric_overhead']}); "
+                f"pod {fa.get('fabric_s')}s, parity "
+                f"ply={fa.get('parity_ply')} stl={fa.get('parity_stl')}, "
+                f"{fa.get('wire_bytes')} B over wire, blob hit ratio "
+                f"{fa.get('blob_hit_ratio')}; resume {fa.get('resume_s')}s, "
+                f"locality hit rate {fa.get('locality_hit_rate')}")
+        except Exception as e:
+            final["fabric"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
+            log(f"fabric arm FAILED ({final['fabric']['error']})")
+
         # one TPU client at a time, repo-wide: if a validation session (or
         # any other tool) holds the claim lock, QUEUE behind it — racing it
         # is the concurrent-client wedge. Waiting is also the best outcome:
@@ -2464,6 +2676,26 @@ if __name__ == "__main__":
                 # carries their dataset/page-cache bias
                 line["pipeline_deadline"]["fused_ref_ratio"] = round(
                     dl_off / fused, 3)
+        except Exception as e:
+            line["error"] = f"{type(e).__name__}: {e}"[:200]
+        emit(line)
+        sys.exit(0)
+    if "--fabric-only" in sys.argv[1:]:
+        # standalone record of the pod-fabric A/B (stock vs blobstore
+        # write-through single-process, cold 2-worker TCP pod, warm-resume
+        # locality probe): one JSON line on stdout, no jax, no accelerator
+        # lock — the numpy backend end to end (ci_tier1's BENCH_FABRIC
+        # block runs this with its own budget, separate from
+        # --pipeline-only so neither arm eats the other's timeout)
+        views = PIPE_VIEWS
+        for a in sys.argv[1:]:
+            if a.startswith("--views="):
+                views = int(a.split("=")[1])
+        line = {"metric": "fabric_pod_wall", "unit": "s",
+                "value": None, "error": None}
+        try:
+            line.update(bench_fabric(views))
+            line["value"] = line.get("fabric_s")
         except Exception as e:
             line["error"] = f"{type(e).__name__}: {e}"[:200]
         emit(line)

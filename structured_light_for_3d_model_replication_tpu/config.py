@@ -391,6 +391,20 @@ class CoordinatorConfig:
     # worker -> coordinator connect deadline; a worker that cannot reach
     # the coordinator within it exits with a clear diagnostic
     connect_timeout_s: float = 20.0
+    # ---- pod fabric (parallel/netutil.py endpoint grammar) --------------
+    # coordinator bind endpoint ("host:port", "[v6]:port", ":port"; empty
+    # = PR-8 loopback behavior, fabric layer fully disabled). Setting it
+    # turns on the networked fabric: the blobstore co-hosts next to the
+    # coordinator, spawned workers get private L1 cache roots, and
+    # external `sl3d worker` processes may join over TCP
+    listen: str = ""
+    # worker-side: coordinator endpoint to dial (external workers; spawned
+    # workers get theirs in the spec). Empty = dial loopback `port`
+    connect: str = ""
+    # shared secret for the hello handshake; when set, every connection
+    # (coordinator AND blobstore) must present it in its first request or
+    # all further ops answer {"error": "unauthorized"}
+    secret: str = ""
 
 
 @dataclass

@@ -36,6 +36,25 @@ Lease protocol (see parallel/lease.py for the bookkeeping invariants):
 A worker that misses ``lease_s`` of heartbeats has its items stolen
 (generation bump) and re-granted to survivors; an item stolen more than
 ``coordinator.max_steals`` times is declared LOST and left to assembly.
+
+**Pod fabric** (``coordinator.listen`` non-empty): the same protocol over a
+real TCP endpoint instead of an ephemeral loopback port, so ``sl3d worker``
+processes on OTHER hosts can join. What changes:
+
+  - the server binds ``coordinator.listen`` (netutil endpoint grammar,
+    IPv6-safe) and, when ``coordinator.secret`` is set, requires a matching
+    ``hello`` as a connection's first request (anything else answers
+    ``{"error": "unauthorized"}``);
+  - a content-addressed blob service (pipeline/blobstore.py) co-hosts next
+    to the coordinator, backed by the SAME cache directory assembly reads —
+    spawned workers get private L1 roots and the fabric is their L2, so the
+    cache-warmer parity construction carries over to hosts that do not
+    share a disk (a missing payload is a recompute, never a wrong byte);
+  - ``hello``/``next``/``beat`` carry inventory diffs (which blob names the
+    worker's L1 holds) into a :class:`~.lease.LocalityIndex`, and pair
+    grants prefer the worker already holding BOTH cleaned-view payloads
+    (``locality: hit`` on the grant event; plain FIFO fallback means a
+    cold worker never starves).
 """
 from __future__ import annotations
 
@@ -49,6 +68,7 @@ import threading
 import time
 
 from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.parallel import netutil
 from structured_light_for_3d_model_replication_tpu.utils import deadline as dl
 from structured_light_for_3d_model_replication_tpu.utils import faults
 from structured_light_for_3d_model_replication_tpu.utils import telemetry as tel
@@ -173,6 +193,7 @@ class _Coordinator:
                  run_id: str, view_done: set[str], log):
         from structured_light_for_3d_model_replication_tpu.parallel.lease import (
             LeaseTable,
+            LocalityIndex,
         )
 
         self.cfg = cfg
@@ -187,20 +208,49 @@ class _Coordinator:
         self.done = threading.Event()
         self.crash: BaseException | None = None   # injected coord crash
         self.workers_seen: dict[str, int] = {}    # worker -> pid
+        self.worker_addrs: dict[str, str] = {}    # worker -> advertised addr
         self.completed_by: dict[str, int] = {}
         self.steal_count = 0
         self.late_completes = 0
+        # fabric mode only: inventory-driven grant preference. Off-fabric
+        # (shared disk) the index stays None and grants are exactly the
+        # PR-8 FIFO — zero behavior drift for `--workers` without listen
+        self.locality = (LocalityIndex() if cfg.coordinator.listen
+                         else None)
+        self.blob_endpoint = ""         # set by run_coordinated in fabric mode
 
     # ---- queue logic (call under self.lock) ------------------------------
 
-    def _grantable(self) -> _Item | None:
+    def _pair_needs(self, it: _Item) -> tuple[str, ...] | None:
+        """The blob names a pair item reads (its endpoints' cleaned-view
+        payloads) — what locality scoring matches against inventories."""
+        if it.kind != "pair":
+            return None
+        return (f"view-{it.spec['key_dst'][:16]}",
+                f"view-{it.spec['key_src'][:16]}")
+
+    def _grantable(self, worker: str | None = None) \
+            -> tuple[_Item | None, str | None]:
+        """First grantable item for ``worker`` plus the locality verdict
+        ("hit"/"miss"/None). Without a locality index (off-fabric) this is
+        plain FIFO over dep-ready pending items."""
+        cands: list[_Item] = []
         for iid in self.order:
             it = self.items[iid]
             if it.state != "pending":
                 continue
             if all(d in self.view_done for d in it.deps):
-                return it
-        return None
+                if self.locality is None or worker is None:
+                    return it, None
+                cands.append(it)
+        if not cands:
+            return None, None
+        idx, hit = self.locality.choose(
+            worker, [(it.id, self._pair_needs(it)) for it in cands])
+        chosen = cands[idx]
+        if chosen.kind != "pair":
+            return chosen, None
+        return chosen, ("hit" if hit else "miss")
 
     def _dep_blocked_forever(self, it: _Item) -> bool:
         """A pending pair whose endpoint view FAILED or was LOST can never
@@ -222,21 +272,36 @@ class _Coordinator:
 
     # ---- protocol ops (any server thread) --------------------------------
 
+    def _fold_inventory(self, req: dict) -> None:
+        """Inventory diffs piggyback on hello/next/beat; additive, so a
+        replayed or reordered diff is harmless."""
+        if self.locality is not None:
+            inv = req.get("inventory")
+            if inv:
+                self.locality.update(req["worker"], inv)
+
     def op_hello(self, req: dict) -> dict:
         w = req["worker"]
         with self.lock:
             self.workers_seen[w] = int(req.get("pid", 0))
+            if req.get("addr"):
+                self.worker_addrs[w] = str(req["addr"])
+        self._fold_inventory(req)
         c = self.cfg.coordinator
-        return {"ok": True, "run_id": self.run_id,
-                "lease_s": c.lease_s, "heartbeat_s": c.heartbeat_s}
+        out = {"ok": True, "run_id": self.run_id,
+               "lease_s": c.lease_s, "heartbeat_s": c.heartbeat_s}
+        if self.blob_endpoint:
+            out["blob"] = self.blob_endpoint
+        return out
 
     def op_next(self, req: dict) -> dict:
         w = req["worker"]
         self.leases.renew(w)
+        self._fold_inventory(req)
         if self.done.is_set():
             return {"shutdown": True}
         with self.lock:
-            it = self._grantable()
+            it, loc = self._grantable(w)
             if it is None:
                 # settle dep-dead pairs while we are here, so the run
                 # drains instead of idling on unreachable work
@@ -259,11 +324,15 @@ class _Coordinator:
             lease = self.leases.grant(it.id, w)
             it.state = "granted"
             it.worker = w
-            self.ledger.event("grant", item=it.id, worker=w, gen=lease.gen)
+            ev = {"item": it.id, "worker": w, "gen": lease.gen}
+            if loc is not None:
+                ev["locality"] = loc
+            self.ledger.event("grant", **ev)
         return {"grant": {"id": it.id, "gen": lease.gen, "kind": it.kind,
                           "spec": it.spec}}
 
     def op_beat(self, req: dict) -> dict:
+        self._fold_inventory(req)
         return {"ok": self.leases.renew(req["worker"])}
 
     def op_complete(self, req: dict) -> dict:
@@ -333,6 +402,8 @@ class _Coordinator:
                 self._check_done()
 
     def drop_worker(self, worker: str, why: str) -> None:
+        if self.locality is not None:
+            self.locality.drop_worker(worker)
         items = self.leases.drop_worker(worker)
         with self.lock:
             for iid in items:
@@ -359,22 +430,34 @@ class _Coordinator:
 
 
 class _Server:
-    """Loopback newline-JSON lease server; one daemon thread per worker
-    connection. Injected coordinator crashes raised in a handler are
-    STORED (the socket thread must not die silently) and re-raised by the
-    poll loop — the coordinator process then actually crashes."""
+    """Newline-JSON lease server; one daemon thread per worker connection.
+    Binds loopback + an ephemeral port by default (the PR-8 shape);
+    ``coordinator.listen`` rebinds it to a real endpoint so remote
+    ``sl3d worker`` processes can dial in, and ``coordinator.secret``
+    gates every connection behind a matching first-``hello``. Injected
+    coordinator crashes raised in a handler are STORED (the socket thread
+    must not die silently) and re-raised by the poll loop — the
+    coordinator process then actually crashes."""
 
-    def __init__(self, coord: _Coordinator, port: int, log):
+    def __init__(self, coord: _Coordinator, port: int, log,
+                 listen: str = "", secret: str = ""):
         self.coord = coord
         self.log = log
-        self._sock = socket.create_server(("127.0.0.1", port))
+        self.secret = secret
+        host, bind_port = netutil.parse_endpoint(listen, default_port=port)
+        self._sock = socket.create_server((host, bind_port))
         self._sock.settimeout(0.2)
+        self.host = self._sock.getsockname()[0]
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="sl3d-coord-accept", daemon=True)
         self._accept_thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return netutil.format_endpoint(self.host, self.port)
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -393,6 +476,11 @@ class _Server:
         ops = {"hello": self.coord.op_hello, "next": self.coord.op_next,
                "beat": self.coord.op_beat, "complete": self.coord.op_complete,
                "failed": self.coord.op_failed}
+        # per-connection auth: with a secret set, the FIRST request must
+        # be a hello presenting it; until then every op answers
+        # unauthorized and the connection closes (fail-closed — an
+        # unauthenticated peer learns nothing about the run)
+        authed = not self.secret
         try:
             with conn, conn.makefile("rw", encoding="utf-8") as f:
                 for line in f:
@@ -401,6 +489,14 @@ class _Server:
                         continue
                     try:
                         req = json.loads(line)
+                        if not authed:
+                            if (req.get("op") != "hello"
+                                    or req.get("secret") != self.secret):
+                                f.write(json.dumps(
+                                    {"error": "unauthorized"}) + "\n")
+                                f.flush()
+                                return
+                            authed = True
                         resp = ops[req["op"]](req)
                     except faults.InjectedCrash as e:
                         # surface on the poll loop; tell the worker to
@@ -461,10 +557,19 @@ def _build_items(cfg: Config, sources: list[str], view_keys: list[str],
 
 def _spawn_worker(rank: int, n: int, port: int, spec_dir: str,
                   cfg_path: str, calib_path: str, target: str, out_dir: str,
-                  steps: tuple[str, ...]) -> subprocess.Popen:
+                  steps: tuple[str, ...],
+                  fabric: dict | None = None) -> subprocess.Popen:
     spec = {"config": cfg_path, "calib": calib_path, "target": target,
             "out": out_dir, "steps": list(steps), "port": port,
             "worker": f"w{rank}", "num_workers": n}
+    if fabric:
+        # networked mode: dial the real endpoint, authenticate, use the
+        # blob fabric as L2 — and warm a PRIVATE L1 root, so each spawned
+        # worker honestly simulates a host with its own disk (fabric
+        # traffic, dedup, and locality are real and measurable on one box)
+        spec.update(fabric)
+        spec["cache_root"] = os.path.join(out_dir,
+                                          f".slscan-cache.w{rank}")
     spec_path = os.path.join(spec_dir, f"worker{rank}.json")
     with open(spec_path, "w") as f:
         json.dump(spec, f, indent=2)
@@ -543,12 +648,26 @@ def run_coordinated(calib_path: str, target: str, out_dir: str,
         return _assemble(calib_path, target, out_dir, cfg, steps,
                          merged_name, stl_name, log, coord, info, t0)
 
-    server = _Server(coord, cfg.coordinator.port, log)
+    fabric = bool(cfg.coordinator.listen)
+    server = _Server(coord, cfg.coordinator.port, log,
+                     listen=cfg.coordinator.listen,
+                     secret=cfg.coordinator.secret)
+    blob = None
+    if fabric:
+        from structured_light_for_3d_model_replication_tpu.pipeline.blobstore import (
+            BlobServer,
+        )
+
+        blob = BlobServer(cache.root, host=server.host, port=0,
+                          secret=cfg.coordinator.secret, log=log)
+        coord.blob_endpoint = blob.endpoint
     log(f"[coord] run {run_id}: {len(items)} item(s) "
         f"({sum(1 for i in items if i.kind == 'view')} view, "
         f"{sum(1 for i in items if i.kind == 'pair')} pair) across "
-        f"{n} worker(s); lease {cfg.coordinator.lease_s:g}s, port "
-        f"{server.port}, ledger -> {ledger_path}")
+        f"{n} worker(s); lease {cfg.coordinator.lease_s:g}s, "
+        + (f"listening on {server.endpoint} (blob {blob.endpoint}), "
+           if fabric else f"port {server.port}, ")
+        + f"ledger -> {ledger_path}")
 
     spec_dir = os.path.join(out_dir, ".coord")
     os.makedirs(spec_dir, exist_ok=True)
@@ -556,12 +675,27 @@ def run_coordinated(calib_path: str, target: str, out_dir: str,
     wcfg.coordinator.workers = 0
     cfg_path = os.path.join(spec_dir, "cfg.json")
     wcfg.save(cfg_path)
+    fabric_spec = None
+    if fabric:
+        fabric_spec = {"connect": server.endpoint,
+                       "secret": cfg.coordinator.secret,
+                       "blob": blob.endpoint}
+        # the two-terminal walkthrough: `sl3d worker --spec
+        # <out>/.coord/join.json` joins this run from another shell (or,
+        # with listen on a routable address, another machine — copy the
+        # spec and adjust the paths it names)
+        join = {"config": cfg_path, "calib": calib_path, "target": target,
+                "out": out_dir, "steps": list(steps), "port": server.port,
+                "worker": "ext0", "num_workers": n, **fabric_spec,
+                "cache_root": os.path.join(out_dir, ".slscan-cache.ext0")}
+        with open(os.path.join(spec_dir, "join.json"), "w") as f:
+            json.dump(join, f, indent=2)
     procs: dict[str, subprocess.Popen] = {}
     try:
         for r in range(n):
             procs[f"w{r}"] = _spawn_worker(
                 r, n, server.port, spec_dir, cfg_path, calib_path, target,
-                out_dir, steps)
+                out_dir, steps, fabric=fabric_spec)
         poll_s = max(0.05, min(0.5, cfg.coordinator.heartbeat_s / 4.0))
         reaped: set[str] = set()
         while not coord.done.is_set():
@@ -584,7 +718,14 @@ def run_coordinated(calib_path: str, target: str, out_dir: str,
                     log(f"[coord] worker {w} (pid {p.pid}) exited rc={rc} "
                         f"with work unsettled — reclaiming its leases")
                     coord.drop_worker(w, f"worker-exit rc={rc}")
-            if alive == 0 and not coord.done.is_set():
+            # fabric runs may be fed by EXTERNAL workers the coordinator
+            # never spawned (joined via coordinator.listen) — with one
+            # seen, or with none spawned at all (n=0 waits for joins),
+            # zero live children does not mean zero workers; lease expiry
+            # + max_steals + the run budget still bound the run
+            externals = any(w not in procs for w in coord.workers_seen)
+            if (alive == 0 and not coord.done.is_set()
+                    and not (fabric and (n == 0 or externals))):
                 # no survivors: whatever is left can never be granted
                 with coord.lock:
                     for iid in coord.order:
@@ -633,6 +774,8 @@ def run_coordinated(calib_path: str, target: str, out_dir: str,
                 except subprocess.TimeoutExpired:
                     p.kill()
                     p.wait()
+        if blob is not None:
+            blob.close()
         server.close()
         ledger.close()
 
@@ -647,6 +790,13 @@ def run_coordinated(calib_path: str, target: str, out_dir: str,
         "item_states": states,
         "coordination_wall_s": round(time.monotonic() - t0, 3),
     })
+    if coord.worker_addrs:
+        info["worker_addrs"] = dict(coord.worker_addrs)
+    if fabric:
+        info["listen"] = server.endpoint
+        info["fabric"] = blob.counters() if blob is not None else {}
+        if coord.locality is not None:
+            info.update(coord.locality.counters())
     lost = states.get("lost", 0) + states.get("failed", 0)
     log(f"[coord] coordination done in {info['coordination_wall_s']:.2f}s: "
         f"{states} (steals={coord.steal_count}); "
@@ -668,6 +818,7 @@ def _assemble(calib_path, target, out_dir, cfg, steps, merged_name,
 
     acfg = copy.deepcopy(cfg)
     acfg.coordinator.workers = 0
+    acfg.coordinator.listen = ""     # fabric is torn down; plain run now
     acfg.pipeline.cache = True
     report = stages.run_pipeline(calib_path, target, out_dir, cfg=acfg,
                                  steps=steps, merged_name=merged_name,

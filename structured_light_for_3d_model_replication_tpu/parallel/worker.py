@@ -28,6 +28,18 @@ Host-scope fault kinds (utils/faults.py) get real semantics here:
                         partitioned), then reconnect and report late — the
                         lease may have been stolen, exercising the
                         late-complete/"stolen" protocol arm.
+  net.slowlink(T)    -> (at site ``worker.sock``) every control frame on
+                        the coordinator/blobstore wire straggles T seconds;
+                        nothing raises, throughput just sags.
+
+Pod fabric: a spec carrying ``connect``/``secret`` dials a real TCP
+endpoint (netutil grammar — `sl3d worker --spec <out>/.coord/join.json`
+joins a listening coordinator from another shell or machine), and one
+carrying ``blob``/``cache_root`` warms a PRIVATE L1 StageCache with the
+coordinator-hosted blobstore as L2 (pipeline/blobstore.py). Heartbeats
+and ``next`` requests piggyback inventory diffs (which blob names this
+L1 holds) so pair grants can prefer the worker that already has both
+endpoint views.
 """
 from __future__ import annotations
 
@@ -39,6 +51,7 @@ import time
 import numpy as np
 
 from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.parallel import netutil
 from structured_light_for_3d_model_replication_tpu.utils import deadline as dl
 from structured_light_for_3d_model_replication_tpu.utils import faults
 from structured_light_for_3d_model_replication_tpu.utils import profiling as prof
@@ -53,11 +66,19 @@ class CoordClient:
     decides between reconnect (partition) and exit (dead coordinator)."""
 
     def __init__(self, port: int, worker: str, connect_timeout_s: float,
-                 io_timeout_s: float = 60.0):
-        self.port = port
+                 io_timeout_s: float = 60.0, connect: str = "",
+                 secret: str = ""):
+        # ONE resolved endpoint, shared grammar with the coordinator bind
+        # and the blobstore (parallel/netutil.py) — `connect` wins, bare
+        # `port` keeps the PR-8 loopback default. IPv6 literals must be
+        # bracketed ("[::1]:9100") and survive the round trip.
+        self.host, self.port = netutil.parse_endpoint(connect,
+                                                      default_port=port)
         self.worker = worker
+        self.secret = secret
         self.connect_timeout_s = connect_timeout_s
         self.io_timeout_s = io_timeout_s
+        self.addr = ""      # this side of the socket, once connected
         self._sock: socket.socket | None = None
         self._f = None
 
@@ -70,38 +91,61 @@ class CoordClient:
         while True:
             try:
                 self._sock = socket.create_connection(
-                    ("127.0.0.1", self.port), timeout=1.0)
+                    (self.host, self.port), timeout=1.0)
                 self._sock.settimeout(self.io_timeout_s)
                 self._f = self._sock.makefile("rw", encoding="utf-8")
+                name = self._sock.getsockname()
+                self.addr = netutil.format_endpoint(name[0], name[1])
                 return
             except OSError as e:
                 last = e
                 if deadline is not None and deadline.remaining() <= 0:
                     raise dl.DeadlineExceeded(
-                        f"worker {self.worker}: no coordinator on port "
-                        f"{self.port} within {self.connect_timeout_s:g}s "
+                        f"worker {self.worker}: no coordinator at "
+                        f"{netutil.format_endpoint(self.host, self.port)} "
+                        f"within {self.connect_timeout_s:g}s "
                         f"({type(e).__name__}: {e})") from last
                 time.sleep(0.1)
 
     def request(self, obj: dict) -> dict:
         if self._f is None:
             raise ConnectionError("not connected")
+        # per-frame wire site: `worker.sock:net.slowlink(T)` delays every
+        # control frame here (heartbeats still land — late, not lost)
+        faults.fire("worker.sock", item=f"coord:{obj.get('op')}")
         self._f.write(json.dumps(obj) + "\n")
         self._f.flush()
         line = self._f.readline()
         if not line:
             raise ConnectionError("coordinator closed the connection")
-        return json.loads(line)
+        resp = json.loads(line)
+        if resp.get("error") == "unauthorized":
+            raise PermissionError(
+                f"worker {self.worker}: coordinator at "
+                f"{netutil.format_endpoint(self.host, self.port)} rejected "
+                f"the handshake (bad or missing coordinator.secret)")
+        return resp
 
-    def hello(self, pid: int) -> dict:
-        return self.request({"op": "hello", "worker": self.worker,
-                             "pid": pid})
+    def hello(self, pid: int, inventory=None) -> dict:
+        req = {"op": "hello", "worker": self.worker, "pid": pid,
+               "addr": self.addr}
+        if self.secret:
+            req["secret"] = self.secret
+        if inventory:
+            req["inventory"] = list(inventory)
+        return self.request(req)
 
-    def next(self) -> dict:
-        return self.request({"op": "next", "worker": self.worker})
+    def next(self, inventory=None) -> dict:
+        req = {"op": "next", "worker": self.worker}
+        if inventory:
+            req["inventory"] = list(inventory)
+        return self.request(req)
 
-    def beat(self) -> dict:
-        return self.request({"op": "beat", "worker": self.worker})
+    def beat(self, inventory=None) -> dict:
+        req = {"op": "beat", "worker": self.worker}
+        if inventory:
+            req["inventory"] = list(inventory)
+        return self.request(req)
 
     def complete(self, item: str, gen: int) -> str:
         return self.request({"op": "complete", "worker": self.worker,
@@ -128,7 +172,7 @@ class _WorkerCtx:
     policy, the shared OverlapStats whose add() renews the lease."""
 
     def __init__(self, cfg: Config, spec: dict, client: CoordClient,
-                 heartbeat_s: float):
+                 heartbeat_s: float, blob_endpoint: str = ""):
         from structured_light_for_3d_model_replication_tpu.io import (
             matfile,
         )
@@ -143,27 +187,64 @@ class _WorkerCtx:
         self.worker = spec["worker"]
         self.steps = tuple(spec["steps"])
         self.calib = matfile.load_calibration(spec["calib"])
-        self.cache = StageCache(
-            os.path.join(spec["out"], ".slscan-cache"), enabled=True,
-            verify=cfg.pipeline.verify_cache, log=lambda *_: None)
         self.stats = prof.OverlapStats()
+        root = spec.get("cache_root") or os.path.join(spec["out"],
+                                                      ".slscan-cache")
+        if blob_endpoint or spec.get("connect"):
+            # fabric mode: private L1 root + the blobstore as L2. A blob
+            # endpoint advertising a wildcard bind resolves to the host
+            # we actually dialed the coordinator on
+            from structured_light_for_3d_model_replication_tpu.pipeline.blobstore import (
+                BlobClient,
+                FabricCache,
+            )
+
+            bclient = None
+            if blob_endpoint:
+                bhost, bport = netutil.parse_endpoint(blob_endpoint)
+                if bhost in ("0.0.0.0", "::"):
+                    bhost = client.host
+                bclient = BlobClient(
+                    netutil.format_endpoint(bhost, bport),
+                    secret=spec.get("secret", ""),
+                    connect_timeout_s=cfg.coordinator.connect_timeout_s)
+            self.cache = FabricCache(
+                root, bclient, enabled=True,
+                verify=cfg.pipeline.verify_cache, log=lambda *_: None,
+                stats=self.stats)
+        else:
+            self.cache = StageCache(
+                root, enabled=True,
+                verify=cfg.pipeline.verify_cache, log=lambda *_: None)
         self._scanner = None
         self._scanner_built = False
         self._last_beat = 0.0
+
+    def inventory(self) -> list[str] | None:
+        """Pending inventory diff to piggyback on the next control frame
+        (None off-fabric or when nothing new was published)."""
+        drain = getattr(self.cache, "drain_inventory", None)
+        if drain is None:
+            return None
+        return drain() or None
 
     def heartbeat(self, stage: str) -> None:
         """The ``OverlapStats.add`` hook: renew every lease this worker
         holds, rate-limited, NEVER raising — a beat that fails (partition,
         dying coordinator) simply lets the lease age toward a steal, which
-        is the correct outcome for both."""
+        is the correct outcome for both. Fabric heartbeats carry the
+        inventory diff; a failed beat requeues it (diffs are additive,
+        replay-safe)."""
         now = time.monotonic()
         if now - self._last_beat < self.heartbeat_s / 2.0:
             return
         self._last_beat = now
+        inv = self.inventory()
         try:
-            self.client.beat()
+            self.client.beat(inventory=inv)
         except Exception:
-            pass
+            if inv:
+                self.cache.requeue_inventory(inv)
 
     def scanner(self, src: str):
         from structured_light_for_3d_model_replication_tpu.pipeline import (
@@ -290,6 +371,13 @@ def run_worker(spec_path: str, log=print) -> int:
     # (trace journal, stalls, failures) — N workers share out_dir safely
     tel.set_host_tag(f"{worker}-{os.getpid()}")
     faults.configure_from(cfg.faults)
+    client = CoordClient(spec["port"], worker,
+                         cfg.coordinator.connect_timeout_s,
+                         connect=spec.get("connect", ""),
+                         secret=spec.get("secret", ""))
+    # connect BEFORE the tracer opens so the journal meta can advertise
+    # this worker's wire address (the `sl3d report` host column)
+    client.connect()
     tracer = prev_tr = None
     if cfg.observability.trace:
         tracer = tel.Tracer(
@@ -298,31 +386,51 @@ def run_worker(spec_path: str, log=print) -> int:
             run_id=tel.new_run_id(),
             meta={"tool": "worker", "host": tel.host_tag(),
                   "worker": worker, "pid": os.getpid(),
+                  "addr": client.addr or None,
                   "backend": cfg.parallel.backend,
                   "host_cpus": os.cpu_count()})
         prev_tr = tel.activate(tracer)
 
-    client = CoordClient(spec["port"], worker,
-                         cfg.coordinator.connect_timeout_s)
-    client.connect()
-    hello = client.hello(os.getpid())
+    # inventory bootstrap: a resumed fabric worker may already hold L1
+    # entries from a prior attempt — advertise them in the handshake
+    boot: list[str] = []
+    root = spec.get("cache_root")
+    if root and os.path.isdir(root):
+        boot = sorted(f[:-4] for f in os.listdir(root)
+                      if f.endswith(".npz"))
+    try:
+        hello = client.hello(os.getpid(), inventory=boot)
+    except PermissionError as e:
+        log(f"[worker {worker}] {e}")
+        if tracer is not None:
+            tel.deactivate(prev_tr)
+            tracer.close()
+        client.close()
+        return 1
     heartbeat_s = float(hello.get("heartbeat_s",
                                   cfg.coordinator.heartbeat_s))
-    ctx = _WorkerCtx(cfg, spec, client, heartbeat_s)
+    blob_endpoint = hello.get("blob") or spec.get("blob", "")
+    ctx = _WorkerCtx(cfg, spec, client, heartbeat_s,
+                     blob_endpoint=blob_endpoint)
     prev_hook = prof.set_heartbeat_hook(ctx.heartbeat)
     log(f"[worker {worker}] joined run {hello.get('run_id')} "
-        f"(pid {os.getpid()}, lease {hello.get('lease_s')}s)")
+        f"(pid {os.getpid()}, addr {client.addr or '?'}, "
+        f"lease {hello.get('lease_s')}s"
+        + (f", blob {blob_endpoint}" if blob_endpoint else "") + ")")
     rc = 0
     try:
         while True:
+            inv = ctx.inventory()
             try:
-                resp = client.next()
+                resp = client.next(inventory=inv)
             except (OSError, ConnectionError, ValueError):
+                if inv:
+                    ctx.cache.requeue_inventory(inv)
                 # coordinator gone mid-run: bounded reconnect, then give up
                 client.close()
                 try:
                     client.connect()
-                    client.hello(os.getpid())
+                    client.hello(os.getpid(), inventory=_full_inv(ctx))
                     continue
                 except Exception:
                     log(f"[worker {worker}] coordinator unreachable; "
@@ -384,6 +492,13 @@ def run_worker(spec_path: str, log=print) -> int:
     return rc
 
 
+def _full_inv(ctx: _WorkerCtx) -> list[str] | None:
+    """Full L1 inventory for a (re)handshake — the coordinator's index for
+    this worker may be gone (restart) or stale (lost diffs)."""
+    names = getattr(ctx.cache, "local_names", None)
+    return names() or None if names is not None else None
+
+
 def _partitioned(ctx: _WorkerCtx, e, kind: str, iid: str, gen: int,
                  ispec: dict, tracer, log) -> None:
     """net.partition semantics: coordination is cut for ``duration_s`` but
@@ -404,7 +519,7 @@ def _partitioned(ctx: _WorkerCtx, e, kind: str, iid: str, gen: int,
     except Exception as ie:
         err = ie
     ctx.client.connect()
-    ctx.client.hello(os.getpid())
+    ctx.client.hello(os.getpid(), inventory=_full_inv(ctx))
     if err is not None:
         ctx.client.failed(iid, gen, err)
         return

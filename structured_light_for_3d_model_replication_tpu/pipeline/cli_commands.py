@@ -580,6 +580,17 @@ def _cmd_pipeline(args) -> int:
               f"{c['workers']} worker(s), steals={c.get('steals', 0)}, "
               f"resumed={c.get('resumed_completed', 0)}; ledger -> "
               f"{c['ledger']}")
+        if c.get("listen"):
+            fb = c.get("fabric") or {}
+            print(f"[pipeline] fabric: listening on {c['listen']}; blob "
+                  f"fetches={fb.get('fetches', 0)} "
+                  f"pushes={fb.get('pushes', 0)} "
+                  f"dedups={fb.get('dedups', 0)} "
+                  f"({fb.get('bytes_fetched', 0)} B out / "
+                  f"{fb.get('bytes_pushed', 0)} B in / "
+                  f"{fb.get('bytes_deduped', 0)} B deduped); locality "
+                  f"hits={c.get('locality_hits', 0)} "
+                  f"misses={c.get('locality_misses', 0)}")
     if report.overlap:
         o = report.overlap
         clean = (f" + clean {o['clean_s']}s" if o.get("clean_s") else "")

@@ -127,6 +127,10 @@ class RunAnalysis:
     # the Pallas wrappers emit) + the h2d/d2h transfer-byte counters
     kernels: dict[str, dict] = field(default_factory=dict)
     transfer: dict[str, int] = field(default_factory=dict)
+    # pod-fabric blob traffic (from `fabric.bytes` instants): bytes this
+    # host fetched from / pushed to / deduped against the L2 blobstore —
+    # the artifact-side twin of the OverlapStats fabric counters
+    fabric: dict[str, int] = field(default_factory=dict)
     manifest: dict | None = None   # failures.json payload
     metrics: dict | None = None    # metrics.json payload
     # stall ledger: watchdog breaches seen in the journal, the last
@@ -218,6 +222,11 @@ def analyze_run(out_dir: str, trace_file: str = "trace.jsonl",
                     v = ev.get(k)
                     if v:
                         a.transfer[k] = a.transfer.get(k, 0) + int(v)
+            elif name == "fabric.bytes":
+                for k in ("fetched", "pushed", "deduped"):
+                    v = ev.get(k)
+                    if v:
+                        a.fabric[k] = a.fabric.get(k, 0) + int(v)
             elif name and name.startswith("kernel."):
                 kn = name[7:]
                 rec = a.kernels.setdefault(
@@ -293,6 +302,10 @@ def merge_host_timeline(out_dir: str,
         meta = j["meta"] or {}
         host = (meta.get("host") or meta.get("tool")
                 or os.path.basename(path))
+        # networked workers advertise the address they dialed from; show
+        # it in the host column so a pod run reads `w0 10.0.0.2:41234`
+        if meta.get("addr"):
+            host = f"{host} {meta['addr']}"
         t0 = float(meta.get("t0_unix", 0.0) or 0.0)
         for ev in j["events"]:
             row = dict(ev)
@@ -327,6 +340,17 @@ def render_host_timeline(rows: list[dict], limit: int = 60) -> str:
             if k in r)
         L.append(f"  +{r['t_unix'] - t_base:8.3f}s  {r['host']:<{wh}}  "
                  f"{what}" + (f"  {detail}" if detail else ""))
+    # pod-wide fabric total: the workers' journals carry the
+    # `fabric.bytes` instants (the coordinator's own journal has none),
+    # so the cross-host fold is where the blobstore traffic is summable —
+    # it must reconcile with the coordinator's blob-server counters
+    fabric = {k: sum(int(r.get(k) or 0) for r in rows
+                     if (r.get("ev") or r.get("type")) == "fabric.bytes")
+              for k in ("fetched", "pushed", "deduped")}
+    if any(fabric.values()):
+        L.append(f"  pod fabric total: {fabric['fetched']} B fetched / "
+                 f"{fabric['pushed']} B pushed / {fabric['deduped']} B "
+                 f"deduped over the blobstore wire")
     return "\n".join(L)
 
 
@@ -431,7 +455,7 @@ def render_report(a: RunAnalysis, width: int = 60) -> str:
                      f"{len(a.pair_launches)} register launch(es), mean "
                      f"{pairs / len(a.pair_launches):.1f}/launch")
 
-    if a.kernels or a.transfer:
+    if a.kernels or a.transfer or a.fabric:
         L.append("")
         L.append("kernel table")
         for kn in sorted(a.kernels):
@@ -459,6 +483,11 @@ def render_report(a: RunAnalysis, width: int = 60) -> str:
             L.append(f"  transfers      {a.transfer.get('h2d', 0)} B h2d "
                      f"({fr} B frame uploads) / "
                      f"{a.transfer.get('d2h', 0)} B d2h" + packed)
+        if a.fabric:
+            L.append(f"  fabric         {a.fabric.get('fetched', 0)} B "
+                     f"fetched / {a.fabric.get('pushed', 0)} B pushed / "
+                     f"{a.fabric.get('deduped', 0)} B deduped over the "
+                     f"blobstore wire")
 
     if (a.retries or a.failures or a.injected or a.quarantined
             or (a.manifest and a.manifest.get("failures"))):

@@ -1922,13 +1922,16 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     The recorder closes (and persists metrics) even on a crash/interrupt.
     """
     cfg = cfg or Config()
-    if cfg.coordinator.workers > 0:
+    if cfg.coordinator.workers > 0 or cfg.coordinator.listen:
         # host-fault-domain mode: the coordinator leases view/pair items
         # to N worker processes (each a crash domain), then re-enters this
         # function with workers=0 as the assembly pass over the warmed
         # stage cache — so coordinated output is byte-identical to a
-        # single-process run by construction. Lazy import: coordinator
-        # imports stages for the item programs.
+        # single-process run by construction. A non-empty
+        # coordinator.listen enters the same mode with zero spawned
+        # workers: the coordinator serves its real TCP endpoint and waits
+        # for external `sl3d worker` joins (the pod-fabric path). Lazy
+        # import: coordinator imports stages for the item programs.
         from structured_light_for_3d_model_replication_tpu.parallel import (
             coordinator as _coord,
         )

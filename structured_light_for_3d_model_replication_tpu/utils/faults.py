@@ -43,6 +43,17 @@ supplies the two halves of making that chain resilient:
                          pipeline/serving.py)
    ``election.acquire``  HA leader-lease acquire attempt (item is the
                          member's owner id; parallel/election.py)
+   ``blob.fetch``        fabric L2 blob fetch (item is the blob name;
+                         transient absorbs into one retry, anything else
+                         degrades to a cache miss; pipeline/blobstore.py)
+   ``blob.push``         fabric L2 blob publish (best-effort: a failed
+                         push leaves the payload in L1 only;
+                         pipeline/blobstore.py)
+   ``worker.sock``       every control frame on a worker's coordinator /
+                         blobstore socket (item is ``"coord:<op>"`` or
+                         ``"blob:<op>"``); the ``net.slowlink(T)`` kind
+                         lands here to delay frames on the wire
+                         (parallel/worker.py, pipeline/blobstore.py)
    ``election.renew``    HA leader-lease renew — a ``stall(T)`` here with
                          T past the lease is how a ZOMBIE leader is
                          manufactured: the lease expires mid-stall, a
@@ -62,6 +73,7 @@ Fault-spec grammar (comma-separated rules)::
 
     kind     transient | permanent | crash | stall[(T)] | slow[(T)]
              | worker.kill | worker.preempt[(T)] | net.partition[(T)]
+             | net.slowlink[(T)]
     ~substr  only fire() calls whose item contains substr count as hits
     @n       arm on the n-th matching hit (1-based; default 1)
     xM       fire at most M times (default: unlimited for permanent,
@@ -112,6 +124,13 @@ multi-process run (parallel/coordinator.py):
                          reconnecting — long enough partitions expire the
                          worker's leases and exercise steal + the
                          stolen-item late-complete path
+  ``net.slowlink(T)``    the degraded-but-alive link: like ``slow`` it
+                         never raises, it just blocks the firing site for
+                         T seconds (default ``SLOWLINK_DEFAULT_S``) and
+                         returns. Aimed at the per-frame socket sites
+                         (``worker.sock``) so every control frame on a
+                         worker's wire straggles — heartbeats still land,
+                         leases stay alive, throughput just sags
 """
 from __future__ import annotations
 
@@ -134,7 +153,7 @@ __all__ = [
     "FaultRule", "FaultPlan", "configure", "configure_from", "reset", "fire",
     "active_plan", "is_transient", "RetryPolicy", "retry_call", "annotate",
     "jitter_rng", "FailureRecord", "STALL_DEFAULT_S", "SLOW_DEFAULT_S",
-    "PREEMPT_GRACE_DEFAULT_S", "PARTITION_DEFAULT_S",
+    "PREEMPT_GRACE_DEFAULT_S", "PARTITION_DEFAULT_S", "SLOWLINK_DEFAULT_S",
 ]
 
 
@@ -197,12 +216,14 @@ class NetPartition(TransientFault):
 # ---------------------------------------------------------------------------
 
 _KINDS = ("transient", "permanent", "crash", "stall", "slow",
-          "worker.kill", "worker.preempt", "net.partition")
+          "worker.kill", "worker.preempt", "net.partition",
+          "net.slowlink")
 
 # the kinds that accept a ``(T)`` duration, and what T means for each:
-# stall/slow block for T; worker.preempt grants T of grace before the
-# forced exit; net.partition keeps the link dark for T
-_DURATION_KINDS = ("stall", "slow", "worker.preempt", "net.partition")
+# stall/slow/net.slowlink block for T; worker.preempt grants T of grace
+# before the forced exit; net.partition keeps the link dark for T
+_DURATION_KINDS = ("stall", "slow", "worker.preempt", "net.partition",
+                   "net.slowlink")
 
 # default block durations for the non-raising kinds when no ``(T)`` is
 # given. Long enough to trip production-default lane deadlines / the
@@ -211,6 +232,7 @@ STALL_DEFAULT_S = 30.0
 SLOW_DEFAULT_S = 1.0
 PREEMPT_GRACE_DEFAULT_S = 0.5
 PARTITION_DEFAULT_S = 1.0
+SLOWLINK_DEFAULT_S = 0.25   # per-frame delay: visible, never lease-fatal
 
 
 @dataclass
@@ -266,6 +288,7 @@ class FaultRule:
         return {"stall": STALL_DEFAULT_S,
                 "worker.preempt": PREEMPT_GRACE_DEFAULT_S,
                 "net.partition": PARTITION_DEFAULT_S,
+                "net.slowlink": SLOWLINK_DEFAULT_S,
                 }.get(self.kind, SLOW_DEFAULT_S)
 
     def throw(self) -> None:
@@ -334,7 +357,7 @@ class FaultPlan:
                        duration_s=(hit.block_s
                                    if hit.kind in _DURATION_KINDS
                                    else None))
-        if hit.kind in ("stall", "slow"):
+        if hit.kind in ("stall", "slow", "net.slowlink"):
             # block, then RESUME normally (a wedge that eventually
             # resolves); cancel-aware so a watchdog hard breach raises
             # deadline.Cancelled out of the sleep and the item is

@@ -234,6 +234,12 @@ class OverlapStats:
         # what the frame uploads would have cost unpacked (raw u8 stacks);
         # equals _frame_bytes when the ingest is raw, ~8x it when packed
         self._frame_raw_bytes = 0
+        # pod-fabric blob traffic (pipeline/blobstore.py): L2 fetches
+        # that saved a recompute, write-through pushes, and pushes the
+        # store already held (dedup). All zero off-fabric
+        self._fabric_fetched = 0
+        self._fabric_pushed = 0
+        self._fabric_deduped = 0
         # per-kernel launch accounting: name -> [launches, wall_s, bytes]
         self._kernels: dict[str, list] = {}
         self.critical_path_s = 0.0
@@ -351,6 +357,24 @@ class OverlapStats:
                            ratio=round(fr_raw / fr, 3),
                            wire=fr, raw=fr_raw)
 
+    def add_fabric(self, fetched: int = 0, pushed: int = 0,
+                   deduped: int = 0) -> None:
+        """Accumulate pod-fabric blob bytes: ``fetched`` (L2 hit promoted
+        into L1), ``pushed`` (write-through publish that L2 accepted), and
+        ``deduped`` (push the store already held — bytes that crossed the
+        wire only to be recognized). The journal instant is emitted from
+        this same call, so ``sl3d report``'s fabric line cross-checks
+        these counters by construction."""
+        f, p, d = int(fetched), int(pushed), int(deduped)
+        with self._lock:
+            self._fabric_fetched += f
+            self._fabric_pushed += p
+            self._fabric_deduped += d
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant("fabric.bytes", fetched=f or None, pushed=p or None,
+                       deduped=d or None)
+
     def add_kernel(self, name: str, wall_s: float, bucket=None,
                    bytes_moved: int = 0) -> None:
         """Record one kernel-lane launch (``fused_view``, ``knn_mean``,
@@ -429,6 +453,9 @@ class OverlapStats:
         out["frame_bytes_ratio"] = (
             round(self._frame_raw_bytes / self._frame_bytes, 2)
             if self._frame_bytes else None)
+        out["fabric_bytes_fetched"] = self._fabric_fetched
+        out["fabric_bytes_pushed"] = self._fabric_pushed
+        out["fabric_bytes_deduped"] = self._fabric_deduped
         out["kernels"] = {
             name: {"launches": agg[0], "wall_s": round(agg[1], 4),
                    "bytes_moved": agg[2]}

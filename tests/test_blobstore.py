@@ -1,0 +1,333 @@
+"""Pod-fabric blob service (pipeline/blobstore.py) + endpoint grammar
+(parallel/netutil.py).
+
+Contract under test (ISSUE 15): payloads move by content-addressed name
+with the transfer digest verified on BOTH ends, so a corrupt or torn blob
+is always a *miss* — never a wrong answer; the FabricCache is a
+write-through two-level cache (local StageCache L1, blob fabric L2) whose
+promotion path re-verifies through the normal ``__digest__`` machinery;
+and the inventory diff protocol is additive and replay-safe.
+"""
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.parallel import netutil
+from structured_light_for_3d_model_replication_tpu.pipeline.blobstore import (
+    BlobClient,
+    BlobServer,
+    FabricCache,
+)
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def server(tmp_path):
+    srv = BlobServer(str(tmp_path / "l2"), port=0)
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def client(server):
+    c = BlobClient(server.endpoint, connect_timeout_s=5.0, io_timeout_s=5.0)
+    yield c
+    c.close()
+
+
+def _arrays(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"points": rng.normal(size=(40, 3)).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# netutil: one endpoint grammar for coordinator, worker, and blobstore
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("text,want", [
+    ("10.0.0.2:5555", ("10.0.0.2", 5555)),
+    ("scanhost:9", ("scanhost", 9)),
+    ("[::1]:5555", ("::1", 5555)),          # IPv6 literals must not break
+    ("[fe80::1%eth0]:80", ("fe80::1%eth0", 80)),
+    (":5555", ("127.0.0.1", 5555)),
+    ("5555", ("127.0.0.1", 5555)),
+    ("scanhost", ("scanhost", 0)),
+    ("", ("127.0.0.1", 0)),
+])
+def test_parse_endpoint(text, want):
+    assert netutil.parse_endpoint(text) == want
+
+
+@pytest.mark.parametrize("text", [
+    "::1",              # unbracketed IPv6 is ambiguous — rejected, not guessed
+    "[::1",             # unclosed bracket
+    "[::1]extra",
+    "host:notaport",
+    "host:70000",
+    "host:-1",
+])
+def test_parse_endpoint_rejects(text):
+    with pytest.raises(ValueError):
+        netutil.parse_endpoint(text)
+
+
+def test_format_endpoint_rebrackets_ipv6():
+    assert netutil.format_endpoint("10.0.0.2", 5555) == "10.0.0.2:5555"
+    assert netutil.format_endpoint("::1", 5555) == "[::1]:5555"
+    # round trip: format -> parse is identity
+    assert netutil.parse_endpoint(netutil.format_endpoint("::1", 9)) \
+        == ("::1", 9)
+
+
+def test_parse_endpoint_defaults_flow_through():
+    assert netutil.parse_endpoint("", default_host="0.0.0.0",
+                                  default_port=7) == ("0.0.0.0", 7)
+    assert netutil.parse_endpoint(":9", default_host="0.0.0.0") \
+        == ("0.0.0.0", 9)
+
+
+# ---------------------------------------------------------------------------
+# BlobServer + BlobClient: the wire
+# ---------------------------------------------------------------------------
+
+def test_push_fetch_roundtrip(server, client):
+    data = os.urandom(4096)
+    assert client.push("view-aaaa1111bbbb2222", data) == "pushed"
+    assert client.fetch("view-aaaa1111bbbb2222") == data
+    c = server.counters()
+    assert c["pushes"] == 1 and c["fetches"] == 1
+    assert c["bytes_pushed"] == c["bytes_fetched"] == 4096
+    assert server.names() == ["view-aaaa1111bbbb2222"]
+
+
+def test_fetch_absent_is_a_miss(server, client):
+    assert client.fetch("view-0000000000000000") is None
+    c = server.counters()
+    assert c["fetches"] == 0 and c["misses"] == 1
+
+
+def test_repeat_push_dedups(server, client):
+    data = b"x" * 1000
+    assert client.push("view-dead", data) == "pushed"
+    assert client.push("view-dead", data) == "deduped"
+    c = server.counters()
+    assert c["pushes"] == 1 and c["dedups"] == 1
+    assert c["bytes_deduped"] == 1000
+
+
+def test_corrupt_server_blob_is_a_client_miss(server, client):
+    """Bit rot in the L2 store cannot cross the wire: the transfer digest
+    is computed over the CURRENT file bytes, and the npz-level promotion
+    check is the second fence — here we corrupt between push and fetch, so
+    the served bytes self-describe as valid but fail the caller's digest
+    comparison path via FabricCache (below). At the raw client level, a
+    torn read (size lies) is the detectable case."""
+    data = os.urandom(512)
+    assert client.push("view-feed", data) == "pushed"
+    path = os.path.join(server.root, "view-feed.npz")
+    with open(path, "wb") as f:
+        f.write(data[:100])     # torn file: header size/sha now match the
+    got = client.fetch("view-feed")     # TORN bytes — wire is consistent
+    assert got == data[:100] or got is None
+    # ...which is exactly why FabricCache re-verifies through StageCache
+
+
+def test_bad_names_never_touch_the_store(server, client, tmp_path):
+    secret_file = tmp_path / "l2" / ".." / "escape.npz"
+    assert client.fetch("../escape") is None
+    assert client.push("../escape", b"x") is None
+    assert client.push("a/b", b"x") is None
+    assert client.push("", b"x") is None
+    assert not os.path.exists(str(secret_file))
+    assert server.names() == []
+
+
+def test_torn_push_is_rejected_never_published(server):
+    """A push whose body does not match its announced sha256 must NOT
+    publish — the server-side half of 'verified on both ends'."""
+    import json
+    with socket.create_connection((server.host, server.port)) as s:
+        f = s.makefile("rwb")
+        body = b"corrupted-in-flight"
+        hdr = {"op": "put", "name": "view-beef", "size": len(body),
+               "sha256": "0" * 64}
+        f.write((json.dumps(hdr) + "\n").encode() + body)
+        f.flush()
+        rep = json.loads(f.readline())
+    assert "error" in rep
+    assert server.names() == []
+    assert server.counters()["rejects"] == 1
+
+
+def test_shared_secret_gates_the_blobstore(tmp_path):
+    srv = BlobServer(str(tmp_path / "l2"), port=0, secret="scan-pod-1")
+    try:
+        # wrong secret: hello rejected, every call degrades to a miss
+        bad = BlobClient(srv.endpoint, secret="nope", connect_timeout_s=5.0)
+        assert bad.push("view-aaaa", b"data") is None
+        assert bad.fetch("view-aaaa") is None
+        bad.close()
+        # no hello at all: first op answers unauthorized
+        anon = BlobClient(srv.endpoint, connect_timeout_s=5.0)
+        assert anon.fetch("view-aaaa") is None
+        anon.close()
+        good = BlobClient(srv.endpoint, secret="scan-pod-1",
+                          connect_timeout_s=5.0)
+        assert good.push("view-aaaa", b"data") == "pushed"
+        assert good.fetch("view-aaaa") == b"data"
+        good.close()
+    finally:
+        srv.close()
+
+
+def test_client_survives_server_restart(tmp_path):
+    """One silent reconnect per call: a bounced blobstore (coordinator
+    failover) costs at most one retried transfer, not a failed item."""
+    srv = BlobServer(str(tmp_path / "l2"), port=0)
+    cli = BlobClient(srv.endpoint, connect_timeout_s=5.0, io_timeout_s=5.0)
+    assert cli.push("view-aaaa", b"one") == "pushed"
+    host, port = srv.host, srv.port
+    srv.close()
+    srv = BlobServer(str(tmp_path / "l2"), host=host, port=port)
+    try:
+        assert cli.fetch("view-aaaa") == b"one"
+    finally:
+        cli.close()
+        srv.close()
+
+
+def test_transient_fault_absorbs_into_retry(server, client):
+    faults.configure("blob.fetch:transient@1")
+    assert client.push("view-aaaa", b"data") == "pushed"
+    assert client.fetch("view-aaaa") == b"data"   # attempt 2 wins
+
+
+def test_permanent_fault_degrades_to_miss(server, client):
+    assert client.push("view-aaaa", b"data") == "pushed"
+    faults.configure("blob.fetch:permanent")
+    assert client.fetch("view-aaaa") is None      # miss, NOT an exception
+    faults.reset()
+    faults.configure("blob.push:permanent")
+    assert client.push("view-bbbb", b"data") is None
+
+
+def test_unreachable_endpoint_times_out_to_miss():
+    cli = BlobClient("127.0.0.1:1", connect_timeout_s=0.3, io_timeout_s=0.3)
+    assert cli.fetch("view-aaaa") is None
+    assert cli.push("view-aaaa", b"x") is None
+    cli.close()
+
+
+# ---------------------------------------------------------------------------
+# FabricCache: L1/L2 semantics
+# ---------------------------------------------------------------------------
+
+def test_l2_promotion_into_l1(tmp_path, server):
+    """Producer pushes through its cache; a consumer with a COLD private
+    L1 misses locally, fetches by digest, promotes, and the second read is
+    a pure L1 hit (no second fetch)."""
+    prod_cli = BlobClient(server.endpoint, connect_timeout_s=5.0)
+    producer = FabricCache(str(tmp_path / "w0"), prod_cli)
+    key = producer.key("view", config_json="{}")
+    producer.put("view", key, **_arrays())
+    assert server.counters()["pushes"] == 1
+
+    cons_cli = BlobClient(server.endpoint, connect_timeout_s=5.0)
+    consumer = FabricCache(str(tmp_path / "w1"), cons_cli)
+    out = consumer.get("view", key)
+    np.testing.assert_array_equal(out["points"], _arrays()["points"])
+    assert server.counters()["fetches"] == 1
+    assert os.path.exists(consumer._path("view", key))   # promoted to L1
+    consumer.get("view", key)
+    assert server.counters()["fetches"] == 1             # L1 hit, no refetch
+    prod_cli.close()
+    cons_cli.close()
+
+
+def test_put_is_write_through(tmp_path, server, client):
+    cache = FabricCache(str(tmp_path / "w0"), client)
+    key = cache.key("view", config_json="{}")
+    cache.put("view", key, **_arrays())
+    assert cache.get("view", key) is not None            # L1
+    assert server.names() == [f"view-{key[:16]}"]        # and L2
+
+
+def test_corrupt_l2_blob_is_an_evicted_miss(tmp_path, server, client):
+    """The second fence: a blob that is wire-consistent but npz-corrupt
+    (rot BEFORE the push digest was computed) promotes into L1, fails the
+    normal ``__digest__`` verification, evicts, and reads as a miss —
+    never a wrong answer."""
+    cache = FabricCache(str(tmp_path / "w0"), client)
+    key = cache.key("view", config_json="{}")
+    name = f"view-{key[:16]}"
+    # plant a corrupt-but-complete blob in L2 under the right name
+    good = FabricCache(str(tmp_path / "scratch"), None)
+    good.put("view", key, **_arrays())
+    blob = bytearray(open(good._path("view", key), "rb").read())
+    mid = len(blob) // 2
+    for i in range(mid, mid + 16):
+        blob[i] ^= 0xFF
+    assert client.push(name, bytes(blob)) == "pushed"    # wire sha matches
+    assert cache.get("view", key) is None                # promoted, failed
+    assert not os.path.exists(cache._path("view", key))  # verify, evicted
+    assert cache.stats()["evicted"] == 1
+
+
+def test_fabric_cache_without_client_is_plain_l1(tmp_path):
+    cache = FabricCache(str(tmp_path / "w0"), None)
+    key = cache.key("view", config_json="{}")
+    assert cache.get("view", key) is None
+    cache.put("view", key, **_arrays())
+    assert cache.get("view", key) is not None
+    assert cache.drain_inventory() == [f"view-{key[:16]}"]
+
+
+# ---------------------------------------------------------------------------
+# inventory protocol
+# ---------------------------------------------------------------------------
+
+def test_inventory_drains_once_and_requeues(tmp_path, server, client):
+    cache = FabricCache(str(tmp_path / "w0"), client)
+    k1 = cache.key("view", config_json='{"v": 1}')
+    k2 = cache.key("view", config_json='{"v": 2}')
+    cache.put("view", k1, **_arrays(1))
+    cache.put("view", k2, **_arrays(2))
+    diff = cache.drain_inventory()
+    assert diff == sorted([f"view-{k1[:16]}", f"view-{k2[:16]}"])
+    assert cache.drain_inventory() == []                 # drained exactly once
+    # the carrying heartbeat failed: requeue, next drain retries the diff
+    cache.requeue_inventory(diff)
+    assert cache.drain_inventory() == diff
+
+
+def test_promotion_joins_the_inventory(tmp_path, server):
+    """A FETCHED blob is inventory too — the worker now holds it locally,
+    so pair grants can prefer this host."""
+    prod = FabricCache(str(tmp_path / "w0"),
+                       BlobClient(server.endpoint, connect_timeout_s=5.0))
+    key = prod.key("view", config_json="{}")
+    prod.put("view", key, **_arrays())
+    cons = FabricCache(str(tmp_path / "w1"),
+                       BlobClient(server.endpoint, connect_timeout_s=5.0))
+    assert cons.drain_inventory() == []
+    assert cons.get("view", key) is not None
+    assert cons.drain_inventory() == [f"view-{key[:16]}"]
+
+
+def test_local_names_is_the_bootstrap_inventory(tmp_path):
+    cache = FabricCache(str(tmp_path / "w0"), None)
+    key = cache.key("view", config_json="{}")
+    cache.put("view", key, **_arrays())
+    cache.drain_inventory()
+    # a resumed worker re-announces EVERYTHING its L1 holds on hello
+    resumed = FabricCache(str(tmp_path / "w0"), None)
+    assert resumed.local_names() == [f"view-{key[:16]}"]
+    assert FabricCache(str(tmp_path / "empty"), None).local_names() == []

@@ -11,6 +11,7 @@ import pytest
 
 from structured_light_for_3d_model_replication_tpu.parallel.lease import (
     LeaseTable,
+    LocalityIndex,
 )
 
 
@@ -135,3 +136,81 @@ def test_steal_of_unleased_item_still_bumps(table, clock):
     g1 = table.steal("view:0")
     assert table.steal("view:0") == g1 + 1
     assert table.holder("view:0") is None
+
+
+# ---------------------------------------------------------------------------
+# LocalityIndex (ISSUE 15): inventory-aware grant ordering
+# ---------------------------------------------------------------------------
+
+PAIRS = [("pair:0-1", ("view-aaaa", "view-bbbb")),
+         ("pair:1-2", ("view-bbbb", "view-cccc")),
+         ("pair:2-3", ("view-cccc", "view-dddd"))]
+
+
+def test_locality_prefers_holder_of_both_pair_inputs():
+    idx = LocalityIndex()
+    idx.update("w1", ["view-bbbb", "view-cccc"])
+    i, hit = idx.choose("w1", PAIRS)
+    assert (i, hit) == (1, True)           # pair:1-2 — both inputs local
+    assert idx.counters() == {"locality_hits": 1, "locality_misses": 0}
+
+
+def test_locality_one_of_two_inputs_is_not_a_hit():
+    """Half-local pairs fall back to FIFO — fetching one endpoint over
+    the fabric costs the same wherever the pair runs."""
+    idx = LocalityIndex()
+    idx.update("w0", ["view-bbbb"])        # holds ONE input of pairs 0+1
+    i, hit = idx.choose("w0", PAIRS)
+    assert (i, hit) == (0, False)
+    assert idx.counters()["locality_misses"] == 1
+
+
+def test_locality_never_starves_a_cold_worker():
+    """An empty inventory (fresh join, wiped L1) gets the FIFO head —
+    locality reorders preference, it never withholds work."""
+    idx = LocalityIndex()
+    i, hit = idx.choose("cold", PAIRS)
+    assert (i, hit) == (0, False)
+    idx.update("warm", [n for _, needs in PAIRS for n in needs])
+    i, hit = idx.choose("cold", PAIRS)     # still FIFO for the cold host
+    assert (i, hit) == (0, False)
+
+
+def test_locality_view_items_do_not_count():
+    """View candidates carry needs=None: granting one is never a
+    locality decision, so the counters stay untouched."""
+    idx = LocalityIndex()
+    views = [("view:0", None), ("view:1", None)]
+    assert idx.choose("w0", views) == (0, False)
+    assert idx.choose("w0", []) == (0, False)
+    assert idx.counters() == {"locality_hits": 0, "locality_misses": 0}
+
+
+def test_locality_updates_are_additive_and_droppable():
+    idx = LocalityIndex()
+    idx.update("w0", ["view-aaaa"])
+    idx.update("w0", ["view-bbbb"])        # diff folds IN, not replaces
+    idx.update("w0", None)                 # empty diff is a no-op
+    assert idx.holds("w0", "view-aaaa") and idx.holds("w0", "view-bbbb")
+    assert idx.choose("w0", PAIRS) == (0, True)
+    idx.drop_worker("w0")                  # dead host: inventory gone
+    assert not idx.holds("w0", "view-aaaa")
+    assert idx.choose("w0", PAIRS) == (0, False)
+
+
+def test_locality_is_orthogonal_to_generations(table, clock):
+    """The locality index only picks WHICH item a worker takes; the
+    lease/generation machinery is untouched — a stolen pair regrants
+    through `choose` at its bumped generation exactly as before."""
+    idx = LocalityIndex()
+    idx.update("w1", ["view-aaaa", "view-bbbb"])
+    i, hit = idx.choose("w0", PAIRS)
+    g0 = table.grant(PAIRS[i][0], "w0").gen
+    clock.advance(11.0)
+    g1 = table.steal(PAIRS[i][0])
+    assert g1 == g0 + 1
+    i2, hit2 = idx.choose("w1", PAIRS)     # regrant prefers the holder
+    assert (i2, hit2) == (0, True)
+    assert table.grant(PAIRS[i2][0], "w1").gen == g1
+    assert not table.complete(PAIRS[i][0], "w0", g0)
+    assert table.complete(PAIRS[i2][0], "w1", g1)

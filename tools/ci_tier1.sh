@@ -129,14 +129,15 @@ else
 fi
 
 # ---- soak smoke: 3 seeded runs over a randomized fault matrix
-# (transient/permanent/crash/stall/slow mixes), 1 coordinated 2-worker
-# run from the host-scope kill matrix, and 1 serving kill->restart run
-# from the serve-scope matrix — every run must TERMINATE within budget
-# with a schema-valid trace journal (ISSUE 7), a replayable ledger
-# (ISSUE 9), and every accepted serve request recovered (ISSUE 13);
-# longer sweeps: python tools/soak.py --runs 20 ----
+# (transient/permanent/crash/stall/slow mixes), 2 coordinated 2-worker
+# runs from the host-scope kill matrix (the second one through the pod
+# fabric with an extra wire-scope rule, ISSUE 15), and 1 serving
+# kill->restart run from the serve-scope matrix — every run must
+# TERMINATE within budget with a schema-valid trace journal (ISSUE 7), a
+# replayable ledger (ISSUE 9), and every accepted serve request
+# recovered (ISSUE 13); longer sweeps: python tools/soak.py --runs 20 ----
 soak_rc=0
-soak=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/soak.py --runs 3 --views 4 --budget-s 150 --multiproc-runs 1 --serve-runs 1 2>&1) || soak_rc=$?
+soak=$(timeout -k 10 700 env JAX_PLATFORMS=cpu python tools/soak.py --runs 3 --views 4 --budget-s 150 --multiproc-runs 2 --serve-runs 1 2>&1) || soak_rc=$?
 echo "$soak" > tools/_ci/soak_smoke.log
 if [ $soak_rc -eq 0 ] && echo "$soak" | grep -q 'SOAK=ok'; then
   echo "$soak" | grep 'SOAK=ok'
@@ -156,6 +157,43 @@ if [ $mproc_rc -eq 0 ] && echo "$mproc" | grep -q 'MULTIPROC_SMOKE=ok'; then
   echo "$mproc" | grep 'MULTIPROC_SMOKE=ok'
 else
   echo "MULTIPROC_SMOKE=FAIL (rc=$mproc_rc; see tools/_ci/multiproc_smoke.log)"
+  [ $rc -eq 0 ] && rc=1
+fi
+
+# ---- fabric smoke: 2 workers joined over REAL TCP (coordinator.listen +
+# shared secret, private per-worker L1 caches against the coordinator's
+# blobstore L2) with a seeded worker.kill of w0 on its 3rd item plus a
+# transient blob.fetch fault — the STL must ship, PLY+STL must be
+# byte-identical to the single-process run, and the ledger must replay
+# with >= 1 steal (ISSUE 15's acceptance anchor) ----
+fabric_rc=0
+fabric=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fabric_smoke.py 2>&1) || fabric_rc=$?
+echo "$fabric" > tools/_ci/fabric_smoke.log
+if [ $fabric_rc -eq 0 ] && echo "$fabric" | grep -q 'FABRIC_SMOKE=ok'; then
+  echo "$fabric" | grep 'FABRIC_SMOKE=ok'
+else
+  echo "FABRIC_SMOKE=FAIL (rc=$fabric_rc; see tools/_ci/fabric_smoke.log)"
+  [ $rc -eq 0 ] && rc=1
+fi
+
+# ---- fabric bench: the pod-fabric cost/benefit ledger (bench_fabric) —
+# arm A/B certify the FabricCache hook adds <= 2% to a fabric-less run,
+# arm C certifies a cold 2-worker TCP pod stays byte-identical, arm D
+# (a warm resume over pre-seeded caches) certifies every pair grant is a
+# locality hit; wall-clock numbers land in tools/_ci/fabric_bench.json
+# for trend-watching but only ratios/parity gate (1-CPU CI box) ----
+fbench_rc=0
+fbench=$(timeout -k 10 900 env JAX_PLATFORMS=cpu python bench.py --fabric-only 2>/dev/null) || fbench_rc=$?
+echo "$fbench" > tools/_ci/fabric_bench.json
+if [ $fbench_rc -eq 0 ] \
+   && echo "$fbench" | grep -q '"parity_ply": true' \
+   && echo "$fbench" | grep -q '"parity_stl": true' \
+   && echo "$fbench" | grep -q '"parity_stl_resume": true' \
+   && echo "$fbench" | grep -q '"locality_hit_rate": 1.0' \
+   && echo "$fbench" | python -c "import json,sys; sys.exit(0 if json.load(sys.stdin).get('fabric_overhead', 9) <= 1.02 else 1)"; then
+  echo "BENCH_FABRIC=ok"
+else
+  echo "BENCH_FABRIC=FAIL (rc=$fbench_rc; see tools/_ci/fabric_bench.json)"
   [ $rc -eq 0 ] && rc=1
 fi
 

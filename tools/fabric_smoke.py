@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Pod-fabric smoke for CI (run by tools/ci_tier1.sh).
+
+Renders a 5-view synthetic turntable dataset and runs the same scan
+twice: once single-process (the trusted baseline) and once as a 2-worker
+pod over REAL TCP — ``coordinator.listen=127.0.0.1:0`` with a shared
+secret, each worker warming a PRIVATE L1 stage cache against the
+coordinator-hosted blobstore L2 — under seeded faults:
+``worker.item~w0:worker.kill@3`` (SIGKILL w0 on its third granted item,
+AFTER it pushed payloads the survivor must fetch over the fabric) plus
+``blob.fetch:transient@1`` (the survivor's first fetch hiccups and must
+absorb into one retry). Asserts ISSUE 15's acceptance anchor:
+
+  - both runs exit 0 — a killed networked worker costs only its
+    in-flight items, a transient blob fault costs one retry
+  - merged.ply and model.stl are BYTE-IDENTICAL across the two runs
+    (workers are cache-warmers publishing content-addressed payloads;
+    assembly is the proven single-process pipeline over the blobstore's
+    backing directory, so parity is by construction — this asserts it
+    held over real sockets)
+  - the ledger replays cleanly with >= 1 steal (the dead worker's lease)
+  - the fabric moved real bytes (pushes > 0) and the locality counters
+    are present in the coordinator summary
+
+Prints ``FABRIC_SMOKE=ok`` (exit 0) or ``FABRIC_SMOKE=FAIL (...)``
+(exit 1).
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# kill w0 on its THIRD granted item — by then it has pushed >= 2 payloads
+# the survivor can only reach through the blobstore (private L1 roots) —
+# and make the survivor's first blob fetch transiently fail. Spawned
+# worker processes inherit the env; the coordinator process never fires
+# worker.* or blob.* sites.
+FAULT_SPEC = "worker.item~w0:worker.kill@3,blob.fetch:transient@1"
+
+PIPE_OVERRIDES = (
+    ("parallel.backend", "numpy"),
+    ("decode.n_cols", 128), ("decode.n_rows", 64),
+    ("decode.thresh_mode", "manual"),
+    ("merge.voxel_size", 4.0),
+    ("merge.ransac_trials", 512),
+    ("merge.icp_iters", 10),
+    ("mesh.depth", 5),
+    ("mesh.density_trim_quantile", 0.0),
+)
+
+
+def fail(why: str) -> int:
+    print(f"FABRIC_SMOKE=FAIL ({why})")
+    return 1
+
+
+def _read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _cfg(workers: int = 0, listen: str = "", secret: str = ""):
+    from structured_light_for_3d_model_replication_tpu.config import Config
+
+    cfg = Config()
+    for dotted, val in PIPE_OVERRIDES:
+        section, key = dotted.split(".")
+        setattr(getattr(cfg, section), key, val)
+    cfg.coordinator.workers = workers
+    cfg.coordinator.listen = listen
+    cfg.coordinator.secret = secret
+    return cfg
+
+
+def main() -> int:
+    # the baseline run must be fault-free even if the CI env is dirty
+    os.environ.pop("SL3D_FAULTS", None)
+    os.environ["SL3D_FAULTS_SEED"] = "0"
+    from structured_light_for_3d_model_replication_tpu.cli import (
+        main as cli_main,
+    )
+    from structured_light_for_3d_model_replication_tpu.parallel.coordinator import (  # noqa: E501
+        Ledger,
+    )
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+    tmp = tempfile.mkdtemp(prefix="slfab_")
+    try:
+        root = os.path.join(tmp, "dataset")
+        out_sp = os.path.join(tmp, "out_single")
+        out_fab = os.path.join(tmp, "out_fabric")
+        rc = cli_main(["synth", root, "--views", "5",
+                       "--cam", "160x120", "--proj", "128x64"])
+        if rc != 0:
+            return fail(f"synth rc={rc}")
+        calib = os.path.join(root, "calib.mat")
+        steps = ("statistical",)
+
+        rep = stages.run_pipeline(calib, root, out_sp, cfg=_cfg(),
+                                  steps=steps, log=lambda m: None)
+        if rep.failed or rep.degraded:
+            return fail(f"single-process run degraded: {rep.failed}")
+
+        # fabric run: real TCP listen + shared secret; workers inherit
+        # the fault env (w0 killed on item 3, one transient blob fetch)
+        os.environ["SL3D_FAULTS"] = FAULT_SPEC
+        try:
+            rep2 = stages.run_pipeline(
+                calib, root, out_fab,
+                cfg=_cfg(workers=2, listen="127.0.0.1:0",
+                         secret="fabric-smoke"),
+                steps=steps, log=lambda m: None)
+        finally:
+            os.environ.pop("SL3D_FAULTS", None)
+        if rep2.failed or rep2.degraded:
+            return fail("fabric run degraded (a killed networked worker "
+                        "must cost only its in-flight items)")
+
+        for name in ("merged.ply", "model.stl"):
+            a, b = os.path.join(out_sp, name), os.path.join(out_fab, name)
+            if not os.path.exists(b):
+                return fail(f"{name} missing from fabric run")
+            if _read(a) != _read(b):
+                return fail(f"{name} differs from single-process run "
+                            f"({os.path.getsize(a)} vs "
+                            f"{os.path.getsize(b)} bytes)")
+
+        info = rep2.coordinator or {}
+        if not info.get("listen"):
+            return fail("coordinator summary carries no listen endpoint")
+        fb = info.get("fabric") or {}
+        if not fb.get("pushes"):
+            return fail(f"no blob pushes recorded ({fb}) — workers did "
+                        f"not publish over the fabric")
+        if "locality_hits" not in info or "locality_misses" not in info:
+            return fail("locality counters missing from the summary")
+        addrs = info.get("worker_addrs") or {}
+        if not any(addrs.values()):
+            return fail(f"no worker advertised an address ({addrs})")
+
+        ledger_path = os.path.join(out_fab, "ledger.jsonl")
+        if not os.path.exists(ledger_path):
+            return fail("ledger.jsonl missing from fabric run")
+        replay = Ledger.replay(ledger_path)
+        steals = 0
+        with open(ledger_path) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("type") == "steal":
+                    steals += 1
+        if steals < 1:
+            return fail("no steal event journaled for the killed worker")
+        print(f"FABRIC_SMOKE=ok (2 TCP workers on {info['listen']}, "
+              f"1 killed; {len(replay['completed'])} item(s) complete, "
+              f"{steals} steal(s); fabric {fb.get('bytes_pushed', 0)} B "
+              f"pushed / {fb.get('bytes_fetched', 0)} B fetched, locality "
+              f"{info['locality_hits']}h/{info['locality_misses']}m; "
+              f"PLY+STL byte-identical to single-process)")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,11 @@ schema-validate so a stalled run is always diagnosable from artifacts.
 coordinated runs under seeded ``worker.kill`` / ``worker.preempt(T)`` /
 ``net.partition(T)`` rules. The same never-hang contract applies, plus
 the work ledger must replay and every per-host journal must validate.
+Every other multiproc run additionally goes through the pod fabric
+(ISSUE 15) — real-TCP control plane + blobstore L2 — with one extra rule
+drawn against the wire itself (``blob.fetch``/``blob.push`` transients,
+``net.slowlink``); the fabric must degrade to retries and cache misses,
+never to a hung or failed run.
 
 ``--serve-runs`` (ISSUE 13) appends a serving kill->restart matrix:
 each run drives a ScanService under a seeded serve-scope rule
@@ -69,6 +74,14 @@ KINDS = ["transient", "permanent", "crash", "stall(0.8)", "slow(0.3)"]
 HOST_KINDS = ["worker.kill", "worker.preempt(0.3)", "net.partition(0.8)"]
 HOST_MATCH = ["", "w0", "w1"]
 
+# pod-fabric wire matrix (ISSUE 15): every other multiproc run listens on
+# real TCP (127.0.0.1:0 + shared secret) and draws one extra rule against
+# the fabric itself — a flaky blob fetch/push (must degrade to a retry or
+# a cache miss, never a failed item) or a straggling socket
+# (net.slowlink: frames arrive late but intact, throughput sags only)
+FABRIC_RULES = ["blob.fetch:transient", "blob.push:transient",
+                "worker.sock:net.slowlink(0.2)x20"]
+
 # serving-scope kill matrix (ISSUE 13): crash the in-process service at
 # each durability boundary (grant journaled / bytes cached / assembly
 # started) or on the journal append itself; a transient on the append
@@ -105,12 +118,14 @@ def _spec_for(rng: random.Random, view_names: list[str]) -> str:
     return ",".join(rules)
 
 
-def _host_spec_for(rng: random.Random) -> str:
+def _host_spec_for(rng: random.Random, fabric: bool = False) -> str:
     rules = []
     for _ in range(rng.randint(1, 2)):
         kind = rng.choice(HOST_KINDS)
         match = rng.choice(HOST_MATCH)
         rules.append(f"worker.item{'~' + match if match else ''}:{kind}")
+    if fabric:
+        rules.append(rng.choice(FABRIC_RULES))
     return ",".join(rules)
 
 
@@ -262,7 +277,11 @@ def main() -> int:
         # terminate within budget with a replayable ledger, schema-valid
         # journals, and (on abort) a failure manifest.
         for i in range(args.multiproc_runs):
-            spec = _host_spec_for(rng)
+            # every other run is a pod-fabric run: real TCP control plane
+            # + blobstore L2, with one extra rule drawn against the wire
+            # itself (ISSUE 15) — the never-hang contract is identical
+            fabric = bool(i % 2)
+            spec = _host_spec_for(rng, fabric=fabric)
             out = os.path.join(tmp, f"out_mp_{i:03d}")
             mpcfg = cfg()
             mpcfg.coordinator.workers = 2
@@ -271,6 +290,9 @@ def main() -> int:
             # complete is journaled and the cache entry stays warm)
             mpcfg.coordinator.lease_s = 6.0
             mpcfg.coordinator.heartbeat_s = 0.5
+            if fabric:
+                mpcfg.coordinator.listen = "127.0.0.1:0"
+                mpcfg.coordinator.secret = "soak-pod"
             os.environ["SL3D_FAULTS"] = spec
             os.environ["SL3D_FAULTS_SEED"] = str(args.seed + 1000 + i)
             t0 = time.monotonic()
@@ -315,7 +337,9 @@ def main() -> int:
                                 f"{os.path.basename(journal)} invalid: "
                                 f"{errors[:3]}")
             outcomes[f"mp-{outcome}"] = outcomes.get(f"mp-{outcome}", 0) + 1
-            print(f"[soak] mp run {i}: {outcome:<9} {wall:5.1f}s  [{spec}]")
+            tag = " +fabric" if fabric else ""
+            print(f"[soak] mp run {i}: {outcome:<9} {wall:5.1f}s  "
+                  f"[{spec}]{tag}")
 
         # ---- serving kill->restart matrix (ISSUE 13): an in-process
         # ScanService under a seeded serve-scope rule. Generation 1 runs
