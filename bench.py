@@ -1403,6 +1403,168 @@ def bench_multiproc(views: int = PIPE_VIEWS) -> dict:
     return out
 
 
+def bench_assembly(view_counts=(4, 8), reps: int = 2) -> dict:
+    """Incremental-assembly tail certification (ISSUE 17).
+
+    For each view count: one single-process run (the parity anchor), then
+    ``reps`` interleaved pairs of 2-worker pods — ``merge.incremental``
+    ON (the fold lane) and OFF (the barrier arm) — over the same rendered
+    dataset, best-of-``reps`` walls. Certifies:
+
+      - PLY+STL byte parity: incremental ≡ barrier ≡ single-process at
+        every view count
+      - the fold lane folded the whole chain before the last item
+        settled (``folded_views == views``)
+      - ``tail_sublinear``: the incremental assembly tail
+        (last-item-settled -> artifacts-on-disk) grows SLOWER than the
+        view count across the measured points — with every view and pair
+        pre-folded, the tail is the postprocess only (Poisson grid work,
+        bounded by mesh depth, not by the chain length), while the
+        barrier tail re-walks the whole chain
+      - ``disabled_overhead``: the pod wall with the knob ON over the
+        knob-OFF wall, <= 1.02x — the fold lane consumes completed
+        payloads off the critical path, so enabling it must ride free
+        (and the knob-off path is the pre-lane coordinator plus a None
+        check, so this one ratio bounds both directions). Measured at
+        the LARGEST view count: small regimes are dominated by fixed
+        pod spin-up (worker fork + per-process warmup), which swings
+        tens of percent rep-to-rep on a busy 1-CPU box; per-point
+        ratios are recorded alongside. Each view count runs one
+        untimed warmup pod first and alternates the arm order per rep
+        so neither arm systematically gets the warmer slot.
+    """
+    import shutil
+    import tempfile
+
+    from structured_light_for_3d_model_replication_tpu.config import Config
+    from structured_light_for_3d_model_replication_tpu.io import images as imio
+    from structured_light_for_3d_model_replication_tpu.io import matfile
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+    from structured_light_for_3d_model_replication_tpu.utils import (
+        synthetic as syn,
+    )
+
+    cam, proj = (160, 120), (128, 64)
+    out: dict = {"view_counts": list(view_counts), "reps": reps,
+                 "backend": "numpy", "workers": 2,
+                 "cam": list(cam), "proj": list(proj),
+                 "host_cpus": os.cpu_count()}
+
+    def cfg(incremental: bool) -> Config:
+        c = Config()
+        c.parallel.backend = "numpy"
+        c.decode.n_cols, c.decode.n_rows = proj
+        c.decode.thresh_mode = "manual"
+        c.merge.voxel_size = 4.0
+        c.merge.ransac_trials = 512
+        c.merge.icp_iters = 10
+        c.merge.incremental = incremental
+        c.mesh.depth = 5
+        c.mesh.density_trim_quantile = 0.0
+        c.coordinator.workers = 2
+        return c
+
+    steps = ("statistical",)
+    points: list[dict] = []
+    tmp = tempfile.mkdtemp(prefix="slbench_asm_")
+    try:
+        rig = syn.default_rig(cam_size=cam, proj_size=proj)
+        scene = syn.sphere_on_background()
+        obj, background = scene.objects
+        calib_path = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(calib_path, rig.calibration())
+        for views in view_counts:
+            root = os.path.join(tmp, f"scans{views}")
+            os.makedirs(root)
+            step = 360.0 / views
+            pivot = np.array([0.0, 0.0, 420.0])
+            for i, (R, t) in enumerate(syn.turntable_poses(views, step,
+                                                           pivot)):
+                frames, _ = syn.render_scene(
+                    rig, syn.Scene([obj.transformed(R, t), background]))
+                imio.save_stack(
+                    os.path.join(root,
+                                 f"scan_{int(round(i * step)):03d}deg_scan"),
+                    frames)
+
+            sp = os.path.join(tmp, f"sp{views}")
+            c0 = cfg(False)
+            c0.coordinator.workers = 0
+            rep_sp = stages.run_pipeline(calib_path, root, sp, cfg=c0,
+                                         steps=steps, log=lambda m: None)
+            assert not rep_sp.failed, rep_sp.failed
+
+            # untimed warmup pod: pays the one-off page-cache / fork /
+            # process-warmup freshness so neither timed arm eats it
+            stages.run_pipeline(calib_path, root,
+                                os.path.join(tmp, f"warm{views}"),
+                                cfg=cfg(False), steps=steps,
+                                log=lambda m: None)
+
+            pt: dict = {"views": views}
+            walls: dict[str, list] = {"incremental": [], "disabled": []}
+            tails: dict[str, list] = {"incremental": [], "disabled": []}
+            folded = 0
+            for r in range(reps):
+                arms = (("incremental", True), ("disabled", False))
+                for arm, inc in (arms if r % 2 == 0 else arms[::-1]):
+                    od = os.path.join(tmp, f"{arm}{views}_{r}")
+                    t0 = time.perf_counter()
+                    rep = stages.run_pipeline(calib_path, root, od,
+                                              cfg=cfg(inc), steps=steps,
+                                              log=lambda m: None)
+                    walls[arm].append(time.perf_counter() - t0)
+                    assert not rep.degraded
+                    info = (rep.coordinator or {}).get("assembly") or {}
+                    if info.get("tail_s") is not None:
+                        tails[arm].append(info["tail_s"])
+                    if inc:
+                        folded = (rep.coordinator or {}).get(
+                            "assembly_lane", {}).get("folded_views", 0)
+                    for name, key in (("merged.ply", "parity_ply"),
+                                      ("model.stl", "parity_stl")):
+                        with open(os.path.join(sp, name), "rb") as fa, \
+                                open(os.path.join(od, name), "rb") as fb:
+                            ok = fa.read() == fb.read()
+                        pt[key] = pt.get(key, True) and ok
+            pt["incremental_s"] = round(min(walls["incremental"]), 4)
+            pt["disabled_s"] = round(min(walls["disabled"]), 4)
+            pt["incremental_walls"] = [round(w, 4)
+                                       for w in walls["incremental"]]
+            pt["disabled_walls"] = [round(w, 4) for w in walls["disabled"]]
+            pt["tail_incremental_s"] = round(min(tails["incremental"]), 4)
+            pt["tail_disabled_s"] = round(min(tails["disabled"]), 4)
+            pt["folded_views"] = folded
+            pt["enabled_over_disabled"] = (
+                round(pt["incremental_s"] / pt["disabled_s"], 3)
+                if pt["disabled_s"] else None)
+            points.append(pt)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    out["points"] = points
+    # the contract ratio, from the largest regime (see docstring); the
+    # per-point ratios stay visible in points[]
+    out["disabled_overhead"] = points[-1]["enabled_over_disabled"]
+    out["disabled_overhead_views"] = points[-1]["views"]
+    if len(points) >= 2:
+        lo, hi = points[0], points[-1]
+        out["view_ratio"] = round(hi["views"] / lo["views"], 3)
+        out["tail_ratio"] = (
+            round(hi["tail_incremental_s"] / lo["tail_incremental_s"], 3)
+            if lo["tail_incremental_s"] else None)
+        out["tail_sublinear"] = (out["tail_ratio"] is not None
+                                 and out["tail_ratio"] < out["view_ratio"])
+        # the postprocess-only share: the incremental tail vs the barrier
+        # tail at the biggest regime (the barrier tail re-walks the chain)
+        out["tail_vs_disabled"] = (
+            round(hi["tail_incremental_s"] / hi["tail_disabled_s"], 3)
+            if hi["tail_disabled_s"] else None)
+    out["parity_ply"] = all(p.get("parity_ply") for p in points)
+    out["parity_stl"] = all(p.get("parity_stl") for p in points)
+    return out
+
+
 def bench_fabric(views: int = PIPE_VIEWS) -> dict:
     """Pod-fabric cost + locality payoff (ISSUE 15).
 
@@ -2679,6 +2841,36 @@ if __name__ == "__main__":
         except Exception as e:
             line["error"] = f"{type(e).__name__}: {e}"[:200]
         emit(line)
+        sys.exit(0)
+    if "--assembly-only" in sys.argv[1:]:
+        # standalone record of the incremental-assembly tail A/B
+        # (fold-lane pod vs barrier pod vs single-process, byte-parity
+        # checked at every view count): one JSON line on stdout, plus
+        # BENCH_ASSEMBLY_r01.json in the repo root (skipped with
+        # --no-record). The merge/assembly lane is jax, so pin to CPU
+        # unless the caller already chose a platform.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        counts, reps = (4, 8), 2
+        for a in sys.argv[1:]:
+            if a.startswith("--views="):
+                counts = tuple(int(v) for v in a.split("=")[1].split(","))
+            elif a.startswith("--reps="):
+                reps = int(a.split("=")[1])
+        line = {"metric": "assembly_tail_wall", "unit": "s",
+                "value": None, "error": None}
+        try:
+            line.update(bench_assembly(counts, reps))
+            line["value"] = line["points"][-1]["tail_incremental_s"]
+        except Exception as e:
+            line["error"] = f"{type(e).__name__}: {e}"[:200]
+        # emit first: it stamps run_id/host_cpus/device_count into the
+        # line, and the record must carry the same regime fields
+        emit(line)
+        if "--no-record" not in sys.argv[1:]:
+            with open(os.path.join(ROOT, "BENCH_ASSEMBLY_r01.json"),
+                      "w") as f:
+                json.dump(line, f, indent=2, sort_keys=True)
+                f.write("\n")
         sys.exit(0)
     if "--fabric-only" in sys.argv[1:]:
         # standalone record of the pod-fabric A/B (stock vs blobstore

@@ -116,22 +116,36 @@ class StageRecorder:
                 json.dump(self._events, f)
             os.replace(tmp, self._progress_path)
 
-    def merge_step(self, i: int, points, colors) -> None:
-        """``points``/``colors`` may be one array or a LIST of per-view
-        arrays (merge_360 passes lists so strided previews never force a
-        full-cloud copy)."""
+    def merge_step(self, i: int, points, colors, total=None) -> None:
+        """merge step_callback consumer. The contract hands over ONLY the
+        newly folded view's arrays plus the running point count (O(new
+        view) per step — the old full-list form was O(V) per step, O(V^2)
+        over a chain): the recorder keeps its own per-view accumulation for
+        the strided preview. ``i == 0`` seeds the base view without writing
+        a step artifact (matching the historical first artifact at step 1).
+        A LIST ``points``/``colors`` is still accepted as the legacy
+        full-state form (``total`` ignored)."""
         from structured_light_for_3d_model_replication_tpu.io import ply
 
         if isinstance(points, (list, tuple)):
-            total = sum(len(p) for p in points)
-            stride = max(1, total // self.max_points)
-            pts = np.concatenate([np.asarray(p)[::stride] for p in points])
-            cols = np.concatenate([np.asarray(c)[::stride] for c in colors])
+            views_p = [np.asarray(p) for p in points]
+            views_c = [np.asarray(c) for c in colors]
         else:
-            total = len(points)
-            stride = max(1, total // self.max_points)
-            pts = np.asarray(points)[::stride]
-            cols = np.asarray(colors)[::stride]
+            with self._lock:
+                if i == 0:
+                    self._merge_p, self._merge_c = [], []
+                elif not hasattr(self, "_merge_p"):
+                    self._merge_p, self._merge_c = [], []
+                self._merge_p.append(np.asarray(points))
+                self._merge_c.append(np.asarray(colors))
+                views_p, views_c = list(self._merge_p), list(self._merge_c)
+            if i == 0:
+                return
+        total = int(total) if total is not None \
+            else sum(len(p) for p in views_p)
+        stride = max(1, total // self.max_points)
+        pts = np.concatenate([p[::stride] for p in views_p])
+        cols = np.concatenate([c[::stride] for c in views_c])
         path = os.path.join(self.dir, f"merge_step_{i:02d}.ply")
         # atomic: the viewer may serve this file mid-merge
         ply.write_ply(path + ".tmp", pts, cols)

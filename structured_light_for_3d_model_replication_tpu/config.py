@@ -115,6 +115,12 @@ class MergeConfig:
     # at most log2(pair_batch)+1 programs compile per cloud bucket); with
     # >1 device the group dispatches through register_pairs_sharded
     pair_batch: int = 4
+    # incremental assembly (coordinated pods only): the coordinator folds
+    # completed views/pair transforms into running merged-cloud state as
+    # their blobs land, so the assembly pass after the last item settles is
+    # ≈ the postprocess tail. SCHEDULE knob like stream/pair_batch — never
+    # cache-key material; incremental ≡ barrier ≡ single-process bytes.
+    incremental: bool = False
 
 
 @dataclass

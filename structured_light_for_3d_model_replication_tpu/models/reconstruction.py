@@ -32,7 +32,7 @@ from structured_light_for_3d_model_replication_tpu.ops import (
 __all__ = ["merge_360", "merge_360_posegraph", "preprocess_for_registration",
            "chamfer_distance", "DeviceClouds", "compact_views_device",
            "stack_views_device", "prep_view", "prep_view_device",
-           "register_prep_pairs", "finalize_chain"]
+           "register_prep_pairs", "finalize_chain", "transform_views_batched"]
 
 
 @dataclass
@@ -635,11 +635,25 @@ def register_prep_pairs(pairs, pair_ids, cfg: MergeConfig, voxel: float,
 
 def finalize_chain(clouds, T_pairs, gfit_all, ifit_all, irmse_all,
                    cfg: MergeConfig | None = None, log=print,
-                   step_callback=None, mesh=None, timings: dict | None = None):
+                   step_callback=None, mesh=None, timings: dict | None = None,
+                   prefold=None):
     """Chain-accumulate per-pair transforms and run the final voxel/outlier
     postprocess — the barrier tail shared by merge_360's host path and the
     streaming pipeline. Given the same per-pair transforms it produces
-    byte-identical merged output, whichever schedule registered the pairs."""
+    byte-identical merged output, whichever schedule registered the pairs.
+
+    The accumulate apply runs as one ``transform_views_batched`` launch
+    (historically a per-view host loop); the chain matmul itself stays a
+    (cheap) host loop. ``step_callback(i, new_points, new_colors, total)``
+    receives only the newly folded view's arrays plus the running point
+    count — view 0 is emitted once as a seed call with ``i == 0``.
+
+    ``prefold``: optional incremental-assembly carry
+    (``pipeline.assembly.Prefold``, already VALIDATED against this run's
+    view order/digests/pair transforms): its folded prefix seeds
+    ``transforms``/``merged_p``/``merged_c`` and only the unfolded suffix
+    is chained + transformed here — identical arithmetic, so the merged
+    bytes are unchanged by how much was prefolded."""
     import time as _time
 
     cfg = cfg or MergeConfig()
@@ -648,8 +662,14 @@ def finalize_chain(clouds, T_pairs, gfit_all, ifit_all, irmse_all,
     transforms = [np.eye(4, dtype=np.float32)]
     merged_p = [np.asarray(clouds[0][0], np.float32)]
     merged_c = [np.asarray(clouds[0][1], np.uint8)]
+    start = 1
+    if prefold is not None and 2 <= len(prefold.transforms) <= n:
+        transforms = [np.asarray(t, np.float32) for t in prefold.transforms]
+        merged_p = [np.asarray(p, np.float32) for p in prefold.merged_p]
+        merged_c = [np.asarray(c, np.uint8) for c in prefold.merged_c]
+        start = len(transforms)
     t0 = _time.perf_counter()
-    t_accum = np.eye(4, dtype=np.float32)
+    t_accum = transforms[-1].copy()
     for i in range(1, n):
         gfit = float(gfit_all[i - 1])
         if gfit < 0.05:
@@ -659,16 +679,24 @@ def finalize_chain(clouds, T_pairs, gfit_all, ifit_all, irmse_all,
         log(f"[merge_360] view {i}: global fit {gfit:.3f} | "
             f"ICP fit {float(ifit_all[i - 1]):.3f} "
             f"rmse {float(irmse_all[i - 1]):.3f}")
+        if i < start:
+            continue  # folded incrementally before the last item settled
         t_accum = (t_accum @ np.asarray(T_pairs[i - 1],
                                         np.float32)).astype(np.float32)
         transforms.append(t_accum.copy())
-        cur_p_full = np.asarray(clouds[i][0], np.float32)
-        moved = cur_p_full @ t_accum[:3, :3].T + t_accum[:3, 3]
-        merged_p.append(moved.astype(np.float32))
-        merged_c.append(np.asarray(clouds[i][1], np.uint8))
+    moved = transform_views_batched(
+        [np.asarray(clouds[i][0], np.float32) for i in range(start, n)],
+        transforms[start:], mesh=mesh)
+    total = sum(len(p) for p in merged_p)
+    if step_callback is not None and start == 1:
+        step_callback(0, merged_p[0], merged_c[0], total)
+    for j, i in enumerate(range(start, n)):
+        merged_p.append(moved[j])
+        cols_i = np.asarray(clouds[i][1], np.uint8)
+        merged_c.append(cols_i)
+        total += len(moved[j])
         if step_callback is not None:
-            # per-view array LISTS, not a concatenated copy (O(V) per step)
-            step_callback(i, merged_p, merged_c)
+            step_callback(i, moved[j], cols_i, total)
     tm["accumulate_s"] = round(_time.perf_counter() - t0, 3)
     t0 = _time.perf_counter()
     points = np.concatenate(merged_p)
@@ -788,37 +816,23 @@ def merge_360(clouds, cfg: MergeConfig | None = None, log=print,
             f"rmse {float(irmse_all[i - 1]):.3f}")
         t_accum = (t_accum @ T_all[i - 1]).astype(np.float32)
         transforms.append(t_accum.copy())
-        if device_acc:
-            continue
-        cur_p_full = np.asarray(clouds[i][0], np.float32)
-        moved = cur_p_full @ t_accum[:3, :3].T + t_accum[:3, 3]
-        merged_p.append(moved.astype(np.float32))
-        merged_c.append(np.asarray(clouds[i][1], np.uint8))
-        if step_callback is not None:
-            # per-view array LISTS, not a concatenated copy: a callback that
-            # previews/strides (acquire.viewer.StageRecorder) stays O(V) per
-            # step instead of re-copying the whole merged cloud every step
-            step_callback(i, merged_p, merged_c)
-    if device_acc:
-        raw_p, raw_v = raw
-        Ts = jnp.asarray(np.stack(transforms))          # [V, 4, 4] tiny H2D
-        moved = _accumulate_views_jit(raw_p, Ts)        # one launch
-        points = moved.reshape(-1, 3)
-        valid_flat = raw_v.reshape(-1)
-        if dc is not None:
-            colors = dc.colors.reshape(-1, 3)           # already resident
-        else:
-            cols = np.zeros((n, raw_p.shape[1], 3), np.uint8)
-            for i, (_, c_full) in enumerate(clouds):
-                cols[i, :len(c_full)] = np.asarray(c_full, np.uint8)
-            colors = jnp.asarray(cols).reshape(-1, 3)
+    # past the host-list fallback above device_acc is always True — the
+    # resident accumulate is the only arm left
+    raw_p, raw_v = raw
+    Ts = jnp.asarray(np.stack(transforms))          # [V, 4, 4] tiny H2D
+    moved = _accumulate_views_jit(raw_p, Ts)        # one launch
+    points = moved.reshape(-1, 3)
+    valid_flat = raw_v.reshape(-1)
+    if dc is not None:
+        colors = dc.colors.reshape(-1, 3)           # already resident
+    else:
+        cols = np.zeros((n, raw_p.shape[1], 3), np.uint8)
+        for i, (_, c_full) in enumerate(clouds):
+            cols[i, :len(c_full)] = np.asarray(c_full, np.uint8)
+        colors = jnp.asarray(cols).reshape(-1, 3)
     tm["accumulate_s"] = round(_time.perf_counter() - t0, 3)
 
     t0 = _time.perf_counter()
-    if not device_acc:
-        points = np.concatenate(merged_p)
-        colors = np.concatenate(merged_c)
-        valid_flat = None
     points, colors = _postprocess_dispatch(points, colors, cfg, tm, mesh, log,
                                            valid=valid_flat)
     tm["postprocess_s"] = round(_time.perf_counter() - t0, 3)
@@ -831,6 +845,122 @@ def _accumulate_views_jit(raw_p, Ts):
     matmuls as one vmapped launch, reusing registration's transform_points
     (single source of truth for the HIGHEST-precision pin)."""
     return jax.vmap(reg.transform_points)(Ts, raw_p)
+
+
+def _transform_view_np(T, p):
+    """Numpy twin of one accumulate apply — the exact arithmetic of the
+    historical per-view host loop (f32 matmul + translate, f32 cast)."""
+    T = np.asarray(T, np.float32)
+    p = np.asarray(p, np.float32)
+    return (p @ T[:3, :3].T + T[:3, 3]).astype(np.float32)
+
+
+def _transform_views_bucket(n_views: int, n_dev: int = 1) -> int:
+    """View-axis bucket for the batched accumulate apply: next power of two
+    at or above ``n_views``, rounded up to a multiple of the device count so
+    the mesh arm shards evenly. Pure schedule — never cache-key material."""
+    b = 1
+    while b < max(n_views, 1):
+        b *= 2
+    d = max(int(n_dev), 1)
+    return -(-b // d) * d
+
+
+def _transform_views_local(Ts, P):
+    return jax.vmap(reg.transform_points)(Ts, P)
+
+
+_TRANSFORM_SHARDED: dict = {}
+
+
+def _transform_views_sharded(mesh, Ts, P):
+    """Shard the batched accumulate apply over ``mesh`` along the view axis
+    (register_pairs_sharded idiom): each device transforms its local views
+    with the same per-view program, so per-view bytes match the
+    single-device launch exactly. The jitted program is memoized per mesh —
+    the fold tail runs once per scan, and a fresh wrapper per call would
+    retrace every launch."""
+    key = (tuple(mesh.axis_names), tuple(d.id for d in mesh.devices.flat))
+    fn = _TRANSFORM_SHARDED.get(key)
+    if fn is None:
+        from jax.sharding import PartitionSpec
+
+        from structured_light_for_3d_model_replication_tpu.utils.jax_compat import (  # noqa: E501
+            shard_map_unchecked,
+        )
+
+        spec = PartitionSpec(tuple(mesh.axis_names))
+        fn = jax.jit(shard_map_unchecked(
+            mesh=mesh, in_specs=(spec, spec),
+            out_specs=spec)(_transform_views_local))
+        _TRANSFORM_SHARDED[key] = fn
+    return fn(Ts, P)
+
+
+_TRANSFORM_PARITY: bool | None = None
+
+
+def _transform_device_parity() -> bool:
+    """One-time per-process probe: the device-batched transform must
+    reproduce the numpy twin BYTE-identically on a tiny fixed input, or the
+    twin stays authoritative for this process (the merged cloud is
+    cache-pinned content — a backend whose fused matmul rounds differently
+    must not change cache bytes)."""
+    global _TRANSFORM_PARITY
+    if _TRANSFORM_PARITY is None:
+        rng = np.random.default_rng(7)
+        p = (rng.normal(size=(64, 3)) * 40).astype(np.float32)
+        q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+        T = np.eye(4, dtype=np.float32)
+        T[:3, :3] = q.astype(np.float32)
+        T[:3, 3] = (rng.normal(size=3) * 5).astype(np.float32)
+        try:
+            dev = np.asarray(_accumulate_views_jit(
+                jnp.asarray(p[None]), jnp.asarray(T[None])), np.float32)[0]
+            _TRANSFORM_PARITY = (dev.tobytes()
+                                 == _transform_view_np(T, p).tobytes())
+        except Exception:
+            _TRANSFORM_PARITY = False
+    return _TRANSFORM_PARITY
+
+
+def transform_views_batched(points_list, transforms, mesh=None,
+                            use_device=None):
+    """Apply per-view accumulated transforms as ONE bucket-padded device
+    batch (the accumulate loop's per-view host matmul+apply, replaced).
+
+    ``points_list``: per-view [Ni,3] f32 host arrays; ``transforms``: one
+    (4,4) f32 per view. Views zero-pad to a shared ``_bucket_pad`` slot
+    count and the view axis pads to ``_transform_views_bucket`` (duplicated
+    transforms, dropped on return) so repeat calls at a bucket hit the jit
+    cache. With ``mesh`` the launch shards over the view axis. Returns the
+    transformed per-view f32 arrays in input order — byte-identical to the
+    numpy twin (``_transform_device_parity`` gates the device arm; on probe
+    failure the twin runs). Bucketing is pure schedule, never cache-key
+    material."""
+    n = len(points_list)
+    if n == 0:
+        return []
+    if use_device is None:
+        use_device = n >= 2 and _transform_device_parity()
+    if not use_device:
+        return [_transform_view_np(T, p)
+                for T, p in zip(transforms, points_list)]
+    n_dev = (int(np.prod(list(mesh.shape.values())))
+             if mesh is not None else 1)
+    slots = _bucket_pad(max(len(p) for p in points_list))
+    vb = _transform_views_bucket(n, n_dev)
+    P = np.zeros((vb, slots, 3), np.float32)
+    for i, p in enumerate(points_list):
+        P[i, :len(p)] = np.asarray(p, np.float32)
+    Ts = np.stack([np.asarray(transforms[i % n], np.float32)
+                   for i in range(vb)])
+    if mesh is not None and n_dev > 1:
+        out = _transform_views_sharded(mesh, jnp.asarray(Ts), jnp.asarray(P))
+    else:
+        out = _accumulate_views_jit(jnp.asarray(P), jnp.asarray(Ts))
+    out = np.asarray(out, np.float32)
+    return [out[i, :len(points_list[i])] for i in range(n)]
 
 
 def _postprocess_dispatch(points, colors, cfg: MergeConfig, tm, mesh, log,
@@ -1011,14 +1141,17 @@ def merge_360_posegraph(clouds, cfg: MergeConfig | None = None, log=print,
         f"{float(res.residual_rmse[-1]):.4f} over {pg_iters} iters")
     transforms = [np.asarray(res.poses[i], np.float32) for i in range(n)]
 
-    merged_p, merged_c = [], []
-    for i, (p_full, c_full) in enumerate(clouds):
-        T = transforms[i]
-        moved = np.asarray(p_full, np.float32) @ T[:3, :3].T + T[:3, 3]
-        merged_p.append(moved.astype(np.float32))
-        merged_c.append(np.asarray(c_full, np.uint8))
-        if step_callback is not None and i > 0:
-            step_callback(i, merged_p, merged_c)
+    # pose-graph poses move EVERY view (transforms[0] need not be identity
+    # after optimization) — one batched launch over all n
+    merged_p = transform_views_batched(
+        [np.asarray(p_full, np.float32) for p_full, _ in clouds],
+        transforms, mesh=mesh)
+    merged_c = [np.asarray(c_full, np.uint8) for _, c_full in clouds]
+    if step_callback is not None:
+        total = 0
+        for i in range(n):
+            total += len(merged_p[i])
+            step_callback(i, merged_p[i], merged_c[i], total)
     points = np.concatenate(merged_p)
     colors = np.concatenate(merged_c)
     points, colors = _postprocess_dispatch(points, colors, cfg, {}, mesh, log)

@@ -218,6 +218,9 @@ class _Coordinator:
         self.locality = (LocalityIndex() if cfg.coordinator.listen
                          else None)
         self.blob_endpoint = ""         # set by run_coordinated in fabric mode
+        # incremental assembly lane (merge.incremental): fed every
+        # successfully settled item id; None when the knob is off
+        self.assembler = None
 
     # ---- queue logic (call under self.lock) ------------------------------
 
@@ -346,6 +349,10 @@ class _Coordinator:
                     self.view_done.add(iid)
                 self.completed_by[w] = self.completed_by.get(w, 0) + 1
                 self.ledger.event("complete", item=iid, worker=w, gen=gen)
+                if self.assembler is not None:
+                    # enqueue-only (the fold runs on the assembler's own
+                    # worker) — never blocks the server thread
+                    self.assembler.note_item(iid)
                 self._check_done()
                 return {"ok": "accepted"}
             # stale echo after a steal: the RESULT may still be perfectly
@@ -646,7 +653,26 @@ def run_coordinated(calib_path: str, target: str, out_dir: str,
             "going straight to assembly")
         ledger.close()
         return _assemble(calib_path, target, out_dir, cfg, steps,
-                         merged_name, stl_name, log, coord, info, t0)
+                         merged_name, stl_name, log, coord, info, t0,
+                         settled_unix=time.time())
+
+    assembler = None
+    if (cfg.merge.incremental and cfg.merge.stream
+            and cfg.merge.method != "posegraph"):
+        from structured_light_for_3d_model_replication_tpu.pipeline import (
+            assembly,
+        )
+
+        assembler = assembly.IncrementalAssembler(cfg, view_keys, cache,
+                                                  log=log)
+        coord.assembler = assembler
+        # pre-settled work (ledger resume + cache-hit views) folds now, so
+        # the lane starts from the same state a fresh observer would see
+        pre = sorted(set(view_done) | set(resume["completed"]))
+        for iid in pre:
+            assembler.note_item(iid)
+        log(f"[coord] incremental assembly lane up "
+            f"({len(pre)} pre-settled item(s) fed)")
 
     fabric = bool(cfg.coordinator.listen)
     server = _Server(coord, cfg.coordinator.port, log,
@@ -659,7 +685,9 @@ def run_coordinated(calib_path: str, target: str, out_dir: str,
         )
 
         blob = BlobServer(cache.root, host=server.host, port=0,
-                          secret=cfg.coordinator.secret, log=log)
+                          secret=cfg.coordinator.secret, log=log,
+                          on_blob=(assembler.note_blob
+                                   if assembler is not None else None))
         coord.blob_endpoint = blob.endpoint
     log(f"[coord] run {run_id}: {len(items)} item(s) "
         f"({sum(1 for i in items if i.kind == 'view')} view, "
@@ -691,6 +719,7 @@ def run_coordinated(calib_path: str, target: str, out_dir: str,
         with open(os.path.join(spec_dir, "join.json"), "w") as f:
             json.dump(join, f, indent=2)
     procs: dict[str, subprocess.Popen] = {}
+    t_settled = None
     try:
         for r in range(n):
             procs[f"w{r}"] = _spawn_worker(
@@ -740,6 +769,7 @@ def run_coordinated(calib_path: str, target: str, out_dir: str,
             coord.done.wait(poll_s)
         if coord.crash is not None:
             raise coord.crash
+        t_settled = time.time()   # last item settled: the tail anchor
     except Exception as e:
         # abort contract: a run that dies during coordination must be
         # diagnosable from disk. InjectedCrash is a BaseException and
@@ -777,6 +807,8 @@ def run_coordinated(calib_path: str, target: str, out_dir: str,
         if blob is not None:
             blob.close()
         server.close()
+        if assembler is not None:
+            assembler.close()
         ledger.close()
 
     with coord.lock:
@@ -798,20 +830,33 @@ def run_coordinated(calib_path: str, target: str, out_dir: str,
         if coord.locality is not None:
             info.update(coord.locality.counters())
     lost = states.get("lost", 0) + states.get("failed", 0)
+    prefold = None
+    if assembler is not None:
+        prefold = assembler.prefold(t_settled if t_settled is not None
+                                    else time.time())
+        info["assembly_lane"] = {"folded_views": prefold.offered_views,
+                                 "folded_pairs": len(prefold.T_pairs)}
+        log(f"[coord] assembly lane folded {prefold.offered_views}/"
+            f"{len(sources)} view(s) before the last item settled")
     log(f"[coord] coordination done in {info['coordination_wall_s']:.2f}s: "
         f"{states} (steals={coord.steal_count}); "
         + (f"{lost} item(s) fall to assembly recompute; " if lost else "")
         + "assembling final artifacts single-process")
     return _assemble(calib_path, target, out_dir, cfg, steps, merged_name,
-                     stl_name, log, coord, info, t0)
+                     stl_name, log, coord, info, t0, prefold=prefold,
+                     settled_unix=t_settled)
 
 
 def _assemble(calib_path, target, out_dir, cfg, steps, merged_name,
-              stl_name, log, coord, info, t0):
+              stl_name, log, coord, info, t0, prefold=None,
+              settled_unix=None):
     """The assembly pass: the proven single-process pipeline over the
     warmed cache. Every floor/degrade/abort rule runs HERE, on exactly the
     state a clean run on the survivors would see — which is the
-    degraded ≡ clean-run-on-survivors byte-identity argument."""
+    degraded ≡ clean-run-on-survivors byte-identity argument. A
+    ``prefold`` (incremental assembly lane) only SEEDS the accumulate with
+    already-validated state; everything it carries is re-validated against
+    this pass's own order/digests/transforms before use."""
     from structured_light_for_3d_model_replication_tpu.pipeline import (
         stages,
     )
@@ -822,7 +867,21 @@ def _assemble(calib_path, target, out_dir, cfg, steps, merged_name,
     acfg.pipeline.cache = True
     report = stages.run_pipeline(calib_path, target, out_dir, cfg=acfg,
                                  steps=steps, merged_name=merged_name,
-                                 stl_name=stl_name, log=log)
+                                 stl_name=stl_name, log=log,
+                                 prefold=prefold)
     info["total_wall_s"] = round(time.monotonic() - t0, 3)
+    anchor = (prefold.settled_unix if prefold is not None
+              else settled_unix)
+    asm = {"enabled": prefold is not None}
+    if anchor is not None:
+        # wall from last-item-settled to artifacts-on-disk: the quantity
+        # bench.py --assembly-only certifies ≈ postprocess-only
+        asm["tail_s"] = round(time.time() - anchor, 3)
+    if prefold is not None:
+        asm["folded_views"] = prefold.offered_views
+        asm["folded_pairs"] = len(prefold.T_pairs)
+        if getattr(report, "assembly", None):
+            asm.update(report.assembly)
+    info["assembly"] = asm
     report.coordinator = info
     return report

@@ -82,10 +82,16 @@ class BlobServer:
     single-process pipeline expects it."""
 
     def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
-                 secret: str = "", log=None):
+                 secret: str = "", log=None, on_blob=None):
         self.root = root
         self.secret = secret
         self._log = log or (lambda m: None)
+        # on_blob(name): called after a pushed blob COMMITS to the store
+        # (post os.replace — the bytes are readable). The incremental
+        # assembler's earliest wake-up signal; must be cheap/non-blocking
+        # (it runs on the per-connection server thread) and must never
+        # raise into the protocol loop.
+        self._on_blob = on_blob
         os.makedirs(root, exist_ok=True)
         self._sock = socket.create_server((host, port))
         self._sock.settimeout(0.2)
@@ -233,6 +239,11 @@ class BlobServer:
             return
         self._bump("pushes")
         self._bump("bytes_pushed", size)
+        if self._on_blob is not None:
+            try:
+                self._on_blob(name)
+            except Exception:
+                pass   # a notification hook must never break the protocol
         _reply(f, {"ok": True, "deduped": False})
 
 

@@ -191,6 +191,18 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("--pair-batch", type=int, default=None,
                    help="ready pairs per register-lane launch "
                         "(default: merge.pair_batch)")
+    p.add_argument("--incremental", dest="incremental", action="store_true",
+                   default=None,
+                   help="incremental assembly (default: merge.incremental; "
+                        "coordinated pods with streaming merge only): fold "
+                        "cleaned views and pair transforms into running "
+                        "merged-cloud state as items settle, so only the "
+                        "postprocess tail remains after the last item; "
+                        "byte-identical to the barrier assembly")
+    p.add_argument("--no-incremental", dest="incremental",
+                   action="store_false",
+                   help="force the monolithic assembly pass "
+                        "(merge.incremental=false)")
     p.add_argument("--fused-clean", dest="fused_clean", action="store_true",
                    default=None,
                    help="HBM-resident view fastpath "
@@ -557,6 +569,8 @@ def _cmd_pipeline(args) -> int:
         cfg.merge.stream = args.stream
     if args.pair_batch is not None:
         cfg.merge.pair_batch = args.pair_batch
+    if args.incremental is not None:
+        cfg.merge.incremental = args.incremental
     if args.fused_clean is not None:
         cfg.pipeline.fused_clean = args.fused_clean
     if args.packed_ingest is not None:
@@ -574,6 +588,13 @@ def _cmd_pipeline(args) -> int:
                                  steps=steps, stl_name=args.stl_name)
     print(f"[pipeline] merge mode: {report.merge_mode} "
           f"({report.merge_status})")
+    if report.assembly:
+        asm = report.assembly
+        tail = asm.get("tail_s")
+        print(f"[pipeline] assembly: {asm.get('used_views', 0)} of "
+              f"{asm.get('folded_views', 0)} folded view(s) seeded the "
+              f"merge" + (f"; tail {tail}s after last item settled"
+                          if tail is not None else ""))
     if report.coordinator:
         c = report.coordinator
         print(f"[pipeline] coordinator: {c['items_total']} item(s) across "
@@ -1196,6 +1217,34 @@ def _cmd_warmup(args) -> int:
                                     list(range(size)), cfg.merge, voxel,
                                     mesh=mesh_m, batch=size)
                 print(f"[warmup] register ladder[group={size}"
+                      f"{f', {n_dev} devices' if mesh_m is not None else ''}"
+                      f"]: {time.perf_counter() - t0:.1f}s")
+
+        # accumulate/transform ladder: finalize_chain moves every view
+        # through ONE bucket-padded device batch (transform_views_batched
+        # — view-count buckets x point-slot buckets, a distinct program
+        # per pair). Warm each view bucket a merge of up to merge_views
+        # can hit, so the assembly tail of the first real run pays no
+        # compile either
+        if len(clouds) >= 2:
+            from structured_light_for_3d_model_replication_tpu.models.reconstruction import (
+                _bucket_pad, _transform_views_bucket, transform_views_batched,
+            )
+
+            mesh_m = meshlib.merge_mesh(cfg.parallel)
+            n_dev = int(mesh_m.devices.size) if mesh_m is not None else 1
+            pts = [p.astype(np.float32) for p, _ in clouds]
+            slots = _bucket_pad(max(len(p) for p in pts))
+            eye = np.eye(4, dtype=np.float32)
+            for vb in sorted({_transform_views_bucket(n, n_dev)
+                              for n in range(2, len(clouds) + 1)}):
+                n = min(vb, len(pts))
+                t0 = time.perf_counter()
+                transform_views_batched(
+                    [pts[i % len(pts)] for i in range(n)], [eye] * n,
+                    mesh=mesh_m, use_device=True)
+                print(f"[warmup] accumulate ladder[views={vb}, "
+                      f"slots={slots}"
                       f"{f', {n_dev} devices' if mesh_m is not None else ''}"
                       f"]: {time.perf_counter() - t0:.1f}s")
     print("[warmup] done — subsequent processes reuse these executables "

@@ -133,6 +133,11 @@ class RunAnalysis:
     fabric: dict[str, int] = field(default_factory=dict)
     manifest: dict | None = None   # failures.json payload
     metrics: dict | None = None    # metrics.json payload
+    # incremental-assembly close-out: the `assembly.tail` instant the
+    # assembly pass emits when a prefold was in play (tail_s + fold
+    # counters) — the journal-side twin of the
+    # `sl3d_assembly_tail_seconds` metrics gauge
+    assembly: dict | None = None
     # stall ledger: watchdog breaches seen in the journal, the last
     # heartbeat time per lane (span ends + lane.heartbeat instants), and
     # the stalls.json payload the watchdog persists on a breach
@@ -217,6 +222,8 @@ def analyze_run(out_dir: str, trace_file: str = "trace.jsonl",
                 a.lane_last_beat[ln] = max(a.lane_last_beat.get(ln, 0.0), t)
             elif name == "executor.finish":
                 a.critical_path_s = ev.get("critical_path_s")
+            elif name == "assembly.tail":
+                a.assembly = ev
             elif name == "transfer.bytes":
                 for k in ("h2d", "d2h", "frames", "frames_raw"):
                     v = ev.get(k)
@@ -454,6 +461,41 @@ def render_report(a: RunAnalysis, width: int = 60) -> str:
             L.append(f"  pair batches : {pairs} pair(s) in "
                      f"{len(a.pair_launches)} register launch(es), mean "
                      f"{pairs / len(a.pair_launches):.1f}/launch")
+
+    if a.assembly is not None or "assembly" in a.lane_walls:
+        L.append("")
+        L.append("incremental assembly")
+        folds = a.lane_spans.get("assembly", 0)
+        fold_s = a.lane_walls.get("assembly", 0.0)
+        L.append(f"  folds      : {folds} fold event(s), {fold_s:.3f}s "
+                 f"folded into the pod window")
+        asm = a.assembly or {}
+        if asm.get("used_views") is not None:
+            L.append(f"  prefix     : {asm.get('used_views')} of "
+                     f"{asm.get('folded_views', '?')} folded view(s) "
+                     f"validated, {asm.get('folded_pairs', '?')} pair "
+                     f"transform(s) pre-chained")
+        tail = asm.get("tail_s")
+        if tail is not None:
+            line = f"  tail_s     : {float(tail):.3f}s after last item settled"
+            # can't-drift cross-check: the journal instant and the
+            # metrics gauge are written from the SAME report field, so
+            # any drift means the close-out path forked — flag >1%
+            gauge = None
+            for row in (a.metrics or {}).get("gauges", []):
+                if row.get("name") == "sl3d_assembly_tail_seconds":
+                    gauge = float(row.get("value", 0.0))
+            if gauge is None:
+                line += " (metrics absent; no cross-check)"
+            else:
+                ref = max(abs(float(tail)), abs(gauge), 1e-9)
+                drift = abs(float(tail) - gauge) / ref
+                if drift > 0.01:
+                    line += (f" [DRIFT: metrics gauge says {gauge:.3f}s, "
+                             f"{drift * 100:.1f}% apart]")
+                else:
+                    line += f" (= metrics gauge, drift {drift * 100:.2f}%)"
+            L.append(line)
 
     if a.kernels or a.transfer or a.fabric:
         L.append("")

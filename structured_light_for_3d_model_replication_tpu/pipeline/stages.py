@@ -1484,6 +1484,10 @@ class PipelineReport:
     # multiprocess runs only: the coordinator's lease/steal/ledger summary
     # (parallel/coordinator.py attaches it after the assembly pass)
     coordinator: dict | None = None
+    # incremental-assembly accounting (merge.incremental pods only):
+    # folded_views/used_views/folded_pairs/fold_wall_s + tail_s — the wall
+    # from last-item-settled to artifacts-on-disk
+    assembly: dict | None = None
     elapsed_s: float = 0.0
 
     @property
@@ -1549,10 +1553,11 @@ def _failure_manifest(out_dir: str, report: "PipelineReport",
     return path
 
 
-# merge.stream / merge.pair_batch are SCHEDULE knobs: the streamed and the
-# barrier arm produce byte-identical merged output, so neither may dirty a
-# merge or pair cache entry — they are stripped from all merge-key material
-_MERGE_SCHEDULE_KNOBS = ("stream", "pair_batch")
+# merge.stream / merge.pair_batch / merge.incremental are SCHEDULE knobs:
+# the streamed, barrier, and incremental-assembly arms produce byte-identical
+# merged output, so none may dirty a merge or pair cache entry — they are
+# stripped from all merge-key material
+_MERGE_SCHEDULE_KNOBS = ("stream", "pair_batch", "incremental")
 
 
 def _merge_numeric_json(cfg: Config) -> str:
@@ -1898,7 +1903,7 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
                  steps: tuple[str, ...] | list[str] = _CLEAN_STEPS,
                  merged_name: str = "merged.ply",
                  stl_name: str = "model.stl", log=print,
-                 cache=None) -> PipelineReport:
+                 cache=None, prefold=None) -> PipelineReport:
     """The fused scan-to-print command: reconstruct -> per-view masked clean
     -> merge-360 -> mesh, end to end in ONE process with device-resident
     handoff — per-view clouds flow from the pipelined executor's clean lane
@@ -1920,6 +1925,12 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     the STL; ``sl3d report <out_dir>`` renders the timeline. A ``run_id``
     correlates the report, the journal, failures.json, and bench lines.
     The recorder closes (and persists metrics) even on a crash/interrupt.
+
+    ``prefold`` (assembly pass of an incremental pod only): a
+    ``pipeline.assembly.Prefold`` carrying the coordinator fold lane's
+    already-merged prefix. It is re-validated against this run's own view
+    order/digests/pair transforms before seeding ``finalize_chain``, so
+    output bytes never depend on it.
     """
     cfg = cfg or Config()
     if cfg.coordinator.workers > 0 or cfg.coordinator.listen:
@@ -1952,6 +1963,7 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
                   "backend": cfg.parallel.backend,
                   "merge_method": cfg.merge.method,
                   "merge_stream": cfg.merge.stream,
+                  "merge_incremental": cfg.merge.incremental,
                   "host": tel.host_tag(),
                   "host_cpus": os.cpu_count(),
                   "device_count": _initialized_device_count()})
@@ -1982,7 +1994,8 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
     try:
         report = _run_pipeline_impl(calib_path, target, out_dir, cfg,
                                     tuple(steps), merged_name, stl_name,
-                                    log, run_id, cache=cache)
+                                    log, run_id, cache=cache,
+                                    prefold=prefold)
         if tracer is not None:
             g = tracer.registry.set_gauge
             g("sl3d_run_wall_seconds", report.elapsed_s)
@@ -1993,6 +2006,10 @@ def run_pipeline(calib_path: str, target: str, out_dir: str,
             if report.overlap:
                 g("sl3d_critical_path_seconds",
                   report.overlap.get("critical_path_s") or 0.0)
+            if report.assembly and report.assembly.get("tail_s") is not None:
+                # same stored value the assembly.tail journal instant
+                # carries — the report's ≤1% drift cross-check rides on it
+                g("sl3d_assembly_tail_seconds", report.assembly["tail_s"])
         return report
     except Exception as e:
         # EVERY abort leaves a manifest (the below-floor path writes its
@@ -2055,7 +2072,8 @@ def _initialized_device_count():
 def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
                        cfg: Config, steps: tuple[str, ...],
                        merged_name: str, stl_name: str, log,
-                       run_id: str, cache=None) -> PipelineReport:
+                       run_id: str, cache=None,
+                       prefold=None) -> PipelineReport:
     from structured_light_for_3d_model_replication_tpu.models import (
         reconstruction as recon,
     )
@@ -2258,12 +2276,35 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
                 T_all, gf_all, fi_all, ir_all = stream.finish(order,
                                                               collected)
                 stream_stats.finish(time.perf_counter() - t_stream0)
+                pf = None
+                if prefold is not None:
+                    # trust nothing folded during the pod phase until it
+                    # matches THIS pass's order/digests/transforms
+                    pf = prefold.validate(order,
+                                          dict(zip(order, view_digests)),
+                                          T_all, log=log)
+                if pf is not None:
+                    # replay the fold lane's buffered events now that a
+                    # tracer is live (the pod phase ran before run_pipeline
+                    # opened one): lane spans + OverlapStats from one call
+                    for kind, idx, dur in pf.events:
+                        stream_stats.add_fold(kind, idx, dur)
+                    report.assembly = {
+                        "folded_views": prefold.offered_views,
+                        "used_views": len(pf.transforms),
+                        "folded_pairs": len(pf.T_pairs),
+                        "fold_wall_s": round(sum(e[2] for e in pf.events),
+                                             6)}
+                    log(f"[assembly] seeding finalize from "
+                        f"{len(pf.transforms)} prefolded view(s); only "
+                        f"the {len(order) - len(pf.transforms)}-view "
+                        f"suffix accumulates here")
                 # the ONLY remaining barrier: chain-accumulate + final
                 # voxel/outlier postprocess (slab-sharded over the mesh
                 # when one is up)
                 points, colors, transforms = recon.finalize_chain(
                     clouds, T_all, gf_all, fi_all, ir_all, cfg.merge,
-                    log=log, mesh=stream.mesh)
+                    log=log, mesh=stream.mesh, prefold=pf)
             if stream.failures:
                 report.failures.extend(stream.failures)
                 report.degraded = True
@@ -2363,6 +2404,28 @@ def _run_pipeline_impl(calib_path: str, target: str, out_dir: str,
         if os.path.exists(stale):
             os.remove(stale)
 
+    if prefold is not None:
+        if report.assembly is None:
+            # merge cache-hit (or non-streamed config): nothing needed
+            # folding, but the tail is still the certified quantity
+            report.assembly = {
+                "folded_views": prefold.offered_views, "used_views": 0,
+                "folded_pairs": len(prefold.T_pairs),
+                "fold_wall_s": round(sum(e[2] for e in prefold.events), 6)}
+        if prefold.settled_unix:
+            tail_s = round(time.time() - prefold.settled_unix, 6)
+            report.assembly["tail_s"] = tail_s
+            if stream_stats is not None:
+                # OverlapStats gauge + assembly.tail journal instant from
+                # ONE call (the PR-6 can't-drift pattern); the report's
+                # metrics gauge reads the same stored value
+                stream_stats.set_assembly_tail(tail_s, report.assembly)
+                if report.overlap is not None:
+                    report.overlap.update(stream_stats.assembly_snapshot())
+            else:
+                _tr3 = tel.current()
+                if _tr3 is not None:
+                    _tr3.instant("assembly.tail", **report.assembly)
     report.cache = cache.stats()
     report.elapsed_s = time.monotonic() - t_start
     log(f"[pipeline] {report.summary}")

@@ -242,6 +242,13 @@ class OverlapStats:
         self._fabric_deduped = 0
         # per-kernel launch accounting: name -> [launches, wall_s, bytes]
         self._kernels: dict[str, list] = {}
+        # incremental-assembly fold lane (merge.incremental pods): fold
+        # wall + folded view/pair counts, and the tail wall from
+        # last-item-settled to artifacts-on-disk. All zero/None otherwise
+        self._asm_fold_s = 0.0
+        self._asm_views = 0
+        self._asm_pairs = 0
+        self._asm_tail_s: float | None = None
         self.critical_path_s = 0.0
 
     def add(self, stage: str, elapsed_s: float, items: int = 0,
@@ -393,6 +400,49 @@ class OverlapStats:
                        bucket=int(bucket) if bucket is not None else None,
                        bytes=int(bytes_moved) or None)
 
+    def add_fold(self, kind: str, idx: int, dur_s: float) -> None:
+        """Record one incremental-assembly fold (``kind`` 'view' or
+        'pair'). The pod phase has no live tracer (coordinated dispatch
+        happens before run_pipeline opens one), so the assembler buffers
+        its fold events and the assembly pass REPLAYS them through here —
+        the ``assembly`` lane span and this aggregate come from the same
+        call (can't-drift), they just both land at replay time."""
+        d = float(dur_s)
+        with self._lock:
+            self._asm_fold_s += d
+            if kind == "view":
+                self._asm_views += 1
+            else:
+                self._asm_pairs += 1
+        tr = telemetry.current()
+        if tr is not None:
+            tr.lane("assembly", d, **{str(kind): int(idx)})
+
+    def set_assembly_tail(self, tail_s: float, info: dict | None = None) \
+            -> None:
+        """Stamp the assembly-tail wall (last-item-settled ->
+        artifacts-on-disk) and emit the ``assembly.tail`` journal instant
+        from the SAME call — the report's ≤1% drift cross-check between
+        the journal and the metrics gauge rides on this single store."""
+        t = float(tail_s)
+        with self._lock:
+            self._asm_tail_s = t
+        tr = telemetry.current()
+        if tr is not None:
+            tr.instant("assembly.tail", **{"tail_s": round(t, 6),
+                                           **(info or {})})
+
+    def assembly_snapshot(self) -> dict:
+        """The assembly-lane gauges alone (for a late overlap update —
+        the tail is only known after the main as_dict snapshot)."""
+        with self._lock:
+            out = {"assembly_s": round(self._asm_fold_s, 4),
+                   "assembly_folded_views": self._asm_views,
+                   "assembly_folded_pairs": self._asm_pairs}
+            if self._asm_tail_s is not None:
+                out["assembly_tail_s"] = round(self._asm_tail_s, 4)
+            return out
+
     def sample_queue(self, depth: int) -> None:
         d = int(depth)
         with self._lock:
@@ -460,6 +510,8 @@ class OverlapStats:
             name: {"launches": agg[0], "wall_s": round(agg[1], 4),
                    "bytes_moved": agg[2]}
             for name, agg in sorted(self._kernels.items())}
+        # incremental-assembly gauges (zeros off-pod / knob off)
+        out.update(self.assembly_snapshot())
         items = self._items
         out["compute_per_item_s"] = (round(self._stage_s["compute"] / items, 4)
                                      if items else None)

@@ -67,9 +67,10 @@ __all__ = [
 
 SCHEMA = "sl3d-trace-v1"
 
-# canonical lane display order (the executor lanes, then run-level tracks)
+# canonical lane display order (the executor lanes, then run-level tracks;
+# "assembly" is the incremental fold lane of merge.incremental pods)
 LANE_ORDER = ("load", "transfer", "compute", "clean", "write", "register",
-              "stage")
+              "assembly", "stage")
 
 # histogram bucket ladders: log-ish spacing for seconds, powers of two for
 # per-launch counts. The +inf bucket is implicit (the overflow count).

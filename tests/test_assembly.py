@@ -1,0 +1,353 @@
+"""Incremental assembly (ISSUE 17): the coordinator-side fold lane.
+
+Contract under test (pipeline/assembly + transform_views_batched):
+  - the device-batched accumulate apply is BYTE-IDENTICAL to its numpy
+    twin, single-device and on the 8-virtual-device mesh the conftest
+    forces, and repeat calls at a bucket retrace nothing
+  - an incremental 2-worker pod produces PLY+STL bytes IDENTICAL to the
+    barrier pod and to the single-process run (merge.incremental is a
+    SCHEDULE knob: the fold lane only re-orders the proven computation)
+  - a dirty-view rerun recomputes exactly the affected entries (one view
+    + its <=2 adjacent pairs), folds the full chain again, and retraces
+    no accumulate program
+  - DEGRADED pods fold incrementally too: a quarantined view stalls the
+    fold at its chain position and the degraded output still equals a
+    clean run on the survivors; an identity-fallback pair (never cached)
+    stalls the fold before it and the pod equals the single-process
+    degraded run
+  - a worker SIGKILLed mid-pod costs only in-flight items and the
+    incremental assembly is still byte-identical
+"""
+import glob
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.cli import main as cli_main
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.models import (
+    reconstruction as recon,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
+VIEWS = 5
+PROJ = (64, 32)
+STEPS = ("statistical",)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("asmds"))
+    rc = cli_main(["synth", root, "--views", str(VIEWS),
+                   "--cam", "96x72", "--proj", f"{PROJ[0]}x{PROJ[1]}"])
+    assert rc == 0
+    return root
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_env():
+    yield
+    os.environ.pop("SL3D_FAULTS", None)
+    os.environ.pop("SL3D_FAULTS_SEED", None)
+    faults.reset()
+
+
+def _cfg(workers: int = 0, incremental: bool = False,
+         mesh: bool = False) -> Config:
+    cfg = Config()
+    cfg.decode.n_cols, cfg.decode.n_rows = PROJ
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 256
+    cfg.merge.icp_iters = 6
+    cfg.merge.incremental = incremental
+    cfg.parallel.merge_mesh = mesh
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    cfg.coordinator.workers = workers
+    return cfg
+
+
+def _run(dataset: str, out: str, **kw):
+    return stages.run_pipeline(os.path.join(dataset, "calib.mat"), dataset,
+                               out, cfg=_cfg(**kw), steps=STEPS,
+                               log=lambda m: None)
+
+
+def _bytes(out_or_rep, name=None) -> bytes:
+    path = (os.path.join(out_or_rep, name) if name is not None
+            else out_or_rep)
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _copy_cache(src_out: str, dst_out: str,
+                stages_=("view", "pair")) -> None:
+    """Seed a fresh out dir with another run's cache entries (keys are
+    content-addressed, so entries are valid across out dirs)."""
+    dst = os.path.join(dst_out, ".slscan-cache")
+    os.makedirs(dst, exist_ok=True)
+    for stage in stages_:
+        for p in glob.glob(os.path.join(src_out, ".slscan-cache",
+                                        f"{stage}-*.npz")):
+            shutil.copy(p, dst)
+
+
+@pytest.fixture(scope="module")
+def baseline(dataset, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("asm_sp"))
+    rep = _run(dataset, out)
+    assert rep.failed == [] and not rep.degraded
+    return out, _bytes(out, "merged.ply"), _bytes(out, "model.stl")
+
+
+def _assert_parity(baseline, out: str) -> None:
+    _, ply, stl = baseline
+    assert _bytes(out, "merged.ply") == ply, "merged.ply differs"
+    assert _bytes(out, "model.stl") == stl, "model.stl differs"
+
+
+def _rigid(rng) -> np.ndarray:
+    q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    T = np.eye(4, dtype=np.float32)
+    T[:3, :3] = q.astype(np.float32)
+    T[:3, 3] = (rng.normal(size=3) * 25).astype(np.float32)
+    return T
+
+
+# ---------------------------------------------------------------------------
+# the device-batched accumulate apply: twin parity + no retrace
+# ---------------------------------------------------------------------------
+
+def test_transform_views_batched_twin_parity_and_no_retrace(rng):
+    """Tentpole arithmetic: the bucket-padded device batch returns bytes
+    identical to the numpy twin for ragged view sizes, single-device AND
+    sharded over the 8-device mesh, and a repeat call at the same bucket
+    compiles nothing new."""
+    import jax
+
+    from structured_light_for_3d_model_replication_tpu.parallel import (
+        mesh as meshlib,
+    )
+
+    assert jax.device_count() == 8          # the conftest mesh
+    sizes = [513, 2048, 37, 1000, 4096]
+    pts = [(rng.normal(size=(n, 3)) * 40).astype(np.float32)
+           for n in sizes]
+    Ts = [_rigid(rng) for _ in sizes]
+    twin = [recon._transform_view_np(T, p) for T, p in zip(Ts, pts)]
+
+    dev = recon.transform_views_batched(pts, Ts, use_device=True)
+    assert all(a.tobytes() == b.tobytes() for a, b in zip(twin, dev))
+
+    m = meshlib.make_mesh()
+    sh = recon.transform_views_batched(pts, Ts, mesh=m, use_device=True)
+    assert all(a.tobytes() == b.tobytes() for a, b in zip(twin, sh))
+
+    # no retrace: both arms hit their compile caches on a repeat at the
+    # same (view bucket, slot bucket)
+    before = recon._accumulate_views_jit._cache_size()
+    recon.transform_views_batched(pts, Ts, use_device=True)
+    assert recon._accumulate_views_jit._cache_size() == before
+    n_sharded = len(recon._TRANSFORM_SHARDED)
+    recon.transform_views_batched(pts, Ts, mesh=m, use_device=True)
+    assert len(recon._TRANSFORM_SHARDED) == n_sharded
+
+    # the default gate folds small batches back onto the twin
+    assert recon.transform_views_batched([], []) == []
+    one = recon.transform_views_batched([pts[0]], [Ts[0]])
+    assert one[0].tobytes() == twin[0].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# incremental ≡ barrier ≡ single-process byte parity
+# ---------------------------------------------------------------------------
+
+def test_incremental_pod_matches_barrier_and_single_process(dataset,
+                                                            baseline,
+                                                            tmp_path):
+    """The acceptance A/B: a cold incremental 2-worker pod folds every
+    view before the last item settles and ships bytes identical to the
+    single-process run; a barrier pod over the same warmed cache agrees
+    and reports no assembly lane."""
+    out_inc = str(tmp_path / "inc")
+    rep = _run(dataset, out_inc, workers=2, incremental=True)
+    assert not rep.degraded and rep.coordinator is not None
+    _assert_parity(baseline, out_inc)
+    asm = rep.assembly
+    assert asm is not None, "incremental pod reported no assembly"
+    assert asm["used_views"] == VIEWS
+    assert asm["folded_pairs"] == VIEWS - 1
+    assert asm["tail_s"] >= 0
+    assert rep.coordinator["assembly"]["enabled"] is True
+    assert rep.coordinator["assembly_lane"]["folded_views"] == VIEWS
+
+    out_bar = str(tmp_path / "bar")
+    _copy_cache(out_inc, out_bar)
+    rep_b = _run(dataset, out_bar, workers=2, incremental=False)
+    assert not rep_b.degraded
+    _assert_parity(baseline, out_bar)
+    assert rep_b.assembly is None
+    assert rep_b.coordinator["assembly"]["enabled"] is False
+
+
+def test_incremental_pod_sharded_mesh_parity(dataset, baseline, tmp_path):
+    """The 8-virtual-device arm: the fold lane + mesh-sharded register
+    and accumulate still ship single-process bytes."""
+    import jax
+
+    assert jax.device_count() == 8
+    out_b, _, _ = baseline
+    out = str(tmp_path / "inc8")
+    _copy_cache(out_b, out, stages_=("view",))   # pairs recompute sharded
+    rep = _run(dataset, out, workers=2, incremental=True, mesh=True)
+    assert not rep.degraded
+    _assert_parity(baseline, out)
+    assert rep.assembly["used_views"] == VIEWS
+
+
+# ---------------------------------------------------------------------------
+# dirty-view rerun: exactly the affected suffix recomputes
+# ---------------------------------------------------------------------------
+
+def test_dirty_view_rerun_recomputes_affected_entries_only(dataset,
+                                                           baseline,
+                                                           tmp_path):
+    """One dirty view in an incremental pod: exactly one new view entry
+    and its two adjacent pair entries appear in the cache, nothing old is
+    rewritten, the full chain folds again, and no accumulate program
+    retraces."""
+    out_b, _, _ = baseline
+    ds2 = str(tmp_path / "ds2")
+    shutil.copytree(dataset, ds2)
+
+    from structured_light_for_3d_model_replication_tpu.io import (
+        images as imio,
+    )
+
+    victim = sorted(d for d in os.listdir(ds2)
+                    if os.path.isdir(os.path.join(ds2, d)))[2]
+    frame0 = sorted(glob.glob(os.path.join(ds2, victim, "*")))[0]
+    img = imio.load_gray(frame0).copy()
+    img[:8, :8] = 255 - img[:8, :8]
+    imio.save_image(frame0, img)
+
+    out = str(tmp_path / "out")
+    _copy_cache(out_b, out)
+    cdir = os.path.join(out, ".slscan-cache")
+    seeded = {p: os.path.getmtime(p)
+              for p in glob.glob(os.path.join(cdir, "*.npz"))}
+
+    before = recon._accumulate_views_jit._cache_size()
+    rep = _run(ds2, out, workers=2, incremental=True)
+    assert recon._accumulate_views_jit._cache_size() == before, \
+        "dirty-view rerun retraced the accumulate program"
+    assert not rep.degraded
+    assert rep.assembly["used_views"] == VIEWS
+
+    for p, mt in seeded.items():
+        assert os.path.getmtime(p) == mt, f"seeded entry rewritten: {p}"
+    new = [os.path.basename(p)
+           for p in glob.glob(os.path.join(cdir, "*.npz"))
+           if p not in seeded]
+    assert sum(1 for n in new if n.startswith("view-")) == 1, new
+    assert sum(1 for n in new if n.startswith("pair-")) == 2, new
+
+    # parity anchor: a single-process run on the dirty dataset
+    out_sp = str(tmp_path / "sp")
+    _copy_cache(out, out_sp)
+    rep_sp = _run(ds2, out_sp)
+    assert rep_sp.failed == []
+    assert _bytes(out, "merged.ply") == _bytes(out_sp, "merged.ply")
+    assert _bytes(out, "model.stl") == _bytes(out_sp, "model.stl")
+
+
+# ---------------------------------------------------------------------------
+# DEGRADED folds: quarantine adjacency remap + identity fallback
+# ---------------------------------------------------------------------------
+
+def test_quarantined_view_degraded_equals_clean_survivors(dataset,
+                                                          tmp_path):
+    """A permanently failing view in an incremental pod: the fold stalls
+    at the victim's chain position (prefold = the clean prefix), the
+    assembly pass quarantines it and re-pairs (k-1)->(k+1), and the
+    DEGRADED bytes equal a clean run over the surviving views."""
+    victim = sorted(d for d in os.listdir(dataset)
+                    if os.path.isdir(os.path.join(dataset, d)))[2]
+    spec = f"compute.view~{victim}:permanent"
+    os.environ["SL3D_FAULTS"] = spec        # the workers' copy
+    faults.configure(spec, seed=0)          # the assembly pass's copy
+
+    out_deg = str(tmp_path / "deg")
+    rep = _run(dataset, out_deg, workers=2, incremental=True)
+    os.environ.pop("SL3D_FAULTS", None)
+    faults.reset()
+    assert rep.degraded and len(rep.failed) == 1
+    # the fold stalled exactly at the victim: only views 0..1 prefolded
+    assert rep.assembly["used_views"] == 2
+
+    ds4 = str(tmp_path / "ds4")
+    shutil.copytree(dataset, ds4)
+    shutil.rmtree(os.path.join(ds4, victim))
+    out_clean = str(tmp_path / "clean")
+    _copy_cache(out_deg, out_clean)
+    rep4 = stages.run_pipeline(os.path.join(dataset, "calib.mat"), ds4,
+                               out_clean, cfg=_cfg(), steps=STEPS,
+                               log=lambda m: None)
+    assert rep4.failed == [] and not rep4.degraded
+    assert _bytes(out_deg, "merged.ply") == _bytes(out_clean, "merged.ply")
+    assert _bytes(out_deg, "model.stl") == _bytes(out_clean, "model.stl")
+
+
+def test_identity_fallback_pair_degraded_parity(dataset, baseline,
+                                                tmp_path):
+    """A permanently failing pair registration: the worker item fails,
+    the pair is never cached so the fold stalls before it, the assembly
+    pass retries then falls back to identity — DEGRADED bytes equal the
+    single-process run under the same fault."""
+    out_b, _, _ = baseline
+    spec = "register.pair~1->2:permanent"
+
+    out_pod = str(tmp_path / "pod")
+    _copy_cache(out_b, out_pod, stages_=("view",))  # pairs must recompute
+    os.environ["SL3D_FAULTS"] = spec
+    faults.configure(spec, seed=0)
+    rep = _run(dataset, out_pod, workers=2, incremental=True)
+    os.environ.pop("SL3D_FAULTS", None)
+    faults.reset()
+    assert rep.degraded
+    # views 0..1 fold; pair 1->2 never lands, stalling everything after
+    assert rep.assembly["used_views"] == 2
+
+    out_sp = str(tmp_path / "sp")
+    _copy_cache(out_b, out_sp, stages_=("view",))
+    faults.configure(spec, seed=0)
+    rep_sp = _run(dataset, out_sp)
+    faults.reset()
+    assert rep_sp.degraded
+    assert _bytes(out_pod, "merged.ply") == _bytes(out_sp, "merged.ply")
+    assert _bytes(out_pod, "model.stl") == _bytes(out_sp, "model.stl")
+
+
+# ---------------------------------------------------------------------------
+# worker kill mid-pod
+# ---------------------------------------------------------------------------
+
+def test_worker_kill_mid_pod_assembles_byte_identical(dataset, baseline,
+                                                      tmp_path):
+    """SIGKILL w0 on its first granted item: the coordinator steals the
+    orphaned lease, the survivor completes it, the fold lane still folds
+    the full chain, and the bytes match the single-process run."""
+    out_b, _, _ = baseline
+    out = str(tmp_path / "out")
+    _copy_cache(out_b, out, stages_=("view",))      # pairs recompute
+    os.environ["SL3D_FAULTS"] = "worker.item~w0:worker.kill"
+    rep = _run(dataset, out, workers=2, incremental=True)
+    os.environ.pop("SL3D_FAULTS", None)
+    assert not rep.degraded
+    assert rep.coordinator["steals"] >= 1
+    assert rep.assembly["used_views"] == VIEWS
+    _assert_parity(baseline, out)

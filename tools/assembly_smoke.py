@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Incremental-assembly smoke for CI (run by tools/ci_tier1.sh).
+
+Renders a 5-view synthetic turntable dataset and runs the same scan
+three ways: single-process (the trusted baseline), a 2-worker pod with
+``merge.incremental`` ON (the ISSUE-17 fold lane), and a 2-worker pod
+with it OFF (the barrier arm). Asserts the incremental-assembly
+contract:
+
+  - all three runs exit clean and merged.ply + model.stl are
+    BYTE-IDENTICAL across them (merge.incremental is a SCHEDULE knob:
+    the fold lane only re-orders the proven computation)
+  - the fold lane actually folded the whole chain before the last item
+    settled (folded_views == views)
+  - the tail-wall ratio holds: the incremental pod's assembly tail
+    (last-item-settled -> artifacts-on-disk) is no slower than the
+    barrier pod's tail * 1.25 — with every view and pair pre-folded,
+    the tail is the postprocess only, so it must not regress even on a
+    noisy 1-CPU CI box
+
+Prints ``ASSEMBLY_SMOKE=ok`` (exit 0) or ``ASSEMBLY_SMOKE=FAIL (...)``
+(exit 1).
+"""
+import os
+import shutil
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+VIEWS = 5
+
+
+def fail(why: str) -> int:
+    print(f"ASSEMBLY_SMOKE=FAIL ({why})")
+    return 1
+
+
+def _read(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _cfg(workers: int = 0, incremental: bool = False):
+    from structured_light_for_3d_model_replication_tpu.config import Config
+
+    cfg = Config()
+    cfg.parallel.backend = "numpy"
+    cfg.decode.n_cols, cfg.decode.n_rows = 128, 64
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 512
+    cfg.merge.icp_iters = 10
+    cfg.merge.incremental = incremental
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    cfg.coordinator.workers = workers
+    return cfg
+
+
+def main() -> int:
+    os.environ.pop("SL3D_FAULTS", None)
+    from structured_light_for_3d_model_replication_tpu.cli import (
+        main as cli_main,
+    )
+    from structured_light_for_3d_model_replication_tpu.pipeline import stages
+
+    tmp = tempfile.mkdtemp(prefix="slasm_")
+    try:
+        root = os.path.join(tmp, "dataset")
+        rc = cli_main(["synth", root, "--views", str(VIEWS),
+                       "--cam", "160x120", "--proj", "128x64"])
+        if rc != 0:
+            return fail(f"synth rc={rc}")
+        calib = os.path.join(root, "calib.mat")
+
+        def run(out, **kw):
+            return stages.run_pipeline(calib, root, out, cfg=_cfg(**kw),
+                                       steps=("statistical",),
+                                       log=lambda m: None)
+
+        out_sp = os.path.join(tmp, "out_single")
+        rep_sp = run(out_sp)
+        if rep_sp.failed or rep_sp.degraded:
+            return fail("single-process run not clean")
+
+        out_inc = os.path.join(tmp, "out_incremental")
+        rep_inc = run(out_inc, workers=2, incremental=True)
+        if rep_inc.degraded:
+            return fail("incremental pod degraded")
+        out_bar = os.path.join(tmp, "out_barrier")
+        rep_bar = run(out_bar, workers=2, incremental=False)
+        if rep_bar.degraded:
+            return fail("barrier pod degraded")
+
+        for name in ("merged.ply", "model.stl"):
+            base = _read(os.path.join(out_sp, name))
+            for out, arm in ((out_inc, "incremental"), (out_bar, "barrier")):
+                p = os.path.join(out, name)
+                if not os.path.exists(p):
+                    return fail(f"{name} missing from {arm} pod")
+                if _read(p) != base:
+                    return fail(f"{name} differs in the {arm} pod vs "
+                                f"single-process")
+
+        asm = rep_inc.assembly or {}
+        lane = (rep_inc.coordinator or {}).get("assembly_lane") or {}
+        if lane.get("folded_views") != VIEWS:
+            return fail(f"fold lane folded {lane.get('folded_views')} of "
+                        f"{VIEWS} view(s) before settle")
+        if asm.get("used_views") != VIEWS:
+            return fail(f"assembly pass seeded only {asm.get('used_views')} "
+                        f"of {VIEWS} folded view(s)")
+
+        tail_i = ((rep_inc.coordinator or {}).get("assembly") or {}).get(
+            "tail_s")
+        tail_b = ((rep_bar.coordinator or {}).get("assembly") or {}).get(
+            "tail_s")
+        if tail_i is None or tail_b is None:
+            return fail(f"tail not reported (inc={tail_i}, bar={tail_b})")
+        # with the whole chain pre-folded the tail is postprocess-only —
+        # it must not exceed the barrier tail (margin for 1-CPU CI noise)
+        if tail_i > tail_b * 1.25 + 0.5:
+            return fail(f"tail-wall ratio regressed: incremental tail "
+                        f"{tail_i:.2f}s vs barrier {tail_b:.2f}s")
+
+        print(f"ASSEMBLY_SMOKE=ok (2 workers; {lane['folded_views']}/"
+              f"{VIEWS} views folded in-pod; tail {tail_i:.2f}s "
+              f"incremental vs {tail_b:.2f}s barrier; PLY+STL "
+              f"byte-identical across incremental/barrier/single-process)")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -160,6 +160,21 @@ else
   [ $rc -eq 0 ] && rc=1
 fi
 
+# ---- assembly smoke: a 2-worker pod with the incremental fold lane
+# (merge.incremental) must ship PLY+STL byte-identical to the barrier
+# pod AND the single-process run, fold the whole chain before the last
+# item settles, and keep the assembly tail (last-item-settled ->
+# artifacts) no slower than the barrier arm's (ISSUE 17) ----
+asm_rc=0
+asm=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/assembly_smoke.py 2>&1) || asm_rc=$?
+echo "$asm" > tools/_ci/assembly_smoke.log
+if [ $asm_rc -eq 0 ] && echo "$asm" | grep -q 'ASSEMBLY_SMOKE=ok'; then
+  echo "$asm" | grep 'ASSEMBLY_SMOKE=ok'
+else
+  echo "ASSEMBLY_SMOKE=FAIL (rc=$asm_rc; see tools/_ci/assembly_smoke.log)"
+  [ $rc -eq 0 ] && rc=1
+fi
+
 # ---- fabric smoke: 2 workers joined over REAL TCP (coordinator.listen +
 # shared secret, private per-worker L1 caches against the coordinator's
 # blobstore L2) with a seeded worker.kill of w0 on its 3rd item plus a

@@ -29,7 +29,10 @@ Every other multiproc run additionally goes through the pod fabric
 (ISSUE 15) — real-TCP control plane + blobstore L2 — with one extra rule
 drawn against the wire itself (``blob.fetch``/``blob.push`` transients,
 ``net.slowlink``); the fabric must degrade to retries and cache misses,
-never to a hung or failed run.
+never to a hung or failed run. The non-fabric half routes through the
+incremental assembly lane (ISSUE 17, ``merge.incremental``) — the fold
+lane is a schedule knob, so the identical contract must hold while views
+fold mid-pod under the drawn fault.
 
 ``--serve-runs`` (ISSUE 13) appends a serving kill->restart matrix:
 each run drives a ScanService under a seeded serve-scope rule
@@ -285,6 +288,11 @@ def main() -> int:
             out = os.path.join(tmp, f"out_mp_{i:03d}")
             mpcfg = cfg()
             mpcfg.coordinator.workers = 2
+            # every other draw routes through the incremental assembly
+            # lane (ISSUE 17): the fold lane is a schedule knob, so the
+            # same never-hang / valid-ledger / valid-journal contract
+            # must hold with views folding mid-pod under host faults
+            mpcfg.merge.incremental = not fabric
             # short leases so an orphaned lease is stolen within seconds
             # (spurious expiry on a slow-but-alive item is safe: the late
             # complete is journaled and the cache entry stays warm)
@@ -337,7 +345,7 @@ def main() -> int:
                                 f"{os.path.basename(journal)} invalid: "
                                 f"{errors[:3]}")
             outcomes[f"mp-{outcome}"] = outcomes.get(f"mp-{outcome}", 0) + 1
-            tag = " +fabric" if fabric else ""
+            tag = " +fabric" if fabric else " +incremental"
             print(f"[soak] mp run {i}: {outcome:<9} {wall:5.1f}s  "
                   f"[{spec}]{tag}")
 
