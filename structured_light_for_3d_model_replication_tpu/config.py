@@ -508,6 +508,55 @@ class ServingConfig:
     ha_renew_s: float = 0.0
     # follower takeover-poll cadence (sec); 0 = ha_lease_s / 5
     ha_poll_s: float = 0.0
+    # ---- elastic fleet (ISSUE 18, parallel/fleet.py) ----
+    # leader-owned worker autoscaler: the gateway acts as fabric
+    # coordinator and spawns/retires `sl3d worker` processes against a
+    # target computed from live admission signals (queue depth, queue
+    # wait vs SLO, breaker states). Every decision is journaled to the
+    # ledger with its signal snapshot; a promoted follower resumes the
+    # fleet it inherited. Off = PR-15 behaviour (hand-started workers)
+    fleet_enabled: bool = False
+    # fleet size bounds; the decision function clamps its target into
+    # [fleet_min_workers, fleet_max_workers]
+    fleet_min_workers: int = 0
+    fleet_max_workers: int = 4
+    # supervisor tick cadence (sec): signals are sampled, decisions made
+    # and dead workers reaped once per tick
+    fleet_poll_s: float = 0.5
+    # scale-up pressure: target = ceil(pending_items / this) while work
+    # is queued (one worker per this-many grantable views)
+    fleet_scale_up_queue: int = 4
+    # scale-in: retire down to fleet_min_workers only after the queue
+    # has been empty this long (sec) — hysteresis against thrash
+    fleet_scale_in_idle_s: float = 5.0
+    # restart-after-crash backoff: first respawn waits fleet_backoff_s,
+    # doubling per consecutive death up to fleet_backoff_max_s
+    fleet_backoff_s: float = 0.5
+    fleet_backoff_max_s: float = 30.0
+    # flap damping: this many deaths of one rank inside
+    # fleet_flap_window_s marks it FLAPPING — respawns for that rank
+    # hold at the max backoff until the window drains. 0 = disabled
+    fleet_flap_threshold: int = 3
+    fleet_flap_window_s: float = 60.0
+    # fabric bind endpoint for the fleet bridge (netutil grammar, e.g.
+    # ":0" for any port). Empty = loopback 127.0.0.1 with an ephemeral
+    # port; workers then warm the SHARED stage cache on this host's
+    # disk (byte parity with solo by the PR-8 construction)
+    fleet_listen: str = ""
+    # shared secret for spawned workers' hello handshake; empty = open
+    fleet_secret: str = ""
+    # ---- front-door auth (ISSUE 18) ----
+    # per-tenant API keys on /submit: keys are verified against sha256
+    # hashes at rest in <root>/tenants.json (`sl3d tenant add` writes
+    # it). Unknown/missing key = 401, a key presented for a DIFFERENT
+    # tenant = 403, both with machine-readable reasons. Off = open door
+    auth_enabled: bool = False
+    # tenants file path; empty = <root>/tenants.json
+    auth_tenants_file: str = ""
+    # default per-tenant rate limit: submits allowed per window; 0 =
+    # unlimited. Per-tenant overrides live in tenants.json
+    auth_rate_limit: int = 0
+    auth_rate_window_s: float = 60.0
 
 
 @dataclass

@@ -41,6 +41,7 @@ clock.
 """
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import os
@@ -55,7 +56,9 @@ from structured_light_for_3d_model_replication_tpu.parallel.lease import (
     LeaseTable,
 )
 
-__all__ = ["ScanJob", "AdmissionController", "replay_serving", "TERMINAL"]
+__all__ = ["ScanJob", "AdmissionController", "replay_serving", "TERMINAL",
+           "TenantAuth", "RateLimiter", "fold_usage", "hash_key",
+           "write_tenant", "TENANTS_SCHEMA"]
 
 # scan lifecycle (the request's /status surface):
 #   queued -> admitted -> warmed -> assembling -> done|degraded|failed|aborted
@@ -428,6 +431,65 @@ class AdmissionController:
                 n += 1
         return n
 
+    def drop_lane(self, lane: str, reason: str = "worker-dead") -> int:
+        """Immediately steal everything a dead lane/worker holds back to
+        pending — the fleet supervisor's fast path when it REAPS a worker
+        (no need to wait ``lease_s`` for the leases to age out). Safe by
+        the same construction as sweep_expired: the steal bumps each
+        item's generation, so a late complete from the corpse is refused
+        by the exact-triple match."""
+        n = 0
+        with self.lock:
+            for item_id in self.leases.drop_worker(lane):
+                it = self.items.get(item_id)
+                if it is None or it.state != "granted":
+                    continue
+                it.state = "pending"
+                self.ledger.event("steal", item=item_id, worker=lane,
+                                  gen=self.leases.gen(item_id),
+                                  reason=reason)
+                n += 1
+        return n
+
+    def open_breakers(self) -> int:
+        """How many tenants currently have an OPEN circuit breaker — one
+        of the fleet supervisor's scale signals (a breaker storm means
+        failures, not load; scaling out would add fuel)."""
+        with self.lock:
+            return sum(1 for b in self._breakers.values()
+                       if b.opened_at is not None)
+
+    def signals(self) -> dict:
+        """One consistent snapshot of the live scale signals the fleet
+        supervisor decides from (ISSUE 18). Everything here is already
+        exported via /metrics — this is the same data read under one
+        lock so a decision journals a coherent snapshot."""
+        with self.lock:
+            pending = granted = 0
+            for it in self.items.values():
+                if it.state == "pending":
+                    pending += 1
+                elif it.state == "granted":
+                    granted += 1
+            waits = [self.jobs[sid].elapsed_s() for sid in self.queue]
+            waits.sort()
+
+            def pct(p: float) -> float:
+                if not waits:
+                    return 0.0
+                i = min(len(waits) - 1, int(p * (len(waits) - 1)))
+                return round(waits[i], 3)
+
+            return {"queued_scans": len(self.queue),
+                    "active_scans": len(self._active()),
+                    "pending_items": pending,
+                    "granted_items": granted,
+                    "queue_wait_p50_s": pct(0.5),
+                    "queue_wait_p99_s": pct(0.99),
+                    "open_breakers": sum(
+                        1 for b in self._breakers.values()
+                        if b.opened_at is not None)}
+
     def scan_settled(self, scan_id: str) -> bool:
         """True when every item of ``scan_id`` is done or failed — the
         scan is WARMED and ready for its assembly pass."""
@@ -667,3 +729,197 @@ def replay_serving(path: str) -> dict:
             "tenant_fails": tenant_fails, "segments": segments,
             "events": events, "max_epoch": max_epoch,
             "stale_ignored": stale_ignored}
+
+
+def fold_usage(rs: dict) -> dict:
+    """Per-tenant usage metering folded from a :func:`replay_serving`
+    result (ISSUE 18): the /usage surface. Metering reads the SAME
+    epoch-fenced fold that restart-resume and the follower read model
+    use, so a bill can never disagree with what the service actually
+    credited — and a zombie leader's fenced-out lines never meter.
+
+    Returns ``{tenant: {"submitted", "done", "degraded", "failed",
+    "aborted", "shed", "in_flight", "views_completed", "compute_s"}}``
+    where ``compute_s`` sums terminal scans' elapsed_s (queue wait burns
+    SLO budget, so it bills — the same clock /status reports)."""
+    usage: dict[str, dict] = {}
+
+    def row(tenant: str) -> dict:
+        r = usage.get(tenant)
+        if r is None:
+            r = usage[tenant] = {"submitted": 0, "done": 0, "degraded": 0,
+                                 "failed": 0, "aborted": 0, "shed": 0,
+                                 "in_flight": 0, "views_completed": 0,
+                                 "compute_s": 0.0}
+        return r
+
+    scan_tenant: dict[str, str] = {}
+    for sid, r in rs["scans"].items():
+        tenant = r.get("tenant", "") or "anon"
+        scan_tenant[sid] = tenant
+        u = row(tenant)
+        u["submitted"] += 1
+        state = r.get("state", "")
+        if state in ("done", "degraded", "failed", "aborted", "shed"):
+            u[state] += 1
+            u["compute_s"] = round(
+                u["compute_s"] + float(r.get("elapsed_s", 0.0)), 3)
+        elif state != "rejected":
+            u["in_flight"] += 1
+    for item_id in rs["completed"]:
+        sid = item_id.rsplit("/", 1)[0]
+        tenant = scan_tenant.get(sid)
+        if tenant is not None:
+            row(tenant)["views_completed"] += 1
+    return usage
+
+
+# ---- front-door auth (ISSUE 18) -------------------------------------------
+
+TENANTS_SCHEMA = "sl3d-tenants-v1"
+
+
+def hash_key(key: str) -> str:
+    """sha256 of an API key — the only form ever at rest or compared."""
+    return hashlib.sha256(key.encode("utf-8")).hexdigest()
+
+
+class TenantAuth:
+    """Per-tenant API keys, verified against sha256 hashes at rest in
+    ``<root>/tenants.json`` (``sl3d tenant add`` writes it; the plaintext
+    key is printed exactly once at creation). The file is re-read only
+    when its stat changes — key rotation needs no restart — and a
+    missing/unreadable file with auth enabled fails CLOSED (every submit
+    401s) rather than silently opening the door."""
+
+    def __init__(self, path: str, clock=time.monotonic):
+        self.path = path
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._stat: tuple | None = None
+        self._tenants: dict[str, dict] = {}
+
+    def _load(self) -> dict[str, dict]:
+        try:
+            st = os.stat(self.path)
+            key = (st.st_mtime_ns, st.st_size, st.st_ino)
+        except OSError:
+            key = None
+        with self._lock:
+            if key is not None and key == self._stat:
+                return self._tenants
+            tenants: dict[str, dict] = {}
+            if key is not None:
+                try:
+                    with open(self.path, encoding="utf-8") as f:
+                        doc = json.load(f)
+                    if doc.get("schema") == TENANTS_SCHEMA:
+                        tenants = dict(doc.get("tenants") or {})
+                except (OSError, ValueError):
+                    tenants = {}     # unreadable = no keys = fail closed
+            self._stat = key
+            self._tenants = tenants
+            return tenants
+
+    def known(self) -> list[str]:
+        return sorted(self._load())
+
+    def tenant_limits(self, tenant: str) -> tuple[int, float] | None:
+        """Per-tenant (rate_limit, rate_window_s) override from
+        tenants.json, None when the tenant carries none."""
+        rec = self._load().get(tenant)
+        if rec is None or "rate_limit" not in rec:
+            return None
+        return (int(rec.get("rate_limit", 0)),
+                float(rec.get("rate_window_s", 60.0)))
+
+    def check(self, tenant: str, key: str) -> dict | None:
+        """None = authenticated; otherwise a machine-readable rejection
+        body (``reason`` ∈ auth-required | auth-invalid | auth-forbidden
+        — the gateway maps them to 401/401/403). A key that IS valid for
+        a different tenant is 403 (we know who you are — you may not act
+        as someone else); an unknown key is 401."""
+        if not key:
+            return {"reason": "auth-required",
+                    "error": "missing API key (X-API-Key header or "
+                             "api_key field)"}
+        tenants = self._load()
+        h = hash_key(key)
+        rec = tenants.get(tenant)
+        if rec is not None and rec.get("key_sha256") == h:
+            return None
+        for other, orec in tenants.items():
+            if orec.get("key_sha256") == h:
+                return {"reason": "auth-forbidden",
+                        "error": f"key belongs to tenant {other!r}, "
+                                 f"not {tenant!r}"}
+        return {"reason": "auth-invalid",
+                "error": f"unknown API key for tenant {tenant!r}"}
+
+
+def write_tenant(path: str, tenant: str, key: str,
+                 rate_limit: int | None = None,
+                 rate_window_s: float | None = None) -> None:
+    """Add/update one tenant's hashed key in ``tenants.json`` (atomic
+    rewrite; creates the file). CLI-facing — the server only reads."""
+    from structured_light_for_3d_model_replication_tpu.io.atomic import (
+        atomic_write,
+    )
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if doc.get("schema") != TENANTS_SCHEMA:
+            doc = {"schema": TENANTS_SCHEMA, "tenants": {}}
+    except (OSError, ValueError):
+        doc = {"schema": TENANTS_SCHEMA, "tenants": {}}
+    rec = doc["tenants"].setdefault(tenant, {})
+    rec["key_sha256"] = hash_key(key)
+    if rate_limit is not None:
+        rec["rate_limit"] = int(rate_limit)
+    if rate_window_s is not None:
+        rec["rate_window_s"] = float(rate_window_s)
+    with atomic_write(path) as tmp:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+
+
+class RateLimiter:
+    """Per-tenant sliding-window submit limiter, expressed in the quota
+    vocabulary: over the limit answers ``rate-limited`` + retry_after_s
+    (HTTP 429), exactly like ``tenant-queue-quota``. Injectable clock —
+    the 429 matrix unit-tests with zero real sleeps."""
+
+    def __init__(self, limit: int, window_s: float = 60.0,
+                 clock=time.monotonic):
+        self.limit = int(limit)
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._hits: dict[str, list[float]] = {}   # tenant -> admit times
+
+    def allow(self, tenant: str,
+              limit: int | None = None,
+              window_s: float | None = None) -> dict | None:
+        """None = allowed (and counted); otherwise the rejection body.
+        Per-tenant overrides (tenants.json) ride in as arguments."""
+        lim = self.limit if limit is None else int(limit)
+        win = self.window_s if window_s is None else float(window_s)
+        if lim <= 0:
+            return None
+        now = self._clock()
+        with self._lock:
+            hits = self._hits.setdefault(tenant, [])
+            cut = now - win
+            while hits and hits[0] <= cut:
+                hits.pop(0)
+            if len(hits) >= lim:
+                retry = max(0.001, hits[0] + win - now)
+                return {"reason": "rate-limited",
+                        "retry_after_s": round(retry, 3),
+                        "error": (f"tenant {tenant!r} over rate limit "
+                                  f"({lim} submits per {win:g}s)")}
+            hits.append(now)
+            return None

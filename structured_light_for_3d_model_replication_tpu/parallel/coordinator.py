@@ -565,10 +565,18 @@ def _build_items(cfg: Config, sources: list[str], view_keys: list[str],
 def _spawn_worker(rank: int, n: int, port: int, spec_dir: str,
                   cfg_path: str, calib_path: str, target: str, out_dir: str,
                   steps: tuple[str, ...],
-                  fabric: dict | None = None) -> subprocess.Popen:
+                  fabric: dict | None = None, name: str | None = None,
+                  generation: int = 0,
+                  cache_root: str | None = None) -> subprocess.Popen:
+    wname = name or f"w{rank}"
     spec = {"config": cfg_path, "calib": calib_path, "target": target,
             "out": out_dir, "steps": list(steps), "port": port,
-            "worker": f"w{rank}", "num_workers": n}
+            "worker": wname, "num_workers": n}
+    if generation:
+        # fleet respawn stamp (ISSUE 18): the rank's lease identity is
+        # reused, the generation tells report/soak THIS incarnation from
+        # the one the supervisor reaped
+        spec["generation"] = int(generation)
     if fabric:
         # networked mode: dial the real endpoint, authenticate, use the
         # blob fabric as L2 — and warm a PRIVATE L1 root, so each spawned
@@ -576,7 +584,11 @@ def _spawn_worker(rank: int, n: int, port: int, spec_dir: str,
         # traffic, dedup, and locality are real and measurable on one box)
         spec.update(fabric)
         spec["cache_root"] = os.path.join(out_dir,
-                                          f".slscan-cache.w{rank}")
+                                          f".slscan-cache.{wname}")
+    if cache_root:
+        # fleet loopback mode: warm the SERVING store directly (the
+        # shared-disk construction — byte parity is the PR-8 argument)
+        spec["cache_root"] = cache_root
     spec_path = os.path.join(spec_dir, f"worker{rank}.json")
     with open(spec_path, "w") as f:
         json.dump(spec, f, indent=2)

@@ -211,3 +211,15 @@ class LeaderLease:
                 f"epoch {self.epoch} fenced by epoch "
                 f"{int(cur.get('epoch', 0))} "
                 f"(leader {cur.get('owner')!r})")
+
+    def superseded(self) -> bool:
+        """Non-raising :meth:`fence`: True when a newer epoch exists on
+        disk. The fleet supervisor polls this at the top of every tick so
+        a deposed leader's autoscaler stops DECIDING (spawn/retire are
+        side effects no fence on the ledger append can un-run) the moment
+        the takeover lands, not just when its next journal write fails."""
+        try:
+            self.fence()
+        except FencedWrite:
+            return True
+        return False

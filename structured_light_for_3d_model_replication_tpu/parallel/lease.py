@@ -158,6 +158,22 @@ class LeaseTable:
         with self._lock:
             return self._steals.get(item, 0)
 
+    def gen(self, item: str) -> int:
+        """``item``'s current steal generation (the one the next grant
+        will carry) — journaled by the fleet supervisor's observed-dead
+        steal so replay sees the same fence the live table enforces."""
+        with self._lock:
+            return self._gen.get(item, 0)
+
+    def worker_items(self, worker: str) -> list[str]:
+        """Items ``worker`` currently holds leases on. The fleet
+        supervisor reads this before retiring a worker — a retire with
+        zero held leases drains for free; anything held steals away on
+        reap exactly like a death."""
+        with self._lock:
+            return [lease.item for lease in self._active.values()
+                    if lease.worker == worker]
+
 
 class LocalityIndex:
     """Which blob names each worker's L1 already holds, and the grant

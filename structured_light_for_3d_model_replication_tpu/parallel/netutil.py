@@ -20,7 +20,8 @@ blobstore endpoint in the ``hello`` reply) always re-parses.
 """
 from __future__ import annotations
 
-__all__ = ["parse_endpoint", "format_endpoint"]
+__all__ = ["parse_endpoint", "format_endpoint", "worker_tag",
+           "parse_worker_tag"]
 
 
 def parse_endpoint(text: str, default_host: str = "127.0.0.1",
@@ -61,6 +62,28 @@ def format_endpoint(host: str, port: int) -> str:
     if ":" in host and not host.startswith("["):
         return f"[{host}]:{port}"
     return f"{host}:{port}"
+
+
+def worker_tag(worker: str, generation: int = 0) -> str:
+    """Display identity of one worker INCARNATION: ``fw0`` for the first
+    spawn, ``fw0#g2`` for its second respawn. The fleet supervisor reuses
+    the RANK (the lease/ledger identity stays ``fw0`` — steals and grants
+    fence on generations already) while the generation stamp lets ``sl3d
+    report`` tell a healed worker from a flapping one."""
+    g = int(generation)
+    return f"{worker}#g{g}" if g > 0 else str(worker)
+
+
+def parse_worker_tag(tag: str) -> tuple[str, int]:
+    """Inverse of :func:`worker_tag`: ``(worker, generation)``. A tag
+    without a ``#g`` suffix (pre-fleet workers, rank-0 incarnations) is
+    generation 0; a malformed suffix stays part of the name rather than
+    guessing."""
+    s = str(tag or "")
+    base, sep, rest = s.rpartition("#g")
+    if sep and rest.isdigit():
+        return base, int(rest)
+    return s, 0
 
 
 def _port(s: str, original: str) -> int:

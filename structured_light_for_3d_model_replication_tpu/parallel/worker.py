@@ -126,9 +126,11 @@ class CoordClient:
                 f"the handshake (bad or missing coordinator.secret)")
         return resp
 
-    def hello(self, pid: int, inventory=None) -> dict:
+    def hello(self, pid: int, inventory=None, generation: int = 0) -> dict:
         req = {"op": "hello", "worker": self.worker, "pid": pid,
                "addr": self.addr}
+        if generation:
+            req["generation"] = int(generation)
         if self.secret:
             req["secret"] = self.secret
         if inventory:
@@ -185,8 +187,14 @@ class _WorkerCtx:
         self.client = client
         self.heartbeat_s = heartbeat_s
         self.worker = spec["worker"]
+        self.generation = int(spec.get("generation", 0))
         self.steps = tuple(spec["steps"])
-        self.calib = matfile.load_calibration(spec["calib"])
+        # fleet workers (ISSUE 18) serve MANY scans: their spec carries no
+        # scan-level calib, each granted item names its own instead
+        self.calib = (matfile.load_calibration(spec["calib"])
+                      if spec.get("calib") else None)
+        self._calibs: dict[str, object] = {}
+        self._load_calibration = matfile.load_calibration
         self.stats = prof.OverlapStats()
         root = spec.get("cache_root") or os.path.join(spec["out"],
                                                       ".slscan-cache")
@@ -216,8 +224,7 @@ class _WorkerCtx:
             self.cache = StageCache(
                 root, enabled=True,
                 verify=cfg.pipeline.verify_cache, log=lambda *_: None)
-        self._scanner = None
-        self._scanner_built = False
+        self._scanners: dict[str, object] = {}   # calib path -> scanner
         self._last_beat = 0.0
 
     def inventory(self) -> list[str] | None:
@@ -246,16 +253,33 @@ class _WorkerCtx:
             if inv:
                 self.cache.requeue_inventory(inv)
 
-    def scanner(self, src: str):
+    def calib_for(self, path: str):
+        """The item's calibration: the spec-level one when the item names
+        none (PR-8/15 single-scan workers), else loaded once per distinct
+        path — a fleet worker hops between tenants' scans without
+        re-reading calib files."""
+        if not path:
+            if self.calib is None:
+                raise RuntimeError(
+                    f"worker {self.worker}: item carries no calib and the "
+                    f"spec has none either")
+            return self.calib
+        c = self._calibs.get(path)
+        if c is None:
+            c = self._calibs[path] = self._load_calibration(path)
+        return c
+
+    def scanner(self, src: str, calib=None, ckey: str = ""):
         from structured_light_for_3d_model_replication_tpu.pipeline import (
             stages,
         )
 
-        if not self._scanner_built:
-            self._scanner = stages._build_scanner([src], self.calib,
-                                                  self.cfg)
-            self._scanner_built = True
-        return self._scanner
+        sc = self._scanners.get(ckey)
+        if sc is None:
+            sc = stages._build_scanner(
+                [src], self.calib if calib is None else calib, self.cfg)
+            self._scanners[ckey] = sc
+        return sc
 
     def retries(self, lane: str):
         def on_retry(n, e):
@@ -270,6 +294,8 @@ def _do_view(ctx: _WorkerCtx, ispec: dict) -> None:
     from structured_light_for_3d_model_replication_tpu.pipeline import stages
 
     src, key, idx = ispec["src"], ispec["key"], ispec["index"]
+    cpath = ispec.get("calib") or ""
+    calib = ctx.calib_for(cpath)
     policy = stages._retry_policy(ctx.cfg)
     t0 = time.perf_counter()
     frames, texture = stages._retry_stage(
@@ -280,7 +306,8 @@ def _do_view(ctx: _WorkerCtx, ispec: dict) -> None:
     pts, cols = stages._retry_stage(
         "compute",
         lambda: tri.compact_cloud(stages._compute_fired(
-            frames, texture, ctx.calib, ctx.cfg, ctx.scanner(src), src)),
+            frames, texture, calib, ctx.cfg,
+            ctx.scanner(src, calib, cpath), src)),
         policy, ctx.retries("compute"))
     ctx.stats.add("compute", time.perf_counter() - t0, view=idx)
     t0 = time.perf_counter()
@@ -367,6 +394,7 @@ def run_worker(spec_path: str, log=print) -> int:
 
     cfg = load_config(spec["config"])
     worker = spec["worker"]
+    generation = int(spec.get("generation", 0))
     # host tag: rank+pid into every artifact filename this process writes
     # (trace journal, stalls, failures) — N workers share out_dir safely
     tel.set_host_tag(f"{worker}-{os.getpid()}")
@@ -386,6 +414,7 @@ def run_worker(spec_path: str, log=print) -> int:
             run_id=tel.new_run_id(),
             meta={"tool": "worker", "host": tel.host_tag(),
                   "worker": worker, "pid": os.getpid(),
+                  "generation": generation or None,
                   "addr": client.addr or None,
                   "backend": cfg.parallel.backend,
                   "host_cpus": os.cpu_count()})
@@ -399,7 +428,8 @@ def run_worker(spec_path: str, log=print) -> int:
         boot = sorted(f[:-4] for f in os.listdir(root)
                       if f.endswith(".npz"))
     try:
-        hello = client.hello(os.getpid(), inventory=boot)
+        hello = client.hello(os.getpid(), inventory=boot,
+                             generation=generation)
     except PermissionError as e:
         log(f"[worker {worker}] {e}")
         if tracer is not None:
@@ -413,7 +443,8 @@ def run_worker(spec_path: str, log=print) -> int:
     ctx = _WorkerCtx(cfg, spec, client, heartbeat_s,
                      blob_endpoint=blob_endpoint)
     prev_hook = prof.set_heartbeat_hook(ctx.heartbeat)
-    log(f"[worker {worker}] joined run {hello.get('run_id')} "
+    log(f"[worker {netutil.worker_tag(worker, generation)}] joined run "
+        f"{hello.get('run_id')} "
         f"(pid {os.getpid()}, addr {client.addr or '?'}, "
         f"lease {hello.get('lease_s')}s"
         + (f", blob {blob_endpoint}" if blob_endpoint else "") + ")")

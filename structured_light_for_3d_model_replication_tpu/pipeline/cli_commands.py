@@ -18,6 +18,9 @@ implementation.
                          with cross-tenant batching, weighted-fair
                          admission, per-request SLOs, and Prometheus
                          /metrics (pipeline/serving.py)
+  tenant        (new)    manage the serve front door's tenants: mint/rotate
+                         per-tenant API keys (sha256 at rest), set rate
+                         limits — `serve --auth` enforces them
   viewer        (A22)    web viewer for per-stage clouds/meshes (the operator
                          front-end: merge previews, cleanup inspection)
   scan          tab 1    capture one structured-light sequence
@@ -384,6 +387,46 @@ def register(sub: argparse._SubParsersAction, add_config_args) -> None:
     p.add_argument("--ha-lease", type=float, default=None,
                    help="leader lease lifetime in seconds — the failover "
                         "bound (default: serving.ha_lease_s)")
+    p.add_argument("--fleet", action="store_true", default=None,
+                   help="elastic worker fleet (serving.fleet_enabled): "
+                        "the leader gateway autoscales `sl3d worker` "
+                        "processes against live queue signals, journals "
+                        "every decision to the ledger, and respawns "
+                        "killed workers under capped backoff with flap "
+                        "damping; scale-in drains by lease expiry")
+    p.add_argument("--fleet-max", type=int, default=None,
+                   help="fleet size ceiling (default: "
+                        "serving.fleet_max_workers)")
+    p.add_argument("--fleet-min", type=int, default=None,
+                   help="fleet size floor kept warm even when idle "
+                        "(default: serving.fleet_min_workers)")
+    p.add_argument("--auth", action="store_true", default=None,
+                   help="authenticated front door (serving.auth_enabled): "
+                        "/submit requires a per-tenant API key from "
+                        "<root>/tenants.json (`sl3d tenant add` mints "
+                        "one) and enforces per-tenant rate limits; "
+                        "metered usage served at /usage")
+    add_config_args(p)
+
+    p = sub.add_parser(
+        "tenant",
+        help="manage the authenticated front door's tenants: `tenant add "
+             "<root> <name>` mints an API key (printed ONCE; only its "
+             "sha256 lands in <root>/tenants.json), `tenant list <root>` "
+             "shows who exists")
+    p.add_argument("action", choices=("add", "list"))
+    p.add_argument("root", help="service state directory (the one "
+                                "`sl3d serve` runs over)")
+    p.add_argument("name", nargs="?", default=None,
+                   help="tenant name (add)")
+    p.add_argument("--key", default=None,
+                   help="use this key instead of minting one (key "
+                        "rotation; still stored hashed)")
+    p.add_argument("--rate-limit", type=int, default=None,
+                   help="per-tenant submits allowed per window (overrides "
+                        "serving.auth_rate_limit for this tenant)")
+    p.add_argument("--rate-window", type=float, default=None,
+                   help="sliding window seconds for --rate-limit")
     add_config_args(p)
 
     p = sub.add_parser("viewer",
@@ -855,8 +898,51 @@ def _cmd_serve(args) -> int:
         cfg.serving.ha_enabled = True
     if args.ha_lease is not None:
         cfg.serving.ha_lease_s = args.ha_lease
+    if args.fleet:
+        cfg.serving.fleet_enabled = True
+    if args.fleet_max is not None:
+        cfg.serving.fleet_max_workers = args.fleet_max
+    if args.fleet_min is not None:
+        cfg.serving.fleet_min_workers = args.fleet_min
+    if args.auth:
+        cfg.serving.auth_enabled = True
     return serving.serve(args.root, cfg=cfg,
                          ready_file=args.ready_file)
+
+
+@_runner("tenant")
+def _cmd_tenant(args) -> int:
+    from structured_light_for_3d_model_replication_tpu.parallel import (
+        admission,
+    )
+
+    path = os.path.join(args.root, "tenants.json")
+    if args.action == "list":
+        auth = admission.TenantAuth(path)
+        names = auth.known()
+        if not names:
+            print(f"no tenants in {path}")
+            return 0
+        for name in names:
+            lim = auth.tenant_limits(name)
+            extra = (f"  rate {lim[0]}/{lim[1]:g}s" if lim else "")
+            print(f"{name}{extra}")
+        return 0
+    if not args.name:
+        print("tenant add needs a name", file=sys.stderr)
+        return 1
+    import secrets
+
+    key = args.key or secrets.token_hex(16)
+    os.makedirs(args.root, exist_ok=True)
+    admission.write_tenant(path, args.name, key,
+                           rate_limit=args.rate_limit,
+                           rate_window_s=args.rate_window)
+    # the only time the plaintext exists outside the client: tenants.json
+    # holds sha256 only, so a leaked state dir leaks no credentials
+    print(f"tenant {args.name!r} written to {path}")
+    print(f"API key (save it — shown once): {key}")
+    return 0
 
 
 @_runner("capture-serve")

@@ -37,6 +37,7 @@ import json
 import os
 from dataclasses import dataclass, field
 
+from structured_light_for_3d_model_replication_tpu.parallel import netutil
 from structured_light_for_3d_model_replication_tpu.utils import telemetry
 
 __all__ = ["RunAnalysis", "analyze_run", "render_report", "validate_journal",
@@ -309,6 +310,11 @@ def merge_host_timeline(out_dir: str,
         meta = j["meta"] or {}
         host = (meta.get("host") or meta.get("tool")
                 or os.path.basename(path))
+        # fleet respawns reuse the rank but bump the generation stamp:
+        # `fw0#g2` is the same lane healed twice, not three workers —
+        # the healed-vs-flapping distinction at a glance
+        if meta.get("generation"):
+            host = netutil.worker_tag(host, int(meta["generation"]))
         # networked workers advertise the address they dialed from; show
         # it in the host column so a pod run reads `w0 10.0.0.2:41234`
         if meta.get("addr"):

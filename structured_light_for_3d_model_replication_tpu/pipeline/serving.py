@@ -8,7 +8,11 @@ mesh — built by composing layers this repo already proved one at a time:
   gateway   stdlib ``ThreadingHTTPServer`` speaking JSON, the same
             no-deps transport discipline as the PR-8 coordinator's
             newline-JSON wire protocol. ``/submit`` · ``/status/<id>`` ·
-            ``/result/<id>`` · ``/metrics`` · ``/healthz``.
+            ``/result/<id>`` · ``/metrics`` · ``/healthz`` · ``/usage``.
+            With ``serving.auth_enabled`` the door checks per-tenant API
+            keys (sha256 at rest in ``<root>/tenants.json``; 401/403
+            machine-readable reasons) and per-tenant sliding-window rate
+            limits (429, the quota vocabulary) before anything else.
   admission ``parallel/admission.py``: per-tenant quotas (a submit over
             quota is a 429 at the door) + weighted-fair scheduling over
             the multi-scan generalization of the PR-8 lease/ledger —
@@ -127,7 +131,10 @@ from structured_light_for_3d_model_replication_tpu.io.atomic import (
 )
 from structured_light_for_3d_model_replication_tpu.parallel.admission import (
     AdmissionController,
+    RateLimiter,
     ScanJob,
+    TenantAuth,
+    fold_usage,
     replay_serving,
 )
 from structured_light_for_3d_model_replication_tpu.parallel.admission import (
@@ -159,9 +166,12 @@ REQUEST_SCHEMA = "sl3d-request-v1"
 # leader when the body carries one), 409 = durable-id conflict,
 # 400 = malformed
 _REASON_HTTP = {"tenant-queue-quota": 429, "queue-full": 429,
+                "rate-limited": 429,
                 "draining": 503, "stopped": 503, "crashed": 503,
                 "circuit-open": 503, "transient": 503,
                 "not-leader": 503,
+                "auth-required": 401, "auth-invalid": 401,
+                "auth-forbidden": 403,
                 "scan-id-conflict": 409, "bad-request": 400}
 
 
@@ -257,6 +267,21 @@ class ScanService:
                             contrast_val=self.cfg.decode.contrast_val)
         self._scans: dict[str, _ScanCtx] = {}
         self._scanners: dict[tuple, object] = {}   # scanner_key -> scanner
+        # elastic fleet (ISSUE 18): the supervisor belongs to whichever
+        # reign owns the engine — solo start() builds it, _promote
+        # rebuilds it from the replayed ledger, _demote tears it down
+        self.fleet = None
+        # front-door auth (ISSUE 18): per-tenant API keys + rate limits.
+        # Disabled (the default) costs /submit ONE attribute check — the
+        # differential contract the fleet bench stamps
+        self._auth: TenantAuth | None = None
+        self._rlim: RateLimiter | None = None
+        if scfg.auth_enabled:
+            self._auth = TenantAuth(
+                scfg.auth_tenants_file
+                or os.path.join(self.root, "tenants.json"))
+            self._rlim = RateLimiter(scfg.auth_rate_limit,
+                                     scfg.auth_rate_window_s)
         self._scan_lock = threading.Lock()
         self._assembly_q: list[str] = []
         self._assembly_cv = threading.Condition()
@@ -446,6 +471,7 @@ class ScanService:
         if scfg.durable:
             self._resume()
         self._threads.extend(self._start_engine_threads())
+        self._start_fleet()
         self.log(f"[serve] service up (run {self.run_id}) root={self.root}")
 
     def _start_engine_threads(self) -> list[threading.Thread]:
@@ -545,6 +571,10 @@ class ScanService:
             self.role = "leader"
         self.registry.inc("sl3d_serve_takeovers_total")
         self._publish_serve_json()
+        # the fleet is a LEADER organ: the new supervisor replays the
+        # shared ledger's fleet events and respawns the inherited ranks
+        # (bumped generations) under OUR epoch's fence
+        self._start_fleet()
 
     def _request_demote(self, why: str) -> None:
         """Thread-safe, idempotent-per-reign demotion trigger — safe to
@@ -561,6 +591,10 @@ class ScanService:
         self.log(f"[serve] DEPOSED (epoch {self.election.epoch}): {why} "
                  f"— demoting to follower")
         self._lead_stop.set()
+        # fleet first: its workers hold leases in the adm this teardown
+        # is about to close, and its supervisor journals through a fence
+        # that already rejects us
+        self._stop_fleet()
         with self._assembly_cv:
             self._assembly_cv.notify_all()
         # an in-flight assembly is left to FINISH, not aborted: its
@@ -727,6 +761,7 @@ class ScanService:
 
     def close(self) -> None:
         self._stop.set()
+        self._stop_fleet()
         with self._assembly_cv:
             self._assembly_cv.notify_all()
         for t in self._threads + self._reign_threads:
@@ -765,6 +800,45 @@ class ScanService:
         if self.exit_on_crash:
             os._exit(137)
 
+    # ---- elastic fleet (ISSUE 18) ----------------------------------------
+
+    def _start_fleet(self) -> None:
+        """Spin up this reign's fleet supervisor (no-op unless
+        ``serving.fleet_enabled``). Import is lazy — a fleet-less service
+        never loads the coordinator stack."""
+        if not self.cfg.serving.fleet_enabled or self.adm is None:
+            return
+        from structured_light_for_3d_model_replication_tpu.parallel import (
+            fleet as fleet_mod,
+        )
+        sup = fleet_mod.FleetSupervisor(
+            self.root, self.cfg, self.adm, self.store_root,
+            steps=self._engine_steps(), log=self.log,
+            registry=self.registry, lease=self.election,
+            on_demote=self._request_demote, on_crash=self._crash,
+            run_id=self.run_id)
+        sup.start()
+        self.fleet = sup
+
+    def _stop_fleet(self) -> None:
+        sup, self.fleet = self.fleet, None
+        if sup is not None:
+            try:
+                sup.close()
+            except Exception as e:
+                self.log(f"[serve] fleet teardown error: "
+                         f"{type(e).__name__}: {e}")
+
+    def usage(self, tenant: str | None = None) -> dict:
+        """Per-tenant usage metering: :func:`fold_usage` over the SAME
+        cached epoch-fenced ledger fold the follower read model uses —
+        the bill agrees with what the service credited, on leaders and
+        followers alike."""
+        u = fold_usage(self._follower_view())
+        if tenant is not None:
+            u = {tenant: u[tenant]} if tenant in u else {}
+        return {"schema": "sl3d-usage-v1", "tenants": u}
+
     # ---- submit ----------------------------------------------------------
 
     def submit(self, payload: dict) -> tuple[bool, dict]:
@@ -786,6 +860,25 @@ class ScanService:
                                       if self.phase == "draining"
                                       else self.phase),
                            "retry_after_s": max(1.0, scfg.drain_budget_s)}
+        if self._auth is not None:
+            # the front door (ISSUE 18): identity before anything else —
+            # an unauthenticated caller learns nothing, not even where
+            # the leader is. Reasons map to 401/403; a valid key then
+            # passes the per-tenant sliding-window rate limit (429 in
+            # the same quota vocabulary as tenant-queue-quota)
+            t0 = _safe_id(payload.get("tenant"), "anon")
+            err = self._auth.check(t0, str(payload.get("api_key") or ""))
+            if err is not None:
+                self.registry.inc("sl3d_serve_auth_denied_total",
+                                  tenant=t0)
+                return False, dict(err, tenant=t0)
+            limits = self._auth.tenant_limits(t0)
+            err = (self._rlim.allow(t0, *limits) if limits
+                   else self._rlim.allow(t0))
+            if err is not None:
+                self.registry.inc("sl3d_serve_rate_limited_total",
+                                  tenant=t0)
+                return False, dict(err, tenant=t0)
         adm = self.adm
         if self.ha and (self.role != "leader" or adm is None):
             # HA follower / mid-transition member: machine-readable
@@ -1435,6 +1528,12 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError) as e:
             return self._json(400, {"error": f"bad JSON body: {e}",
                                     "reason": "bad-request"})
+        if isinstance(payload, dict) and not payload.get("api_key"):
+            # header form of the credential; the body field wins so a
+            # scripted client can carry both through one JSON blob
+            key = self.headers.get("X-API-Key")
+            if key:
+                payload["api_key"] = key
         try:
             faults.fire("http.submit",
                         item=str(payload.get("tenant") or ""))
@@ -1471,6 +1570,10 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/metrics":
             return self._bytes(200, self.service.metrics_text().encode(),
                                "text/plain; version=0.0.4")
+        if path == "/usage":
+            q = urllib.parse.parse_qs(parsed.query)
+            tenant = (q.get("tenant") or [None])[0]
+            return self._json(200, self.service.usage(tenant))
         if path.startswith("/status/"):
             d = self.service.status(path[len("/status/"):])
             if d is None:
@@ -1523,7 +1626,7 @@ def start_gateway(root: str, cfg: Config | None = None, log=print,
             json.dump(info, f)
     log(f"[serve] listening on http://{host}:{port} role={svc.role} "
         f"(endpoints: /submit /status/<id> /result/<id> /metrics "
-        f"/healthz)")
+        f"/healthz /usage)")
     return httpd, svc
 
 

@@ -59,6 +59,17 @@ supplies the two halves of making that chain resilient:
                          manufactured: the lease expires mid-stall, a
                          standby steals it, and the waker's next append
                          is fenced (parallel/election.py)
+   ``fleet.decide``      fleet-supervisor decision tick (item is the tick
+                         counter): a transient skips the tick, a crash
+                         fells the gateway exactly like an engine-loop
+                         crash — the kill matrix's supervisor-death arm
+                         (parallel/fleet.py)
+   ``worker.spawn``      fleet worker spawn, fired BETWEEN the journaled
+                         spawn decision and the Popen (item is the
+                         worker name, e.g. ``fw0``): a transient retries
+                         under the rank's backoff, a crash leaves a
+                         journaled-but-unspawned rank — exactly what the
+                         next resume respawns (parallel/fleet.py)
    ====================  ====================================================
 
 2. **Retry/quarantine toolkit** — the exception classifier

@@ -1,0 +1,614 @@
+"""Unit tests for the elastic fleet + authenticated front door (ISSUE 18).
+
+Contract under test, all on injectable clocks (zero real sleeps):
+
+  * :func:`fleet.decide` is pure and ordered — floor first, breaker
+    storms never scale up, backlog scales toward ceil(backlog/per) plus
+    queued scans, in-flight work holds, scale-in needs sustained idle;
+  * :class:`fleet.FlapTracker` doubles backoff per death inside the
+    window, caps it, pins a flapping rank at the cap, and forgets clean
+    retirements;
+  * the supervisor's decisions journal BEFORE they enact and are
+    epoch-fenced: a superseded lease stops the tick cold, a FencedWrite
+    from the ledger propagates (the loop's demote trigger);
+  * SIGKILL-shaped death (a reaped proc) drops the lane's leases,
+    journals the exit, and respawns the RANK at gen+1 under backoff;
+  * :func:`fleet.replay_fleet` folds the ledger back to the live final
+    state and ignores stale-epoch lines a zombie raced in;
+  * the front door: TenantAuth 401/403 matrix, RateLimiter 429s with
+    retry_after_s, the ScanService wiring of both, and `/usage` metering
+    (``fold_usage``) agreeing with an AdmissionController-driven ledger.
+"""
+import json
+import os
+
+import pytest
+
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.parallel import fleet
+from structured_light_for_3d_model_replication_tpu.parallel.admission import (
+    AdmissionController,
+    RateLimiter,
+    ScanJob,
+    TenantAuth,
+    fold_usage,
+    hash_key,
+    replay_serving,
+    write_tenant,
+)
+from structured_light_for_3d_model_replication_tpu.parallel.election import (
+    FencedWrite,
+)
+from structured_light_for_3d_model_replication_tpu.utils import faults
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture()
+def clock():
+    return FakeClock()
+
+
+def P(**kw):
+    return fleet.FleetParams(**kw)
+
+
+SIG0 = {"queued_scans": 0, "active_scans": 0, "pending_items": 0,
+        "granted_items": 0, "queue_wait_p50_s": 0.0,
+        "queue_wait_p99_s": 0.0, "open_breakers": 0}
+
+
+def sig(**kw):
+    s = dict(SIG0)
+    s.update(kw)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# decide(): the pure scaling function
+# ---------------------------------------------------------------------------
+
+def test_decide_scales_up_on_backlog():
+    d = fleet.decide(sig(pending_items=9), live=0, idle_s=0.0,
+                     p=P(scale_up_queue=4, max_workers=4))
+    assert d["action"] == "scale-up"
+    assert d["target"] == 3          # ceil(9/4)
+
+
+def test_decide_counts_queued_scans_as_future_backlog():
+    d = fleet.decide(sig(pending_items=1, queued_scans=2), live=0,
+                     idle_s=0.0, p=P(scale_up_queue=4, max_workers=8))
+    assert d["action"] == "scale-up"
+    assert d["target"] == 3          # ceil(1/4) + 2 queued
+
+
+def test_decide_clamps_to_max():
+    d = fleet.decide(sig(pending_items=1000), live=0, idle_s=0.0,
+                     p=P(max_workers=2))
+    assert d["target"] == 2
+
+
+def test_decide_holds_below_floor_never():
+    d = fleet.decide(SIG0, live=0, idle_s=0.0, p=P(min_workers=1))
+    assert d["action"] == "scale-up"
+    assert d["target"] == 1
+
+
+def test_decide_breaker_storm_never_scales_up():
+    d = fleet.decide(sig(pending_items=50, open_breakers=1), live=1,
+                     idle_s=0.0, p=P(max_workers=8))
+    assert d["action"] == "hold"
+    assert d["target"] == 1
+
+
+def test_decide_holds_while_work_in_flight():
+    for s in (sig(active_scans=1), sig(granted_items=2)):
+        d = fleet.decide(s, live=3, idle_s=100.0, p=P())
+        assert d["action"] == "hold", s
+        assert d["target"] == 3
+
+
+def test_decide_scale_in_requires_sustained_idle():
+    p = P(scale_in_idle_s=5.0)
+    assert fleet.decide(SIG0, live=2, idle_s=4.9, p=p)["action"] == "hold"
+    d = fleet.decide(SIG0, live=2, idle_s=5.0, p=p)
+    assert d["action"] == "scale-in"
+    assert d["target"] == 0
+
+
+def test_decide_never_scales_in_below_floor():
+    d = fleet.decide(SIG0, live=2, idle_s=60.0, p=P(min_workers=1))
+    assert d["target"] == 1
+
+
+# ---------------------------------------------------------------------------
+# FlapTracker: backoff caps + flap damping
+# ---------------------------------------------------------------------------
+
+def test_backoff_doubles_per_death_and_caps(clock):
+    ft = fleet.FlapTracker(window_s=600.0, threshold=0, backoff_s=0.5,
+                           backoff_max_s=4.0, clock=clock)
+    assert ft.backoff(0) == 0.0
+    got = []
+    for _ in range(5):
+        ft.record_exit(0)
+        got.append(ft.backoff(0))
+        clock.advance(1.0)
+    assert got == [0.5, 1.0, 2.0, 4.0, 4.0]      # capped
+
+
+def test_flapping_pins_to_max_backoff(clock):
+    ft = fleet.FlapTracker(window_s=60.0, threshold=3, backoff_s=0.5,
+                           backoff_max_s=30.0, clock=clock)
+    ft.record_exit(1)
+    ft.record_exit(1)
+    assert not ft.flapping(1)
+    ft.record_exit(1)
+    assert ft.flapping(1)
+    assert ft.backoff(1) == 30.0
+
+
+def test_window_drain_resets_history(clock):
+    ft = fleet.FlapTracker(window_s=60.0, threshold=3, backoff_s=0.5,
+                           backoff_max_s=30.0, clock=clock)
+    for _ in range(3):
+        ft.record_exit(2)
+    assert ft.flapping(2)
+    clock.advance(61.0)
+    assert not ft.flapping(2)
+    assert ft.backoff(2) == 0.0
+
+
+def test_clean_retirement_clears_history(clock):
+    ft = fleet.FlapTracker(window_s=600.0, threshold=3, backoff_s=0.5,
+                           backoff_max_s=30.0, clock=clock)
+    ft.record_exit(0)
+    ft.record_exit(0)
+    ft.record_exit(0, clean=True)
+    assert ft.deaths(0) == 0
+    assert ft.backoff(0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# FleetSupervisor: decision loop on fakes (no sockets, no processes)
+# ---------------------------------------------------------------------------
+
+class FakeProc:
+    _pid = 40000
+
+    def __init__(self):
+        FakeProc._pid += 1
+        self.pid = FakeProc._pid
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+    def terminate(self):
+        if self.returncode is None:
+            self.returncode = 143
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+    def kill(self):
+        self.returncode = 137
+
+
+class FakeLeases:
+    def worker_items(self, worker):
+        return []
+
+
+class FakeLedger:
+    def __init__(self):
+        self.events = []
+        self.fence_exc = None
+
+    def event(self, type_, **fields):
+        if self.fence_exc is not None:
+            raise self.fence_exc
+        self.events.append(dict(fields, type=type_))
+
+    def actions(self):
+        return [e["action"] for e in self.events if e["type"] == "fleet"]
+
+
+class FakeAdm:
+    def __init__(self):
+        self.ledger = FakeLedger()
+        self.leases = FakeLeases()
+        self.sig = dict(SIG0)
+        self.dropped = []
+
+    def signals(self):
+        return dict(self.sig)
+
+    def drop_lane(self, lane, reason="worker-dead"):
+        self.dropped.append((lane, reason))
+        return 0
+
+
+class FakeLease:
+    def __init__(self):
+        self.superseded_now = False
+
+    def superseded(self):
+        return self.superseded_now
+
+
+def make_sup(tmp_path, clock, adm=None, lease=None, **scfg_over):
+    cfg = Config()
+    cfg.serving.fleet_enabled = True
+    cfg.serving.fleet_max_workers = 4
+    cfg.serving.fleet_scale_up_queue = 4
+    cfg.serving.fleet_backoff_s = 0.5
+    cfg.serving.fleet_backoff_max_s = 4.0
+    cfg.serving.fleet_flap_threshold = 3
+    cfg.serving.fleet_flap_window_s = 60.0
+    for k, v in scfg_over.items():
+        setattr(cfg.serving, k, v)
+    adm = adm or FakeAdm()
+    events = {"demote": [], "crash": []}
+    sup = fleet.FleetSupervisor(
+        str(tmp_path), cfg, adm, str(tmp_path / "cache"),
+        steps=("statistical",), log=lambda m: None, lease=lease,
+        on_demote=lambda why: events["demote"].append(why),
+        on_crash=lambda where, e: events["crash"].append((where, e)),
+        clock=clock, spawn_fn=lambda rank, gen: FakeProc())
+    return sup, adm, events
+
+
+def test_supervisor_scales_up_and_journals_signals(tmp_path, clock):
+    sup, adm, _ = make_sup(tmp_path, clock)
+    adm.sig = sig(pending_items=9)
+    sup._tick()
+    st = sup.state()
+    assert st["live"] == [0, 1, 2]           # ceil(9/4) = 3
+    assert st["target"] == 3
+    acts = adm.ledger.actions()
+    assert acts == ["scale-up", "spawn", "spawn", "spawn"]
+    # the decision carries the deciding snapshot
+    ev = adm.ledger.events[0]
+    assert ev["signals"]["pending_items"] == 9
+
+
+def test_supervisor_death_respawns_rank_at_next_generation(tmp_path,
+                                                           clock):
+    sup, adm, _ = make_sup(tmp_path, clock)
+    adm.sig = sig(pending_items=4)
+    sup._tick()
+    assert sup.state()["live"] == [0]
+    sup._workers[0]["proc"].returncode = 137      # SIGKILL-shaped
+    sup._tick()
+    assert ("fw0", "worker-exit-137") in adm.dropped
+    assert "worker-exit" in adm.ledger.actions()
+    assert sup.state()["live"] == []
+    assert sup.state()["respawning"] == [0]
+    clock.advance(0.5)                            # past first backoff
+    sup._tick()
+    st = sup.state()
+    assert st["live"] == [0]
+    assert st["generations"][0] == 1              # healed, not new
+    assert "respawn" in adm.ledger.actions()
+
+
+def test_supervisor_flap_damping_caps_respawn_rate(tmp_path, clock):
+    sup, adm, _ = make_sup(tmp_path, clock)
+    adm.sig = sig(pending_items=1)
+    sup._tick()
+    for _ in range(3):                            # three quick deaths
+        sup._workers[0]["proc"].returncode = 137
+        sup._tick()
+        clock.advance(sup.flap.backoff_max_s)
+        sup._tick()
+    exits = [e for e in adm.ledger.events
+             if e["type"] == "fleet" and e["action"] == "worker-exit"]
+    assert exits[-1]["flapping"] is True
+    assert exits[-1]["backoff_s"] == sup.flap.backoff_max_s
+
+
+def test_supervisor_scale_in_retires_then_reaps_clean(tmp_path, clock):
+    sup, adm, _ = make_sup(tmp_path, clock, fleet_scale_in_idle_s=5.0)
+    adm.sig = sig(pending_items=8)
+    sup._tick()
+    assert sup.state()["live"] == [0, 1]
+    adm.sig = dict(SIG0)
+    clock.advance(5.0)
+    sup._tick()
+    st = sup.state()
+    assert st["target"] == 0
+    assert st["retiring"] == ["fw0", "fw1"]
+    assert sup.is_retiring("fw0")                 # the bridge's question
+    for w in list(sup._workers.values()):
+        w["proc"].returncode = 0                  # clean shutdown exit
+    sup._tick()
+    assert sup.state()["live"] == []
+    acts = adm.ledger.actions()
+    assert acts.count("retire") == 2
+    assert acts.count("retired") == 2
+    assert "worker-exit" not in acts              # retirement != death
+
+
+def test_retiring_worker_killed_midway_is_not_respawned(tmp_path, clock):
+    sup, adm, _ = make_sup(tmp_path, clock, fleet_scale_in_idle_s=5.0)
+    adm.sig = sig(pending_items=4)
+    sup._tick()
+    adm.sig = dict(SIG0)
+    clock.advance(5.0)
+    sup._tick()                                   # retire fw0
+    sup._workers[0]["proc"].returncode = 137      # killed while draining
+    sup._tick()
+    assert sup.state()["live"] == []
+    assert sup.state()["respawning"] == []        # wanted gone: stays gone
+
+
+def test_superseded_lease_stops_decisions_and_demotes(tmp_path, clock):
+    lease = FakeLease()
+    sup, adm, events = make_sup(tmp_path, clock, lease=lease)
+    adm.sig = sig(pending_items=9)
+    lease.superseded_now = True
+    sup._tick()
+    assert sup.state()["live"] == []              # nothing enacted
+    assert adm.ledger.actions() == []             # nothing journaled
+    assert events["demote"]                       # the service was told
+    assert sup._stop.is_set()
+
+
+def test_fenced_journal_write_propagates(tmp_path, clock):
+    sup, adm, _ = make_sup(tmp_path, clock)
+    adm.sig = sig(pending_items=4)
+    adm.ledger.fence_exc = FencedWrite("stale epoch 1 < 2")
+    with pytest.raises(FencedWrite):
+        sup._tick()
+    assert sup.state()["live"] == []              # journal-first: no spawn
+
+
+def test_spawn_fault_retries_under_backoff(tmp_path, clock):
+    faults.configure("worker.spawn:transient@1")
+    try:
+        sup, adm, _ = make_sup(tmp_path, clock)
+        adm.sig = sig(pending_items=4)
+        sup._tick()
+        assert sup.state()["live"] == []
+        assert "spawn-failed" in adm.ledger.actions()
+        assert sup.state()["respawning"] == [0]
+        clock.advance(1.0)
+        sup._tick()                               # retry succeeds
+        assert sup.state()["live"] == [0]
+    finally:
+        faults.reset()
+
+
+def test_decide_fault_crash_is_supervisor_fatal(tmp_path, clock):
+    faults.configure("fleet.decide:crash@1")
+    try:
+        sup, adm, _ = make_sup(tmp_path, clock)
+        adm.sig = sig(pending_items=4)
+        with pytest.raises(faults.InjectedCrash):
+            sup._tick()
+        assert sup.state()["live"] == []
+    finally:
+        faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# replay_fleet: the scaling history folds back, epoch-fenced
+# ---------------------------------------------------------------------------
+
+def _ledger_lines(tmp_path, lines):
+    path = str(tmp_path / "ledger.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def test_replay_fleet_reproduces_final_state(tmp_path, clock):
+    sup, adm, _ = make_sup(tmp_path, clock)
+    real = AdmissionController(str(tmp_path / "ledger.jsonl"), "r1",
+                               log=lambda m: None)
+    sup.adm = adm          # keep fakes for procs; journal to a REAL ledger
+    adm.ledger = real.ledger
+    adm.sig = sig(pending_items=8)
+    sup._tick()                                   # spawn fw0, fw1
+    sup._workers[0]["proc"].returncode = 137
+    sup._tick()                                   # fw0 dies
+    clock.advance(0.5)
+    sup._tick()                                   # fw0 respawns gen 1
+    real.close()
+    rs = fleet.replay_fleet(str(tmp_path / "ledger.jsonl"))
+    st = sup.state()
+    assert rs["live"] == st["live"] == [0, 1]
+    assert rs["target"] == st["target"] == 2
+    assert rs["generations"][0] == st["generations"][0] == 1
+    assert rs["generations"][1] == 0
+
+
+def test_replay_fleet_ignores_stale_epoch_lines(tmp_path):
+    path = _ledger_lines(tmp_path, [
+        {"type": "fleet", "epoch": 2, "action": "spawn", "rank": 0,
+         "gen": 3, "target": 1},
+        # a zombie's raced-in line from the deposed epoch 1: ignored
+        {"type": "fleet", "epoch": 1, "action": "spawn", "rank": 7,
+         "gen": 9, "target": 5},
+        {"type": "fleet", "epoch": 2, "action": "worker-exit", "rank": 0,
+         "gen": 3},
+    ])
+    rs = fleet.replay_fleet(path)
+    assert rs["live"] == []
+    assert rs["target"] == 1
+    assert rs["stale_ignored"] == 1
+    assert 7 not in rs["generations"]
+
+
+def test_replay_fleet_tolerates_torn_tail(tmp_path):
+    path = _ledger_lines(tmp_path, [
+        {"type": "fleet", "action": "spawn", "rank": 0, "gen": 0,
+         "target": 1},
+    ])
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"type": "fleet", "action": "spawn", "ra')   # torn
+    rs = fleet.replay_fleet(path)
+    assert rs["live"] == [0]
+
+
+# ---------------------------------------------------------------------------
+# front door: TenantAuth 401/403, RateLimiter 429
+# ---------------------------------------------------------------------------
+
+def test_auth_matrix(tmp_path):
+    path = str(tmp_path / "tenants.json")
+    write_tenant(path, "alice", "alice-key")
+    write_tenant(path, "bob", "bob-key")
+    auth = TenantAuth(path)
+    assert auth.check("alice", "alice-key") is None
+    assert auth.check("alice", "")["reason"] == "auth-required"
+    assert auth.check("alice", "nope")["reason"] == "auth-invalid"
+    # a key valid for SOMEONE is 403, not 401: identity known, role wrong
+    assert auth.check("alice", "bob-key")["reason"] == "auth-forbidden"
+    assert auth.check("mallory", "mallory")["reason"] == "auth-invalid"
+    assert sorted(auth.known()) == ["alice", "bob"]
+
+
+def test_auth_fails_closed_without_file(tmp_path):
+    auth = TenantAuth(str(tmp_path / "missing.json"))
+    assert auth.check("alice", "any")["reason"] == "auth-invalid"
+
+
+def test_auth_file_reload_on_rotation(tmp_path):
+    path = str(tmp_path / "tenants.json")
+    write_tenant(path, "alice", "old-key")
+    auth = TenantAuth(path)
+    assert auth.check("alice", "old-key") is None
+    write_tenant(path, "alice", "new-key")       # atomic rewrite: new stat
+    assert auth.check("alice", "old-key")["reason"] == "auth-invalid"
+    assert auth.check("alice", "new-key") is None
+
+
+def test_tenants_file_stores_hashes_only(tmp_path):
+    path = str(tmp_path / "tenants.json")
+    write_tenant(path, "alice", "super-secret", rate_limit=5)
+    raw = open(path, encoding="utf-8").read()
+    assert "super-secret" not in raw
+    assert hash_key("super-secret") in raw
+
+
+def test_rate_limiter_sliding_window(clock):
+    rl = RateLimiter(2, window_s=60.0, clock=clock)
+    assert rl.allow("t") is None
+    assert rl.allow("t") is None
+    rej = rl.allow("t")
+    assert rej["reason"] == "rate-limited"
+    assert rej["retry_after_s"] > 0
+    assert rl.allow("other") is None              # per-tenant windows
+    clock.advance(61.0)
+    assert rl.allow("t") is None                  # window drained
+
+
+def test_rate_limiter_per_tenant_override(clock):
+    rl = RateLimiter(0, clock=clock)              # 0 = unlimited default
+    for _ in range(10):
+        assert rl.allow("free") is None
+    assert rl.allow("capped", 1, 60.0) is None
+    assert rl.allow("capped", 1, 60.0)["reason"] == "rate-limited"
+
+
+# ---------------------------------------------------------------------------
+# service-level wiring: submit 401/403/429 + /usage fold parity
+# ---------------------------------------------------------------------------
+
+def _svc(tmp_path, **scfg_over):
+    from structured_light_for_3d_model_replication_tpu.pipeline.serving import (
+        ScanService,
+    )
+
+    cfg = Config()
+    cfg.serving.auth_enabled = True
+    for k, v in scfg_over.items():
+        setattr(cfg.serving, k, v)
+    root = str(tmp_path / "svc")
+    os.makedirs(root, exist_ok=True)
+    write_tenant(os.path.join(root, "tenants.json"), "alice", "ak")
+    write_tenant(os.path.join(root, "tenants.json"), "bob", "bk",
+                 rate_limit=1, rate_window_s=3600.0)
+    return ScanService(root, cfg=cfg, log=lambda m: None)
+
+
+def test_submit_auth_gate_401_403_429(tmp_path):
+    from structured_light_for_3d_model_replication_tpu.pipeline.serving import (
+        _REASON_HTTP,
+    )
+
+    svc = _svc(tmp_path)
+    try:
+        ok, body = svc.submit({"tenant": "alice"})
+        assert not ok and body["reason"] == "auth-required"
+        ok, body = svc.submit({"tenant": "alice", "api_key": "wrong"})
+        assert not ok and body["reason"] == "auth-invalid"
+        ok, body = svc.submit({"tenant": "alice", "api_key": "bk"})
+        assert not ok and body["reason"] == "auth-forbidden"
+        # authenticated but bad payload: auth happens FIRST, then 400
+        ok, body = svc.submit({"tenant": "alice", "api_key": "ak"})
+        assert not ok and body["reason"] == "bad-request"
+        # bob's per-tenant override: second submit inside the window 429s
+        svc.submit({"tenant": "bob", "api_key": "bk"})
+        ok, body = svc.submit({"tenant": "bob", "api_key": "bk"})
+        assert not ok and body["reason"] == "rate-limited"
+        assert body["retry_after_s"] > 0
+        assert _REASON_HTTP["auth-required"] == 401
+        assert _REASON_HTTP["auth-invalid"] == 401
+        assert _REASON_HTTP["auth-forbidden"] == 403
+        assert _REASON_HTTP["rate-limited"] == 429
+    finally:
+        svc.close()
+
+
+def test_auth_disabled_costs_one_none_check(tmp_path):
+    svc = _svc(tmp_path, auth_enabled=False)
+    try:
+        assert svc._auth is None                  # the 1.02x contract
+        ok, body = svc.submit({"tenant": "alice"})
+        assert not ok and body["reason"] == "bad-request"   # not auth
+    finally:
+        svc.close()
+
+
+def test_usage_fold_parity_vs_ledger(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    adm = AdmissionController(path, "r1", log=lambda m: None)
+    for i, (tenant, state) in enumerate(
+            (("alice", "done"), ("alice", "degraded"), ("bob", "failed"))):
+        sid = f"{tenant}-s{i}"
+        job = ScanJob(sid, tenant, f"/t{i}", "/c", f"/o{i}")
+        ok, _ = adm.submit(job)
+        assert ok
+        adm.add_items(sid, [{"index": 0, "src": "v0"}])
+        job.state = "admitted"
+        (iid, gen, _spec), = adm.next_views("lane0", 1)
+        assert adm.complete(iid, "lane0", gen)
+        adm.finish(sid, state)
+    sid = "carol-s9"
+    ok, _ = adm.submit(ScanJob(sid, "carol", "/t9", "/c", "/o9"))
+    assert ok                                     # stays in flight
+    adm.close()
+    u = fold_usage(replay_serving(path))
+    assert u["alice"]["submitted"] == 2
+    assert u["alice"]["done"] == 1
+    assert u["alice"]["degraded"] == 1
+    assert u["alice"]["views_completed"] == 2
+    assert u["alice"]["in_flight"] == 0
+    assert u["bob"]["failed"] == 1
+    assert u["bob"]["views_completed"] == 1
+    assert u["carol"]["in_flight"] == 1
+    assert u["carol"]["compute_s"] == 0.0
+    assert u["alice"]["compute_s"] >= 0.0

@@ -260,4 +260,20 @@ else
   echo "HA_SMOKE=FAIL (rc=$ha_rc; see tools/_ci/ha_smoke.log)"
   [ $rc -eq 0 ] && rc=1
 fi
+
+# ---- fleet smoke: a real gateway with the elastic fleet supervisor on,
+# driven over HTTP — scale-up 0->2 `sl3d worker` processes under load,
+# byte parity vs solo runs, a SIGKILLed worker respawned at the same
+# rank with a bumped generation (ledger + state + the worker's own
+# hello), scale-in back to the floor on idle, and replay_fleet folding
+# the decision ledger to the live supervisor's final state (ISSUE 18) ----
+fleet_rc=0
+fleet=$(timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py 2>&1) || fleet_rc=$?
+echo "$fleet" > tools/_ci/fleet_smoke.log
+if [ $fleet_rc -eq 0 ] && echo "$fleet" | grep -q 'FLEET_SMOKE=ok'; then
+  echo "$fleet" | grep 'FLEET_SMOKE=ok'
+else
+  echo "FLEET_SMOKE=FAIL (rc=$fleet_rc; see tools/_ci/fleet_smoke.log)"
+  [ $rc -eq 0 ] && rc=1
+fi
 exit $rc

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""CI smoke for the elastic fleet (ISSUE 18): a real gateway with the
+fleet supervisor on, driven over HTTP, with a real SIGKILL in the middle.
+
+Asserts, end to end (no thresholds — completion + identity + ledger):
+  * under load the supervisor scales 0 -> 2 ``sl3d worker`` processes
+    (spawn decisions journaled with their signal snapshots);
+  * both tenants' requests complete DONE with /result PLY + STL
+    byte-identical to solo ``run_pipeline`` runs of the same inputs —
+    fleet workers only warm the shared content-addressed cache, so
+    parity is the PR-8 construction whoever computed each view;
+  * SIGKILLing a worker respawns its RANK with a bumped generation
+    (visible in the ledger, the supervisor state, AND the respawned
+    worker's own hello — the spec->hello->trace generation thread);
+  * a fully idle fleet scales back in to the floor (0), draining via
+    clean shutdown grants;
+  * ``replay_fleet`` over the ledger reproduces the live supervisor's
+    final state — the scaling history is replayable.
+
+Prints ``FLEET_SMOKE=ok`` and exits 0 on success. Numpy backend.
+"""
+import json
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from structured_light_for_3d_model_replication_tpu.config import Config
+from structured_light_for_3d_model_replication_tpu.io import matfile
+from structured_light_for_3d_model_replication_tpu.parallel.fleet import (
+    replay_fleet,
+)
+from structured_light_for_3d_model_replication_tpu.pipeline import serving
+from structured_light_for_3d_model_replication_tpu.pipeline import stages
+from structured_light_for_3d_model_replication_tpu.utils import synthetic as syn
+
+from serve_smoke import CAM, PROJ, STEPS, get, post_json, render_scan, \
+    wait_terminal  # noqa: E402  (same dir; the shared smoke idiom)
+
+
+def make_cfg() -> Config:
+    cfg = Config()
+    cfg.parallel.backend = "numpy"
+    cfg.decode.n_cols, cfg.decode.n_rows = PROJ
+    cfg.decode.thresh_mode = "manual"
+    cfg.merge.voxel_size = 4.0
+    cfg.merge.ransac_trials = 512
+    cfg.merge.icp_iters = 10
+    cfg.mesh.depth = 5
+    cfg.mesh.density_trim_quantile = 0.0
+    cfg.serving.clean_steps = "statistical"
+    cfg.serving.host = "127.0.0.1"
+    cfg.serving.port = 0
+    cfg.serving.fleet_enabled = True
+    cfg.serving.fleet_min_workers = 0
+    cfg.serving.fleet_max_workers = 2
+    cfg.serving.fleet_poll_s = 0.1
+    cfg.serving.fleet_scale_up_queue = 2
+    cfg.serving.fleet_scale_in_idle_s = 10.0
+    cfg.serving.fleet_backoff_s = 0.2
+    return cfg
+
+
+def wait_for(pred, what: str, timeout_s: float = 90.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        v = pred()
+        if v:
+            return v
+    raise TimeoutError(f"timed out waiting for {what} after {timeout_s}s")
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="sl3d_fleet_smoke_")
+    try:
+        calib = os.path.join(tmp, "calib.mat")
+        matfile.save_calibration(
+            calib, syn.default_rig(cam_size=CAM,
+                                   proj_size=PROJ).calibration())
+        targets, solos = [], []
+        for i, shift in enumerate((0.0, 9.0)):
+            tgt = os.path.join(tmp, f"in_t{i}")
+            os.makedirs(tgt)
+            render_scan(tgt, views=3, shift=shift)
+            solo = os.path.join(tmp, f"solo{i}")
+            rep = stages.run_pipeline(calib, tgt, solo, cfg=make_cfg(),
+                                      steps=STEPS, log=lambda m: None)
+            assert rep.failed == [], rep.failed
+            targets.append(tgt)
+            solos.append(solo)
+        print("[fleet_smoke] solo references done")
+
+        root = os.path.join(tmp, "svc")
+        httpd, svc = serving.start_gateway(root, cfg=make_cfg(),
+                                           log=lambda m: None)
+        threading.Thread(target=httpd.serve_forever,
+                         kwargs={"poll_interval": 0.1},
+                         daemon=True).start()
+        base = (f"http://{httpd.server_address[0]}:"
+                f"{httpd.server_address[1]}")
+        assert svc.fleet is not None, "fleet supervisor did not start"
+        print(f"[fleet_smoke] gateway up at {base} "
+              f"(fleet bridge {svc.fleet.server.endpoint})")
+        try:
+            sids = [post_json(f"{base}/submit",
+                              {"tenant": f"t{i}", "target": tgt,
+                               "calib": calib})["scan_id"]
+                    for i, tgt in enumerate(targets)]
+
+            # scale-up: 6 pending items / scale_up_queue=2, capped at 2
+            wait_for(lambda: len(svc.fleet.state()["live"]) >= 2,
+                     "scale-up to 2 workers")
+            print(f"[fleet_smoke] scaled 0 -> 2: "
+                  f"{svc.fleet.state()['live']}")
+
+            for sid, solo in zip(sids, solos):
+                st = wait_terminal(base, sid)
+                assert st["state"] == "done", st
+                for art, name in (("ply", "merged.ply"),
+                                  ("stl", "model.stl")):
+                    got = get(f"{base}/result/{sid}?artifact={art}")
+                    with open(os.path.join(solo, name), "rb") as f:
+                        assert f.read() == got, \
+                            f"{sid} {name} diverged from solo"
+            print("[fleet_smoke] both tenants done, PLY/STL byte-parity "
+                  "vs solo holds")
+
+            # the kill: wait for a worker to be fully up (hello'd), then
+            # SIGKILL it and watch the SAME RANK come back at gen+1
+            wait_for(lambda: svc.fleet.state()["hellos"],
+                     "first worker hello")
+            st = svc.fleet.state()
+            rank = st["live"][0]
+            pid = st["pids"][rank]
+            os.kill(pid, signal.SIGKILL)
+            print(f"[fleet_smoke] SIGKILLed fw{rank} (pid {pid})")
+            wait_for(lambda: (svc.fleet.state()["generations"]
+                              .get(rank, 0) >= 1),
+                     f"fw{rank} respawn with bumped generation")
+            gen = svc.fleet.state()["generations"][rank]
+            print(f"[fleet_smoke] fw{rank} healed as generation {gen}")
+            # the respawned incarnation's own hello carries the stamp
+            wait_for(lambda: (svc.fleet.state()["hellos"]
+                              .get(f"fw{rank}", {})
+                              .get("generation", 0) >= 1),
+                     "respawned worker hello with generation")
+
+            # scale-in: idle past fleet_scale_in_idle_s drains to floor 0
+            wait_for(lambda: not svc.fleet.state()["live"]
+                     and not svc.fleet.state()["respawning"],
+                     "scale-in to 0 on idle")
+            print("[fleet_smoke] scaled in to 0 on idle")
+
+            # the ledger replays to the live final state
+            rs = replay_fleet(svc._ledger_path)
+            live_st = svc.fleet.state()
+            assert rs["live"] == live_st["live"] == [], \
+                (rs["live"], live_st["live"])
+            assert rs["target"] == live_st["target"] == 0, \
+                (rs["target"], live_st["target"])
+            assert rs["generations"].get(rank, 0) >= 1, rs["generations"]
+            actions: dict = {}
+            with open(svc._ledger_path, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if ev.get("type") == "fleet":
+                        a = ev.get("action", "?")
+                        actions[a] = actions.get(a, 0) + 1
+            for need in ("scale-up", "spawn", "worker-exit", "respawn",
+                         "scale-in", "retired"):
+                assert actions.get(need), \
+                    f"ledger missing fleet action {need!r}: {actions}"
+            print(f"[fleet_smoke] decision ledger replays "
+                  f"({rs['events']} fleet events: {actions})")
+
+            text = get(f"{base}/metrics").decode()
+            assert "sl3d_fleet_spawns_total" in text, "fleet metrics"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            svc.close()
+        print("FLEET_SMOKE=ok")
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,19 @@ contract: every accepted request must be terminal (done/degraded)
 after the restart, within budget, and ``replay_serving`` must fold the
 ledger without error.
 
+``--fleet-runs`` (ISSUE 18) appends an elastic-fleet kill matrix: each
+run starts an in-process gateway with the FleetSupervisor armed
+(floor 1, cap 2 workers) under a randomly drawn chaos kind — SIGKILL
+of one or two fleet workers mid-load, seeded ``worker.spawn``
+transients (spawn attempts fail and retry under backoff),
+``fleet.decide`` transients (skipped decision ticks), or an injected
+``fleet.decide`` crash that fells the gateway itself (the service is
+then restarted fault-free over the same root and must RESUME the
+journaled fleet). Every run must end with all accepted requests
+done/degraded, the fleet quiesced back to its floor, and —
+the replayability contract — ``replay_fleet`` over the decision
+ledger folding to exactly the live supervisor's final state.
+
 Prints ``SOAK=ok runs=N ...`` (exit 0) or ``SOAK=FAIL (...)`` (exit 1).
 CI runs a short arm (``tools/ci_tier1.sh`` SOAK_SMOKE); longer sweeps:
 
@@ -148,6 +161,14 @@ def main() -> int:
                     help="additional serving kill->restart runs drawn "
                          "from the serve-scope matrix (serve.crash / "
                          "ledger.append); 0 disables")
+    ap.add_argument("--fleet-runs", type=int, default=0,
+                    help="additional elastic-fleet runs drawn from the "
+                         "fleet kill matrix (worker SIGKILLs, "
+                         "worker.spawn/fleet.decide faults, supervisor "
+                         "crash + fault-free resume); every run must "
+                         "settle all accepted requests and its decision "
+                         "ledger must replay to the live fleet state; "
+                         "0 disables")
     ap.add_argument("--ha-runs", type=int, default=0,
                     help="additional two-member gateway-HA runs drawn "
                          "from the HA matrix (leader crash at a "
@@ -175,7 +196,8 @@ def main() -> int:
     # thread's stack and die loudly instead of hanging CI
     alarm_s = int(args.budget_s * (args.runs + args.multiproc_runs
                                    + 2 * args.serve_runs
-                                   + 2 * args.ha_runs) + 120)
+                                   + 2 * args.ha_runs
+                                   + 3 * args.fleet_runs) + 120)
 
     def on_alarm(signum, frame):
         faulthandler.dump_traceback(all_threads=True)
@@ -525,10 +547,159 @@ def main() -> int:
                   f"{rs['stale_ignored']} stale record(s) fenced out of "
                   f"the fold ({len(accepted)} scan(s))")
 
+        # ---- elastic-fleet kill matrix (ISSUE 18): an in-process
+        # gateway with the FleetSupervisor armed, under a drawn chaos
+        # kind — real SIGKILLs of spawned fleet workers, spawn/decide
+        # faults, or a supervisor crash followed by a fault-free resume
+        # over the same root. Contract per run: all accepted requests
+        # done/degraded, the fleet quiesced back to target, and the
+        # journaled decisions replaying to the live supervisor's state.
+        from structured_light_for_3d_model_replication_tpu.parallel.fleet import (  # noqa: E501
+            replay_fleet,
+        )
+
+        def fleet_cfg() -> Config:
+            c = serve_cfg()
+            c.serving.fleet_enabled = True
+            c.serving.fleet_min_workers = 1   # the floor keeps a worker
+            c.serving.fleet_max_workers = 2   # up so every kind can kill
+            c.serving.fleet_poll_s = 0.1
+            c.serving.fleet_scale_up_queue = 2
+            c.serving.fleet_scale_in_idle_s = 2.0
+            c.serving.fleet_backoff_s = 0.2
+            c.serving.fleet_backoff_max_s = 2.0
+            return c
+
+        FLEET_KINDS = ["kill-worker", "kill-worker-x2", "spawn-flap",
+                       "decide-skip", "supervisor-crash"]
+        FLEET_RULES = {"spawn-flap": "worker.spawn:transientx2",
+                       "decide-skip": "fleet.decide:transient@2x3",
+                       "supervisor-crash": "fleet.decide:crash@6"}
+
+        for i in range(args.fleet_runs):
+            kind = rng.choice(FLEET_KINDS)
+            froot = os.path.join(tmp, f"fleet_{i:03d}")
+            t0 = time.monotonic()
+            spec = FLEET_RULES.get(kind, "")
+            if spec:
+                faults.configure(spec, seed=args.seed + 4000 + i)
+            svc = serving.ScanService(froot, cfg=fleet_cfg(),
+                                      log=lambda m: None)
+            svc.start()
+            accepted = []
+            try:
+                for tenant in ("ta", "tb"):
+                    ok, body = svc.submit({"tenant": tenant,
+                                           "target": root,
+                                           "calib": calib})
+                    if ok:
+                        accepted.append(body["scan_id"])
+            except faults.InjectedCrash:
+                pass                 # died in the submit path itself
+            kills = {"kill-worker": 1, "kill-worker-x2": 2}.get(kind, 0)
+            killed: list[int] = []
+            crashed = False
+            t_end = t0 + args.budget_s
+            while time.monotonic() < t_end:
+                if svc.phase == "crashed":
+                    crashed = True
+                    break
+                if kills and svc.fleet is not None:
+                    # SIGKILL a fleet worker we have not killed yet (a
+                    # respawned incarnation is a NEW pid, so x2 kills the
+                    # healed worker too)
+                    fresh = [p for p in svc.fleet.state()["pids"].values()
+                             if p not in killed]
+                    if fresh:
+                        try:
+                            os.kill(fresh[0], signal.SIGKILL)
+                            killed.append(fresh[0])
+                            kills -= 1
+                        except OSError:
+                            pass     # lost the race with its own death
+                with svc.adm.lock:
+                    jobs = list(svc.adm.jobs.values())
+                if accepted and jobs and all(j.state in TERMINAL
+                                             for j in jobs):
+                    break
+                time.sleep(0.1)
+            faults.reset()
+            if not accepted and not crashed:
+                svc.close()
+                return fail(f"fleet run {i} [{kind}] accepted nothing")
+            if crashed:
+                # the supervisor (or its host service) died: restart
+                # FAULT-FREE over the same root — replay_fleet hands the
+                # new supervisor the journaled fleet to resume
+                svc.close()
+                svc = serving.ScanService(froot, cfg=fleet_cfg(),
+                                          log=lambda m: None)
+                svc.start()
+            t_end = time.monotonic() + args.budget_s
+            settled = False
+            jobs = []
+            while time.monotonic() < t_end:
+                with svc.adm.lock:
+                    jobs = list(svc.adm.jobs.values())
+                if jobs and all(j.state in TERMINAL for j in jobs):
+                    settled = True
+                    break
+                time.sleep(0.1)
+            states = {j.scan_id: j.state for j in jobs}
+            # quiesce + replay parity: the fleet drains to its floor and
+            # the decision ledger folds to exactly the live state
+            ledger_path = os.path.join(froot, "ledger.jsonl")
+            parity = False
+            rs_fleet: dict = {}
+            st: dict = {}
+            t_end = time.monotonic() + 90.0
+            while settled and svc.fleet is not None \
+                    and time.monotonic() < t_end:
+                st = svc.fleet.state()
+                rs_fleet = replay_fleet(ledger_path)
+                if (not st["retiring"] and not st["respawning"]
+                        and len(st["live"]) == st["target"]
+                        and rs_fleet["live"] == st["live"]
+                        and rs_fleet["target"] == st["target"]
+                        and all(rs_fleet["generations"].get(r)
+                                == st["generations"][r]
+                                for r in st["live"])):
+                    parity = True
+                    break
+                time.sleep(0.2)
+            svc.close()
+            wall = time.monotonic() - t0
+            walls.append(round(wall, 1))
+            if not settled:
+                return fail(f"fleet run {i} [{kind}] not settled: "
+                            f"{states}")
+            bad = {s: stt for s, stt in states.items()
+                   if stt not in ("done", "degraded")}
+            if bad:
+                return fail(f"fleet run {i} [{kind}] accepted requests "
+                            f"not recovered: {bad}")
+            if not parity:
+                return fail(f"fleet run {i} [{kind}] decision ledger "
+                            f"does not replay to the live fleet state: "
+                            f"replay={rs_fleet} live={st}")
+            if not rs_fleet.get("events"):
+                return fail(f"fleet run {i} [{kind}] journaled no fleet "
+                            f"decisions")
+            try:
+                replay_serving(os.path.join(froot, "ledger.jsonl"))
+            except ValueError as e:
+                return fail(f"fleet run {i} [{kind}] ledger invalid: {e}")
+            outcomes[f"fleet-{kind}"] = \
+                outcomes.get(f"fleet-{kind}", 0) + 1
+            print(f"[soak] fleet run {i}: {kind:<16} {wall:5.1f}s  "
+                  f"({len(states)} scan(s), {rs_fleet['events']} fleet "
+                  f"event(s), {len(killed)} worker(s) SIGKILLed, "
+                  f"final fleet {st['live']})")
+
         summary = json.dumps(outcomes, sort_keys=True)
         print(f"SOAK=ok runs={args.runs} seed={args.seed} "
               f"multiproc={args.multiproc_runs} serve={args.serve_runs} "
-              f"ha={args.ha_runs} "
+              f"ha={args.ha_runs} fleet={args.fleet_runs} "
               f"outcomes={summary} max_wall={max(walls)}s")
         return 0
     finally:
